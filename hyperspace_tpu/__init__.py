@@ -1,0 +1,15 @@
+"""hyperspace_tpu: a TPU-native lakehouse indexing framework.
+
+A brand-new JAX/XLA/Pallas implementation of the capabilities of Microsoft Hyperspace
+(the reference at /root/reference): covering-index CRUD over an on-lake operation log
+with optimistic concurrency, transparent query rewrite rules (filter + join), and a
+TPU-first execution path — index builds as on-device hash-partition + sort with
+all-to-all over the device mesh, index scans and co-bucketed shuffle-free sort-merge
+joins as XLA/Pallas programs.
+"""
+
+from .config import HyperspaceConf, IndexConstants, SessionConf  # noqa: F401
+from .exceptions import HyperspaceException  # noqa: F401
+from .index.index_config import IndexConfig  # noqa: F401
+
+__version__ = "0.1.0"

@@ -1,0 +1,129 @@
+"""Configuration system.
+
+Parity: the reference piggybacks on Spark SQLConf with the `spark.hyperspace.*` namespace;
+all keys + defaults are centralized in `index/IndexConstants.scala:21-57` with the typed
+accessor `util/HyperspaceConf.scala`. Here the session carries a flat string-keyed conf
+(`SessionConf`) with the same knob set, plus typed accessors (`HyperspaceConf`).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+class IndexConstants:
+    """All config keys and defaults (reference `index/IndexConstants.scala:21-57`)."""
+
+    INDEX_SYSTEM_PATH = "hyperspace.system.path"
+    INDEX_CREATION_PATH = "hyperspace.index.creation.path"
+    INDEX_SEARCH_PATHS = "hyperspace.index.search.paths"
+
+    INDEX_NUM_BUCKETS = "hyperspace.index.num.buckets"
+    INDEX_NUM_BUCKETS_DEFAULT = 200  # reference default = spark.sql.shuffle.partitions
+
+    INDEX_CACHE_EXPIRY_DURATION_SECONDS = "hyperspace.index.cache.expiryDurationInSeconds"
+    INDEX_CACHE_EXPIRY_DURATION_SECONDS_DEFAULT = 300
+
+    INDEX_HYBRID_SCAN_ENABLED = "hyperspace.index.hybridscan.enabled"
+    INDEX_HYBRID_SCAN_ENABLED_DEFAULT = False
+
+    INDEX_LINEAGE_ENABLED = "hyperspace.index.lineage.enabled"
+    INDEX_LINEAGE_ENABLED_DEFAULT = False
+    DATA_FILE_NAME_COLUMN = "_data_file_name"
+
+    # On-lake layout names (reference `IndexConstants.scala:41-42`).
+    HYPERSPACE_LOG = "_hyperspace_log"
+    INDEX_VERSION_DIR_PREFIX = "v__"
+
+    # Explain display modes (reference `IndexConstants.scala:45-52`).
+    DISPLAY_MODE = "hyperspace.explain.displayMode"
+    HIGHLIGHT_BEGIN_TAG = "hyperspace.explain.displayMode.highlight.beginTag"
+    HIGHLIGHT_END_TAG = "hyperspace.explain.displayMode.highlight.endTag"
+
+    EVENT_LOGGER_CLASS = "hyperspace.eventLoggerClass"
+
+    # Data-skipping extension (north-star; absent from the v0 reference snapshot).
+    DATASKIPPING_TARGET_INDEX_DATA_FILE_SIZE = "hyperspace.index.dataskipping.targetIndexDataFileSize"
+
+    # Number of mesh devices the build path shards over (TPU-native knob; no
+    # reference analogue — Spark's parallelism came from its cluster manager).
+    BUILD_MESH_DEVICES = "hyperspace.build.mesh.devices"
+
+
+class SessionConf:
+    """Flat string-keyed conf map with defaults (the SQLConf analogue)."""
+
+    def __init__(self, initial: Optional[Dict[str, str]] = None):
+        self._conf: Dict[str, str] = dict(initial or {})
+
+    def set(self, key: str, value) -> None:
+        self._conf[key] = str(value)
+
+    def unset(self, key: str) -> None:
+        self._conf.pop(key, None)
+
+    def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
+        return self._conf.get(key, default)
+
+    def get_int(self, key: str, default: int) -> int:
+        v = self._conf.get(key)
+        return int(v) if v is not None else default
+
+    def get_bool(self, key: str, default: bool) -> bool:
+        v = self._conf.get(key)
+        if v is None:
+            return default
+        return v.strip().lower() in ("1", "true", "yes", "on")
+
+    def copy(self) -> "SessionConf":
+        return SessionConf(dict(self._conf))
+
+
+class HyperspaceConf:
+    """Typed accessors over a SessionConf (reference `util/HyperspaceConf.scala`)."""
+
+    def __init__(self, conf: SessionConf):
+        self._c = conf
+
+    @property
+    def num_buckets(self) -> int:
+        return self._c.get_int(
+            IndexConstants.INDEX_NUM_BUCKETS, IndexConstants.INDEX_NUM_BUCKETS_DEFAULT
+        )
+
+    @property
+    def hybrid_scan_enabled(self) -> bool:
+        return self._c.get_bool(
+            IndexConstants.INDEX_HYBRID_SCAN_ENABLED,
+            IndexConstants.INDEX_HYBRID_SCAN_ENABLED_DEFAULT,
+        )
+
+    @property
+    def lineage_enabled(self) -> bool:
+        return self._c.get_bool(
+            IndexConstants.INDEX_LINEAGE_ENABLED, IndexConstants.INDEX_LINEAGE_ENABLED_DEFAULT
+        )
+
+    @property
+    def cache_expiry_seconds(self) -> int:
+        return self._c.get_int(
+            IndexConstants.INDEX_CACHE_EXPIRY_DURATION_SECONDS,
+            IndexConstants.INDEX_CACHE_EXPIRY_DURATION_SECONDS_DEFAULT,
+        )
+
+    @property
+    def system_path(self) -> Optional[str]:
+        return self._c.get(IndexConstants.INDEX_SYSTEM_PATH)
+
+    @property
+    def search_paths(self) -> Optional[List[str]]:
+        v = self._c.get(IndexConstants.INDEX_SEARCH_PATHS)
+        return v.split(",") if v else None
+
+    @property
+    def event_logger_class(self) -> Optional[str]:
+        return self._c.get(IndexConstants.EVENT_LOGGER_CLASS)
+
+    @property
+    def build_mesh_devices(self) -> int:
+        return self._c.get_int(IndexConstants.BUILD_MESH_DEVICES, 1)

@@ -1,0 +1,13 @@
+"""The single framework exception type.
+
+Parity: reference `HyperspaceException.scala:19` — one exception class carrying a
+message, raised for all user-facing error conditions.
+"""
+
+
+class HyperspaceException(Exception):
+    """Raised for all Hyperspace-TPU error conditions (validation, concurrency, state)."""
+
+    def __init__(self, message: str):
+        super().__init__(message)
+        self.message = message

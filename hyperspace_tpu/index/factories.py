@@ -1,0 +1,28 @@
+"""DI seams for log/data managers and filesystems (reference `index/factories.scala:23-50`).
+
+Tests inject fakes here exactly like the reference's mocked factories
+(`IndexCollectionManagerTest.scala:29-91`).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..storage.filesystem import FileSystem, LocalFileSystem
+from .data_manager import IndexDataManager, IndexDataManagerImpl
+from .log_manager import IndexLogManager, IndexLogManagerImpl
+
+
+class FileSystemFactory:
+    def create(self, path: str) -> FileSystem:
+        return LocalFileSystem()
+
+
+class IndexLogManagerFactory:
+    def create(self, index_path: str, fs: Optional[FileSystem] = None) -> IndexLogManager:
+        return IndexLogManagerImpl(index_path, fs)
+
+
+class IndexDataManagerFactory:
+    def create(self, index_path: str, fs: Optional[FileSystem] = None) -> IndexDataManager:
+        return IndexDataManagerImpl(index_path, fs)

@@ -1,0 +1,114 @@
+"""Operation log with optimistic concurrency.
+
+Parity: reference `index/IndexLogManager.scala` — numbered JSON entries under
+`<indexRoot>/_hyperspace_log/<id>`, `writeLog` refuses existing ids and commits via
+temp-file + atomic rename (`:146-162`); `latestStable` pointer copy (`:113-130`);
+`getLatestStableLog` falls back to scanning ids descending for a stable state (`:92-111`).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from ..actions.states import STABLE_STATES
+from ..config import IndexConstants
+from ..storage.filesystem import FileSystem, LocalFileSystem
+from ..util import json_utils
+from .log_entry import IndexLogEntry, LogEntry
+
+
+LATEST_STABLE = "latestStable"
+
+
+class IndexLogManager:
+    """Contract (reference `IndexLogManager.scala:33-55`)."""
+
+    def get_log(self, log_id: int) -> Optional[LogEntry]:
+        raise NotImplementedError
+
+    def get_latest_id(self) -> Optional[int]:
+        raise NotImplementedError
+
+    def get_latest_log(self) -> Optional[LogEntry]:
+        latest = self.get_latest_id()
+        return self.get_log(latest) if latest is not None else None
+
+    def get_latest_stable_log(self) -> Optional[LogEntry]:
+        raise NotImplementedError
+
+    def create_latest_stable_log(self, log_id: int) -> bool:
+        raise NotImplementedError
+
+    def delete_latest_stable_log(self) -> bool:
+        raise NotImplementedError
+
+    def write_log(self, log_id: int, entry: LogEntry) -> bool:
+        raise NotImplementedError
+
+
+class IndexLogManagerImpl(IndexLogManager):
+    """Filesystem-backed implementation (reference `IndexLogManagerImpl`, :57-163)."""
+
+    def __init__(self, index_path: str, fs: Optional[FileSystem] = None):
+        self._index_path = index_path
+        self._fs = fs or LocalFileSystem()
+
+    @property
+    def _log_dir(self) -> str:
+        return os.path.join(self._index_path, IndexConstants.HYPERSPACE_LOG)
+
+    def _path_for(self, log_id) -> str:
+        return os.path.join(self._log_dir, str(log_id))
+
+    def _read(self, path: str) -> Optional[LogEntry]:
+        if not self._fs.exists(path):
+            return None
+        return LogEntry.from_json(self._fs.read_text(path))
+
+    def get_log(self, log_id: int) -> Optional[LogEntry]:
+        return self._read(self._path_for(log_id))
+
+    def get_latest_id(self) -> Optional[int]:
+        if not self._fs.exists(self._log_dir):
+            return None
+        ids = [
+            int(st.name)
+            for st in self._fs.list_status(self._log_dir)
+            if st.name.isdigit()
+        ]
+        return max(ids) if ids else None
+
+    def get_latest_stable_log(self) -> Optional[LogEntry]:
+        stable = self._read(self._path_for(LATEST_STABLE))
+        if stable is not None:
+            return stable
+        # Fallback: scan ids descending for a stable state (reference :92-111).
+        latest = self.get_latest_id()
+        if latest is None:
+            return None
+        for i in range(latest, -1, -1):
+            entry = self.get_log(i)
+            if entry is not None and entry.state in STABLE_STATES:
+                return entry
+        return None
+
+    def create_latest_stable_log(self, log_id: int) -> bool:
+        entry = self.get_log(log_id)
+        if entry is None or entry.state not in STABLE_STATES:
+            return False
+        text = json_utils.to_json(entry.to_json())
+        return self._fs.atomic_write_text(self._path_for(LATEST_STABLE), text)
+
+    def delete_latest_stable_log(self) -> bool:
+        path = self._path_for(LATEST_STABLE)
+        if not self._fs.exists(path):
+            return True
+        self._fs.delete(path)
+        return True
+
+    def write_log(self, log_id: int, entry: LogEntry) -> bool:
+        """OCC point: fails if ``log_id`` already exists (reference :146-162)."""
+        entry.id = log_id
+        text = json_utils.to_json(entry.to_json())
+        return self._fs.atomic_write_text(self._path_for(log_id), text)

@@ -1,0 +1,40 @@
+"""Index path resolution.
+
+Parity: reference `index/PathResolver.scala:30-106` — resolves the system root
+(`spark.hyperspace.system.path`, default `<warehouse>/indexes`) and the per-index path
+with a case-insensitive name match against existing directories, so `createIndex("MyIdx")`
+followed by `deleteIndex("myidx")` hits the same directory.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..config import IndexConstants, SessionConf
+from ..storage.filesystem import FileSystem, LocalFileSystem
+
+DEFAULT_INDEX_SYSTEM_DIR = "indexes"
+
+
+class PathResolver:
+    def __init__(self, conf: SessionConf, fs: Optional[FileSystem] = None, warehouse: str = "."):
+        self._conf = conf
+        self._fs = fs or LocalFileSystem()
+        self._warehouse = warehouse
+
+    def system_path(self) -> str:
+        p = self._conf.get(IndexConstants.INDEX_SYSTEM_PATH)
+        if p:
+            return p
+        return os.path.join(self._warehouse, DEFAULT_INDEX_SYSTEM_DIR)
+
+    def get_index_path(self, name: str) -> str:
+        """Per-index root; reuses an existing dir whose name matches case-insensitively
+        (reference :39-58)."""
+        root = self.system_path()
+        if self._fs.exists(root):
+            for st in self._fs.list_status(root):
+                if st.is_dir and st.name.lower() == name.lower():
+                    return st.path
+        return os.path.join(root, name)

@@ -1,0 +1,293 @@
+"""Filesystem abstraction (the L1 storage layer).
+
+Parity: reference L1 = Hadoop `FileSystem` API reached through `util/FileUtils.scala:28-117`
+and `index/factories.scala:43-50` (`FileSystemFactory.create(path)`). The design point kept
+from the reference: *all* persistent state (metadata log + index data) lives on a
+filesystem-like store with an atomic rename, so the optimistic-concurrency protocol of the
+operation log works on any backend.
+
+Backends here: a local-disk implementation and an in-memory one (used by unit tests the way
+the reference injects mocked `FileSystem`s, `IndexCollectionManagerTest.scala:29-91`).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+
+@dataclass(frozen=True)
+class FileStatus:
+    """Metadata of one file or directory (name is the full path)."""
+
+    path: str
+    size: int
+    modified_time: int  # epoch millis
+    is_dir: bool
+
+    @property
+    def name(self) -> str:
+        return os.path.basename(self.path.rstrip("/"))
+
+
+class FileSystem:
+    """Minimal filesystem contract needed by the log/data managers and IO layer."""
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def is_dir(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def mkdirs(self, path: str) -> None:
+        raise NotImplementedError
+
+    def list_status(self, path: str) -> List[FileStatus]:
+        """Non-recursive listing of a directory."""
+        raise NotImplementedError
+
+    def get_status(self, path: str) -> FileStatus:
+        raise NotImplementedError
+
+    def delete(self, path: str, recursive: bool = False) -> None:
+        raise NotImplementedError
+
+    def rename(self, src: str, dst: str) -> bool:
+        """Atomic rename; returns False if dst already exists (no overwrite)."""
+        raise NotImplementedError
+
+    def read_bytes(self, path: str) -> bytes:
+        raise NotImplementedError
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        """Create-or-overwrite write (creates parent dirs)."""
+        raise NotImplementedError
+
+    # -- Conveniences shared by all backends (reference util/FileUtils.scala) --
+
+    def read_text(self, path: str) -> str:
+        return self.read_bytes(path).decode("utf-8")
+
+    def write_text(self, path: str, text: str) -> None:
+        self.write_bytes(path, text.encode("utf-8"))
+
+    def atomic_write_text(self, path: str, text: str) -> bool:
+        """OCC commit primitive: write to a unique temp then atomically link into place.
+
+        Returns False (and cleans up the temp) if ``path`` already exists — this is the
+        exact contract of the reference's `IndexLogManagerImpl.writeLog`
+        (`IndexLogManager.scala:146-162`). The commit must be atomic no-overwrite even
+        under concurrent writers (two processes racing on the same log id: exactly one
+        wins).
+        """
+        if self.exists(path):
+            return False
+        tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.temp"
+        self.write_text(tmp, text)
+        ok = self.rename(tmp, path)
+        if self.exists(tmp):
+            self.delete(tmp)
+        return ok
+
+    def list_leaf_files(self, path: str) -> List[FileStatus]:
+        """Recursive listing of all plain files beneath ``path``."""
+        out: List[FileStatus] = []
+        stack = [path]
+        while stack:
+            p = stack.pop()
+            for st in self.list_status(p):
+                if st.is_dir:
+                    stack.append(st.path)
+                else:
+                    out.append(st)
+        return sorted(out, key=lambda s: s.path)
+
+    def directory_size(self, path: str) -> int:
+        return sum(f.size for f in self.list_leaf_files(path))
+
+
+class LocalFileSystem(FileSystem):
+    """Local-disk backend (the default)."""
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def is_dir(self, path: str) -> bool:
+        return os.path.isdir(path)
+
+    def mkdirs(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+
+    def _status(self, path: str) -> FileStatus:
+        st = os.stat(path)
+        return FileStatus(
+            path=path,
+            size=st.st_size if not os.path.isdir(path) else 0,
+            modified_time=int(st.st_mtime * 1000),
+            is_dir=os.path.isdir(path),
+        )
+
+    def list_status(self, path: str) -> List[FileStatus]:
+        if not os.path.isdir(path):
+            return []
+        return sorted(
+            (self._status(os.path.join(path, n)) for n in os.listdir(path)),
+            key=lambda s: s.path,
+        )
+
+    def get_status(self, path: str) -> FileStatus:
+        return self._status(path)
+
+    def delete(self, path: str, recursive: bool = False) -> None:
+        if os.path.isdir(path):
+            if recursive:
+                shutil.rmtree(path)
+            else:
+                os.rmdir(path)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def rename(self, src: str, dst: str) -> bool:
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        if os.path.isdir(src):
+            # Directory moves are not on the OCC path; a pre-check suffices.
+            if os.path.exists(dst):
+                return False
+            try:
+                os.rename(src, dst)
+                return True
+            except OSError:
+                return False
+        try:
+            # os.link raises FileExistsError atomically if dst exists — unlike
+            # os.rename, which silently replaces it. This is what makes the
+            # operation log's optimistic concurrency sound under racing writers.
+            os.link(src, dst)
+            os.unlink(src)
+            return True
+        except FileExistsError:
+            return False
+        except OSError:
+            return False
+
+    def read_bytes(self, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(data)
+
+
+class InMemoryFileSystem(FileSystem):
+    """Dict-backed filesystem for unit tests and fault injection."""
+
+    def __init__(self):
+        self._files: Dict[str, bytes] = {}
+        self._mtimes: Dict[str, int] = {}
+        self._dirs: set = set()
+        # RLock: write_bytes holds the lock and calls mkdirs, which locks again.
+        self._lock = threading.RLock()
+
+    @staticmethod
+    def _norm(path: str) -> str:
+        return os.path.normpath(path)
+
+    def exists(self, path: str) -> bool:
+        p = self._norm(path)
+        with self._lock:
+            if p in self._files or p in self._dirs:
+                return True
+            prefix = p + os.sep
+            return any(f.startswith(prefix) for f in self._files)
+
+    def is_dir(self, path: str) -> bool:
+        p = self._norm(path)
+        with self._lock:
+            if p in self._dirs:
+                return True
+            prefix = p + os.sep
+            return any(f.startswith(prefix) for f in self._files)
+
+    def mkdirs(self, path: str) -> None:
+        p = self._norm(path)
+        with self._lock:
+            while p and p != os.sep:
+                self._dirs.add(p)
+                p = os.path.dirname(p)
+
+    def list_status(self, path: str) -> List[FileStatus]:
+        p = self._norm(path)
+        prefix = p + os.sep
+        children = set()
+        for f in list(self._files) + list(self._dirs):
+            if f.startswith(prefix):
+                rest = f[len(prefix):]
+                children.add(rest.split(os.sep)[0])
+        out = []
+        for c in sorted(children):
+            cp = os.path.join(p, c)
+            out.append(self.get_status(cp))
+        return out
+
+    def get_status(self, path: str) -> FileStatus:
+        p = self._norm(path)
+        if p in self._files:
+            return FileStatus(p, len(self._files[p]), self._mtimes.get(p, 0), False)
+        return FileStatus(p, 0, 0, True)
+
+    def delete(self, path: str, recursive: bool = False) -> None:
+        p = self._norm(path)
+        with self._lock:
+            self._files.pop(p, None)
+            self._mtimes.pop(p, None)
+            self._dirs.discard(p)
+            if recursive:
+                prefix = p + os.sep
+                for f in [f for f in self._files if f.startswith(prefix)]:
+                    del self._files[f]
+                    self._mtimes.pop(f, None)
+                self._dirs = {d for d in self._dirs if not d.startswith(prefix)}
+
+    def rename(self, src: str, dst: str) -> bool:
+        s, d = self._norm(src), self._norm(dst)
+        with self._lock:
+            if d in self._files or d in self._dirs:
+                return False
+            if s in self._files:
+                self._files[d] = self._files.pop(s)
+                self._mtimes[d] = self._mtimes.pop(s, 0)
+                return True
+            if s in self._dirs or self.is_dir(s):
+                prefix = s + os.sep
+                for f in [f for f in self._files if f.startswith(prefix)]:
+                    self._files[d + f[len(s):]] = self._files.pop(f)
+                    self._mtimes[d + f[len(s):]] = self._mtimes.pop(f, 0)
+                moved_dirs = {x for x in self._dirs if x == s or x.startswith(prefix)}
+                self._dirs -= moved_dirs
+                self._dirs |= {d + x[len(s):] for x in moved_dirs}
+                self._dirs.add(d)
+                return True
+            return False
+
+    def read_bytes(self, path: str) -> bytes:
+        p = self._norm(path)
+        if p not in self._files:
+            raise FileNotFoundError(path)
+        return self._files[p]
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        p = self._norm(path)
+        with self._lock:
+            self._files[p] = data
+            self._mtimes[p] = int(time.time() * 1000)
+            parent = os.path.dirname(p)
+            if parent:
+                self.mkdirs(parent)

@@ -1,0 +1,28 @@
+"""JSON (de)serialization helpers.
+
+Parity: reference `util/JsonUtils.scala:28-45` (Jackson pretty printer, Include.ALWAYS).
+Here: stdlib json with stable key order off (insertion order preserved), pretty output,
+and dataclass-aware encoding handled by the caller via `to_json_dict` protocols.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+
+def to_json(obj: Any) -> str:
+    """Serialize a JSON-compatible object tree to a pretty-printed string."""
+    return json.dumps(obj, indent=2, ensure_ascii=False)
+
+
+def from_json(text: str) -> Any:
+    """Parse a JSON string into Python objects."""
+    return json.loads(text)
+
+
+def json_to_map(text: str) -> dict:
+    obj = from_json(text)
+    if not isinstance(obj, dict):
+        raise ValueError(f"expected JSON object, got {type(obj)}")
+    return obj

@@ -1,0 +1,26 @@
+"""Path helpers.
+
+Parity: reference `util/PathUtils.scala:21-38` — absolute-path normalization and the
+data-path filter that hides `_*`/`.*` metadata files (except hive-style partition dirs,
+which contain `=`).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def make_absolute(path: str) -> str:
+    return os.path.abspath(path)
+
+
+def is_data_path(name: str) -> bool:
+    """True if a file/dir name is user data (not `_`/`.`-prefixed metadata).
+
+    Hive-style partition directory names like ``v__=12`` or ``date=2020-01-01`` are
+    data paths even when they begin with ``_`` (reference `PathUtils.DataPathFilter`).
+    """
+    base = os.path.basename(name.rstrip("/"))
+    if "=" in base:
+        return True
+    return not (base.startswith("_") or base.startswith("."))

@@ -1,0 +1,33 @@
+"""Column-name resolution honoring case sensitivity.
+
+Parity: reference `util/ResolverUtils.scala:26-74` — resolves requested column names
+against available ones using the session resolver (case-insensitive by default,
+controlled by conf `caseSensitive`). Returns the *available* spelling on match, so
+downstream code uses the canonical column name.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence
+
+
+def resolve(requested: str, available: Iterable[str], case_sensitive: bool = False) -> Optional[str]:
+    """Resolve one requested column name; returns canonical (available) spelling or None."""
+    for a in available:
+        if requested == a if case_sensitive else requested.lower() == a.lower():
+            return a
+    return None
+
+
+def resolve_all(
+    requested: Sequence[str], available: Iterable[str], case_sensitive: bool = False
+) -> Optional[List[str]]:
+    """Resolve all requested names; None if any fails to resolve."""
+    avail = list(available)
+    out: List[str] = []
+    for r in requested:
+        m = resolve(r, avail, case_sensitive)
+        if m is None:
+            return None
+        out.append(m)
+    return out

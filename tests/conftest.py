@@ -1,0 +1,31 @@
+"""Test harness: force JAX onto a virtual 8-device CPU platform.
+
+Mirrors the reference's test strategy of running everything on Spark local[4] in-process
+(`SparkInvolvedSuite.scala:30-46`): no real cluster/TPU needed; sharding and collectives
+are exercised on a virtual 8-device CPU mesh.
+
+Note: this image preloads jax at interpreter start with JAX_PLATFORMS=axon (TPU tunnel),
+so a plain env-var default is not enough — we must override the already-created jax
+config before the first backend initialization.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_system_path(tmp_path):
+    """Per-test index system path (the reference's per-suite systemPath fixture,
+    `HyperspaceSuite.scala:25-89`)."""
+    p = tmp_path / "indexes"
+    return str(p)

@@ -1,0 +1,270 @@
+"""Tests for the storage + metadata core (L1/L3).
+
+Mirrors reference tiers 1 and 3 (SURVEY §4): `IndexConfigTests`, `JsonUtilsTests`,
+`HashingUtilsTests`, `IndexLogEntryTest` (Content/Directory tree construction),
+`IndexLogManagerImplTest` (real files under a tmpdir).
+"""
+
+import os
+
+import pytest
+
+from hyperspace_tpu import HyperspaceException, IndexConfig, IndexConstants, SessionConf
+from hyperspace_tpu.actions import states
+from hyperspace_tpu.index.data_manager import IndexDataManagerImpl
+from hyperspace_tpu.index.log_entry import (
+    Content,
+    CoveringIndexProperties,
+    Directory,
+    FileInfo,
+    IndexLogEntry,
+    LogEntry,
+    LogicalPlanFingerprint,
+    Relation,
+    Signature,
+    Source,
+    SourcePlanProperties,
+)
+from hyperspace_tpu.index.log_manager import IndexLogManagerImpl
+from hyperspace_tpu.index.path_resolver import PathResolver
+from hyperspace_tpu.storage.filesystem import InMemoryFileSystem, LocalFileSystem
+from hyperspace_tpu.util import hashing_utils, json_utils, resolver_utils
+from hyperspace_tpu.util.path_utils import is_data_path
+
+
+# ---------------------------------------------------------------------------
+# IndexConfig (reference IndexConfigTests)
+# ---------------------------------------------------------------------------
+
+
+class TestIndexConfig:
+    def test_basic(self):
+        c = IndexConfig("idx", ["a", "b"], ["c"])
+        assert c.index_name == "idx"
+        assert c.indexed_columns == ["a", "b"]
+
+    def test_empty_name_rejected(self):
+        with pytest.raises(HyperspaceException):
+            IndexConfig("", ["a"])
+
+    def test_empty_indexed_rejected(self):
+        with pytest.raises(HyperspaceException):
+            IndexConfig("idx", [])
+
+    def test_case_insensitive_duplicates_rejected(self):
+        with pytest.raises(HyperspaceException):
+            IndexConfig("idx", ["a", "A"])
+        with pytest.raises(HyperspaceException):
+            IndexConfig("idx", ["a"], ["b", "B"])
+        with pytest.raises(HyperspaceException):
+            IndexConfig("idx", ["a"], ["A"])
+
+    def test_case_insensitive_equality(self):
+        assert IndexConfig("IDX", ["A"], ["b", "C"]) == IndexConfig("idx", ["a"], ["c", "B"])
+        assert hash(IndexConfig("IDX", ["A"])) == hash(IndexConfig("idx", ["a"]))
+        assert IndexConfig("idx", ["a", "b"]) != IndexConfig("idx", ["b", "a"])  # order matters
+
+    def test_builder(self):
+        c = IndexConfig.builder().index_name("n").index_by("a", "b").include("c").create()
+        assert c == IndexConfig("n", ["a", "b"], ["c"])
+        with pytest.raises(HyperspaceException):
+            IndexConfig.builder().index_name("n").index_name("m")
+        with pytest.raises(HyperspaceException):
+            IndexConfig.builder().index_by("a").index_by("b")
+
+
+# ---------------------------------------------------------------------------
+# Utils (reference JsonUtilsTests / HashingUtilsTests / ResolverUtils)
+# ---------------------------------------------------------------------------
+
+
+class TestUtils:
+    def test_json_roundtrip(self):
+        obj = {"a": 1, "b": [1, 2, {"c": None}]}
+        assert json_utils.from_json(json_utils.to_json(obj)) == obj
+
+    def test_md5_stable(self):
+        assert hashing_utils.md5_hex("x") == hashing_utils.md5_hex("x")
+        assert hashing_utils.md5_hex("x") != hashing_utils.md5_hex("y")
+
+    def test_resolver_case_insensitive_default(self):
+        assert resolver_utils.resolve("DeptId", ["deptId", "other"]) == "deptId"
+        assert resolver_utils.resolve("deptid", ["deptId"], case_sensitive=True) is None
+        assert resolver_utils.resolve_all(["A", "b"], ["a", "B"]) == ["a", "B"]
+        assert resolver_utils.resolve_all(["A", "x"], ["a", "B"]) is None
+
+    def test_data_path_filter(self):
+        assert is_data_path("part-0.parquet")
+        assert not is_data_path("_SUCCESS")
+        assert not is_data_path(".hidden")
+        assert is_data_path("v__=3")  # hive-style partition dir counts as data
+
+
+# ---------------------------------------------------------------------------
+# Content / Directory tree (reference IndexLogEntryTest)
+# ---------------------------------------------------------------------------
+
+
+def _sample_entry(name="idx1", state=states.ACTIVE, sig="deadbeef"):
+    content = Content(
+        Directory(
+            "/tmp/indexes/idx1/v__=0",
+            files=[FileInfo("part-0.parquet", 100, 1)],
+            subdirs=[],
+        )
+    )
+    rel = Content(Directory("/data/t1", files=[FileInfo("f1.parquet", 10, 2)]))
+    entry = IndexLogEntry(
+        name,
+        CoveringIndexProperties(["deptId"], ["deptName"], '{"fields":[]}', 8),
+        content,
+        Source(
+            SourcePlanProperties(
+                [Relation(["/data/t1"], rel, '{"fields":[]}', "parquet", {})],
+                None,
+                None,
+                LogicalPlanFingerprint(signatures=[Signature("prov", sig)]),
+            )
+        ),
+    )
+    entry.state = state
+    return entry
+
+
+class TestContent:
+    def test_tree_from_leaf_files_and_flatten(self, tmp_path):
+        fs = LocalFileSystem()
+        root = tmp_path / "data"
+        (root / "a").mkdir(parents=True)
+        (root / "a" / "f1").write_text("xx")
+        (root / "f2").write_text("yyy")
+        (root / "_meta").write_text("ignored")
+        content = Content.from_directory(str(root), fs)
+        files = content.files()
+        assert str(root / "a" / "f1") in files
+        assert str(root / "f2") in files
+        assert all("_meta" not in f for f in files)
+
+    def test_json_roundtrip(self):
+        e = _sample_entry()
+        d = e.to_json()
+        e2 = IndexLogEntry.from_json(d)
+        assert e2 == e
+        assert e2.name == "idx1"
+        assert e2.num_buckets == 8
+        assert e2.signature().value == "deadbeef"
+        assert e2.indexed_columns == ["deptId"]
+
+    def test_polymorphic_decode(self):
+        text = json_utils.to_json(_sample_entry().to_json())
+        e = LogEntry.from_json(text)
+        assert isinstance(e, IndexLogEntry)
+
+
+# ---------------------------------------------------------------------------
+# IndexLogManager (reference IndexLogManagerImplTest + ActionTest OCC checks)
+# ---------------------------------------------------------------------------
+
+
+class TestIndexLogManager:
+    @pytest.mark.parametrize("fs_kind", ["local", "memory"])
+    def test_occ_write_refuses_existing_id(self, tmp_path, fs_kind):
+        fs = LocalFileSystem() if fs_kind == "local" else InMemoryFileSystem()
+        mgr = IndexLogManagerImpl(str(tmp_path / "idx"), fs)
+        assert mgr.write_log(0, _sample_entry(state=states.CREATING))
+        assert not mgr.write_log(0, _sample_entry(state=states.ACTIVE))  # OCC conflict
+        assert mgr.get_log(0).state == states.CREATING
+
+    def test_latest_id_and_log(self, tmp_path):
+        mgr = IndexLogManagerImpl(str(tmp_path / "idx"))
+        assert mgr.get_latest_id() is None
+        assert mgr.get_latest_log() is None
+        mgr.write_log(0, _sample_entry(state=states.CREATING))
+        mgr.write_log(1, _sample_entry(state=states.ACTIVE))
+        assert mgr.get_latest_id() == 1
+        assert mgr.get_latest_log().state == states.ACTIVE
+
+    def test_latest_stable_pointer_and_fallback(self, tmp_path):
+        mgr = IndexLogManagerImpl(str(tmp_path / "idx"))
+        mgr.write_log(0, _sample_entry(state=states.CREATING))
+        assert mgr.get_latest_stable_log() is None
+        mgr.write_log(1, _sample_entry(state=states.ACTIVE))
+        # No pointer yet -> descending scan finds id 1.
+        assert mgr.get_latest_stable_log().state == states.ACTIVE
+        assert mgr.create_latest_stable_log(1)
+        assert mgr.get_latest_stable_log().id == 1
+        # Pointer refuses non-stable ids.
+        mgr.write_log(2, _sample_entry(state=states.DELETING))
+        assert not mgr.create_latest_stable_log(2)
+        assert mgr.delete_latest_stable_log()
+        assert mgr.get_latest_stable_log().id == 1  # fallback scan again
+
+    def test_entry_roundtrip_through_log(self, tmp_path):
+        mgr = IndexLogManagerImpl(str(tmp_path / "idx"))
+        e = _sample_entry()
+        mgr.write_log(0, e)
+        got = mgr.get_log(0)
+        assert got == e
+        assert got.id == 0
+
+
+# ---------------------------------------------------------------------------
+# IndexDataManager versioned dirs
+# ---------------------------------------------------------------------------
+
+
+class TestIndexDataManager:
+    def test_versions(self, tmp_path):
+        root = str(tmp_path / "idx")
+        mgr = IndexDataManagerImpl(root)
+        assert mgr.get_latest_version_id() is None
+        os.makedirs(os.path.join(root, "v__=0"))
+        os.makedirs(os.path.join(root, "v__=3"))
+        os.makedirs(os.path.join(root, "not_a_version"))
+        assert mgr.get_latest_version_id() == 3
+        assert mgr.get_path(4).endswith("v__=4")
+        mgr.delete(3)
+        assert mgr.get_latest_version_id() == 0
+
+
+# ---------------------------------------------------------------------------
+# PathResolver
+# ---------------------------------------------------------------------------
+
+
+class TestPathResolver:
+    def test_default_and_configured_root(self, tmp_path):
+        conf = SessionConf()
+        r = PathResolver(conf, warehouse=str(tmp_path))
+        assert r.system_path() == os.path.join(str(tmp_path), "indexes")
+        conf.set(IndexConstants.INDEX_SYSTEM_PATH, "/custom/root")
+        assert r.system_path() == "/custom/root"
+
+    def test_case_insensitive_index_dir_match(self, tmp_path):
+        conf = SessionConf()
+        conf.set(IndexConstants.INDEX_SYSTEM_PATH, str(tmp_path / "indexes"))
+        os.makedirs(tmp_path / "indexes" / "MyIdx")
+        r = PathResolver(conf)
+        assert r.get_index_path("myidx") == str(tmp_path / "indexes" / "MyIdx")
+        assert r.get_index_path("other") == str(tmp_path / "indexes" / "other")
+
+
+# ---------------------------------------------------------------------------
+# Conf
+# ---------------------------------------------------------------------------
+
+
+class TestConf:
+    def test_typed_accessors(self):
+        from hyperspace_tpu import HyperspaceConf
+
+        conf = SessionConf()
+        h = HyperspaceConf(conf)
+        assert h.num_buckets == 200
+        assert h.cache_expiry_seconds == 300
+        assert not h.hybrid_scan_enabled
+        assert not h.lineage_enabled
+        conf.set(IndexConstants.INDEX_NUM_BUCKETS, 8)
+        conf.set(IndexConstants.INDEX_HYBRID_SCAN_ENABLED, "true")
+        assert h.num_buckets == 8
+        assert h.hybrid_scan_enabled
