@@ -1,0 +1,100 @@
+"""The generic lifecycle action: a two-phase commit on the operation log.
+
+Parity: reference `actions/Action.scala:34-104` — `run()` = validate → begin → op → end,
+where `begin()` writes log id base+1 with the transient state and `end()` writes id
+base+2 with the final state, then deletes and recreates the `latestStable` pointer.
+Telemetry events wrap the whole run; failures are logged and rethrown.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from ..exceptions import HyperspaceException
+from ..index.log_entry import LogEntry
+from ..index.log_manager import IndexLogManager
+from ..telemetry.event_logging import EventLogger, NoOpEventLogger
+from ..telemetry.events import HyperspaceEvent
+from . import states
+
+
+class Action:
+    """Subclasses define: transient_state, final_state, validate(), op(), log_entry(),
+    event() (reference's abstract members)."""
+
+    def __init__(self, log_manager: IndexLogManager, event_logger: Optional[EventLogger] = None):
+        self._log_manager = log_manager
+        self._event_logger = event_logger or NoOpEventLogger()
+        self._base_id: Optional[int] = None
+
+    # -- abstract -----------------------------------------------------------
+
+    @property
+    def transient_state(self) -> str:
+        raise NotImplementedError
+
+    @property
+    def final_state(self) -> str:
+        raise NotImplementedError
+
+    def validate(self) -> None:
+        """Raise HyperspaceException if the action is not allowed in the current state."""
+
+    def op(self) -> None:
+        """The action body (Spark-job analogue: the TPU build for create/refresh)."""
+
+    def log_entry(self) -> LogEntry:
+        """The metadata record to commit at end()."""
+        raise NotImplementedError
+
+    def event(self, message: str) -> HyperspaceEvent:
+        raise NotImplementedError
+
+    # -- the FSM ------------------------------------------------------------
+
+    @property
+    def base_id(self) -> int:
+        if self._base_id is None:
+            latest = self._log_manager.get_latest_id()
+            self._base_id = latest if latest is not None else -1
+        return self._base_id
+
+    def begin(self) -> None:
+        """Write id base+1 with the transient state (reference `Action.scala:48-54`).
+        An OCC conflict here means a concurrent writer won the race."""
+        entry = self.log_entry()
+        entry.state = self.transient_state
+        entry.timestamp = int(time.time() * 1000)
+        if not self._log_manager.write_log(self.base_id + 1, entry):
+            raise HyperspaceException(
+                "Another Index operation is in progress. Please retry."
+            )
+
+    def end(self) -> None:
+        """Write id base+2 with the final state and refresh `latestStable`
+        (reference `Action.scala:59-74`)."""
+        entry = self.log_entry()
+        entry.state = self.final_state
+        entry.timestamp = int(time.time() * 1000)
+        final_id = self.base_id + 2
+        if not self._log_manager.write_log(final_id, entry):
+            raise HyperspaceException(
+                "Another Index operation is in progress. Please retry."
+            )
+        if entry.state in states.STABLE_STATES:
+            self._log_manager.delete_latest_stable_log()
+            self._log_manager.create_latest_stable_log(final_id)
+
+    def run(self) -> None:
+        """validate → begin → op → end, wrapped in telemetry (reference `:83-101`)."""
+        self._event_logger.log_event(self.event("Operation Started."))
+        try:
+            self.validate()
+            self.begin()
+            self.op()
+            self.end()
+            self._event_logger.log_event(self.event("Operation Succeeded."))
+        except Exception as e:  # log + rethrow (reference behavior)
+            self._event_logger.log_event(self.event(f"Operation Failed: {e}"))
+            raise

@@ -1,0 +1,96 @@
+"""CreateAction: (none/DOESNOTEXIST) → CREATING → ACTIVE.
+
+Parity: reference `actions/CreateAction.scala:30-82` + `actions/CreateActionBase.scala`.
+Validation: the source plan must be a single linear relation, columns must resolve
+against the dataframe schema, and no live index of the same name may exist. The heavy
+`op()` (bucketed build) and log-entry derivation (signature + file inventory) are
+engine concerns, injected as an ``IndexerBuilder`` so the FSM is testable against fakes
+— the same seam the reference tests exploit with mocked log managers.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..exceptions import HyperspaceException
+from ..index.index_config import IndexConfig
+from ..index.log_entry import IndexLogEntry, LogEntry
+from ..index.log_manager import IndexLogManager
+from ..telemetry.events import AppInfo, CreateActionEvent, HyperspaceEvent
+from . import states
+from .action import Action
+
+
+class IndexerBuilder:
+    """Engine seam used by Create/Refresh: validates, writes index data, derives the
+    metadata record (reference `CreateActionBase.scala:33-203`)."""
+
+    def validate_source(self, df, index_config: IndexConfig) -> None:
+        """Raise if the plan is not indexable or columns don't resolve."""
+        raise NotImplementedError
+
+    def write(self, df, index_config: IndexConfig, index_data_path: str) -> None:
+        """The bucketed build: partition by indexed cols, sort, write index files."""
+        raise NotImplementedError
+
+    def derive_log_entry(
+        self, df, index_config: IndexConfig, index_path: str, index_data_path: str
+    ) -> IndexLogEntry:
+        """Build the IndexLogEntry: signature over source files, relations inventory,
+        index content tree (reference `getIndexLogEntry`, `CreateActionBase.scala:41-86`)."""
+        raise NotImplementedError
+
+    def reconstruct_df(self, relation):
+        """Rebuild a dataframe from a logged Relation (reference `RefreshAction.scala:44-56`)."""
+        raise NotImplementedError
+
+
+class CreateAction(Action):
+    def __init__(
+        self,
+        df,
+        index_config: IndexConfig,
+        builder: IndexerBuilder,
+        log_manager: IndexLogManager,
+        index_path: str,
+        index_data_path: str,
+        event_logger=None,
+    ):
+        super().__init__(log_manager, event_logger)
+        self._df = df
+        self._config = index_config
+        self._builder = builder
+        self._index_path = index_path
+        self._index_data_path = index_data_path
+        self._entry_cache: Optional[IndexLogEntry] = None
+
+    @property
+    def transient_state(self) -> str:
+        return states.CREATING
+
+    @property
+    def final_state(self) -> str:
+        return states.ACTIVE
+
+    def validate(self) -> None:
+        # Existing live index of the same name blocks creation
+        # (reference `CreateAction.scala:44-64`).
+        latest = self._log_manager.get_latest_log()
+        if latest is not None and latest.state != states.DOESNOTEXIST:
+            raise HyperspaceException(
+                f"Another Index with name {self._config.index_name} already exists."
+            )
+        self._builder.validate_source(self._df, self._config)
+
+    def op(self) -> None:
+        self._builder.write(self._df, self._config, self._index_data_path)
+
+    def log_entry(self) -> LogEntry:
+        if self._entry_cache is None:
+            self._entry_cache = self._builder.derive_log_entry(
+                self._df, self._config, self._index_path, self._index_data_path
+            )
+        return self._entry_cache
+
+    def event(self, message: str) -> HyperspaceEvent:
+        return CreateActionEvent(index_name=self._config.index_name, message=message)

@@ -1,0 +1,131 @@
+"""Metadata-only lifecycle actions: Delete, Restore, Vacuum, Cancel.
+
+Parity: reference `actions/DeleteAction.scala` (ACTIVE→DELETED, soft), `RestoreAction`
+(DELETED→ACTIVE), `VacuumAction.scala:38-52` (DELETED→DOESNOTEXIST, deletes every data
+version dir), `CancelAction.scala:28-76` (any transient → last stable state, rollback
+for crashed actions). None of these run a build job; vacuum touches the filesystem.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Optional
+
+from ..exceptions import HyperspaceException
+from ..index.data_manager import IndexDataManager
+from ..index.log_entry import LogEntry
+from ..index.log_manager import IndexLogManager
+from ..telemetry.events import (
+    CancelActionEvent,
+    DeleteActionEvent,
+    HyperspaceEvent,
+    RestoreActionEvent,
+    VacuumActionEvent,
+)
+from . import states
+from .action import Action
+
+
+class _EntryTransitionAction(Action):
+    """Base for actions that carry forward the previous log entry with a new state."""
+
+    def __init__(self, log_manager: IndexLogManager, event_logger=None):
+        super().__init__(log_manager, event_logger)
+        self._entry_cache: Optional[LogEntry] = None
+
+    def _previous_entry(self) -> LogEntry:
+        prev = self._log_manager.get_log(self.base_id)
+        if prev is None:
+            raise HyperspaceException("Index does not exist.")
+        return prev
+
+    def log_entry(self) -> LogEntry:
+        if self._entry_cache is None:
+            self._entry_cache = copy.deepcopy(self._previous_entry())
+        return self._entry_cache
+
+    @property
+    def index_name(self) -> str:
+        try:
+            return self._previous_entry().name  # type: ignore[attr-defined]
+        except Exception:
+            return ""
+
+
+class DeleteAction(_EntryTransitionAction):
+    transient_state = states.DELETING
+    final_state = states.DELETED
+
+    def validate(self) -> None:
+        if self._previous_entry().state != states.ACTIVE:
+            raise HyperspaceException(
+                f"Delete is only supported in {states.ACTIVE} state."
+            )
+
+    def event(self, message: str) -> HyperspaceEvent:
+        return DeleteActionEvent(index_name=self.index_name, message=message)
+
+
+class RestoreAction(_EntryTransitionAction):
+    transient_state = states.RESTORING
+    final_state = states.ACTIVE
+
+    def validate(self) -> None:
+        if self._previous_entry().state != states.DELETED:
+            raise HyperspaceException(
+                f"Restore is only supported in {states.DELETED} state."
+            )
+
+    def event(self, message: str) -> HyperspaceEvent:
+        return RestoreActionEvent(index_name=self.index_name, message=message)
+
+
+class VacuumAction(_EntryTransitionAction):
+    """Hard delete: removes every data version directory (reference `:46-52`)."""
+
+    transient_state = states.VACUUMING
+    final_state = states.DOESNOTEXIST
+
+    def __init__(self, data_manager: IndexDataManager, log_manager, event_logger=None):
+        super().__init__(log_manager, event_logger)
+        self._data_manager = data_manager
+
+    def validate(self) -> None:
+        if self._previous_entry().state != states.DELETED:
+            raise HyperspaceException(
+                f"Vacuum is only supported in {states.DELETED} state."
+            )
+
+    def op(self) -> None:
+        latest = self._data_manager.get_latest_version_id()
+        if latest is not None:
+            for vid in range(latest + 1):
+                self._data_manager.delete(vid)
+
+    def event(self, message: str) -> HyperspaceEvent:
+        return VacuumActionEvent(index_name=self.index_name, message=message)
+
+
+class CancelAction(_EntryTransitionAction):
+    """Roll a stuck transient state back to the last stable one
+    (reference `CancelAction.scala:28-76`): VACUUMING → DOESNOTEXIST; no stable log at
+    all → DOESNOTEXIST; otherwise the latest stable entry's state."""
+
+    transient_state = states.CANCELLING
+
+    @property
+    def final_state(self) -> str:
+        prev = self._previous_entry()
+        if prev.state == states.VACUUMING:
+            return states.DOESNOTEXIST
+        stable = self._log_manager.get_latest_stable_log()
+        return stable.state if stable is not None else states.DOESNOTEXIST
+
+    def validate(self) -> None:
+        if self._previous_entry().state in states.STABLE_STATES:
+            raise HyperspaceException(
+                "Cancel is only supported when index is in transient states."
+            )
+
+    def event(self, message: str) -> HyperspaceEvent:
+        return CancelActionEvent(index_name=self.index_name, message=message)

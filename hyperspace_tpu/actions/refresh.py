@@ -1,0 +1,95 @@
+"""RefreshAction: ACTIVE → REFRESHING → ACTIVE (full rebuild).
+
+Parity: reference `actions/RefreshAction.scala:31-86` — reconstructs the source
+dataframe from the previous log entry's `Relation` (root paths / schema / format /
+options) and rewrites the index into the next version directory. The new log entry
+carries a fresh signature over the current source files.
+
+Extension (north-star, absent from the v0 reference): ``mode="incremental"`` indexes
+only files appended since the recorded inventory and ``optimizeIndex`` compacts — see
+`actions/optimize.py`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..exceptions import HyperspaceException
+from ..index.log_entry import IndexLogEntry, LogEntry
+from ..telemetry.events import HyperspaceEvent, RefreshActionEvent
+from . import states
+from .action import Action
+from .create import IndexerBuilder
+
+
+class RefreshAction(Action):
+    def __init__(
+        self,
+        builder: IndexerBuilder,
+        log_manager,
+        index_path: str,
+        index_data_path: str,
+        event_logger=None,
+    ):
+        super().__init__(log_manager, event_logger)
+        self._builder = builder
+        self._index_path = index_path
+        self._index_data_path = index_data_path
+        self._entry_cache: Optional[IndexLogEntry] = None
+        self._prev: Optional[IndexLogEntry] = None
+        self._df = None
+
+    def _previous_entry(self) -> IndexLogEntry:
+        if self._prev is None:
+            prev = self._log_manager.get_log(self.base_id)
+            if prev is None:
+                raise HyperspaceException("Refresh is only supported on an existing index.")
+            self._prev = prev
+        return self._prev
+
+    def _source_df(self):
+        if self._df is None:
+            prev = self._previous_entry()
+            relations = prev.relations
+            if len(relations) != 1:
+                raise HyperspaceException("Refresh supports indexes over a single relation.")
+            self._df = self._builder.reconstruct_df(relations[0])
+        return self._df
+
+    @property
+    def transient_state(self) -> str:
+        return states.REFRESHING
+
+    @property
+    def final_state(self) -> str:
+        return states.ACTIVE
+
+    def validate(self) -> None:
+        prev = self._previous_entry()
+        if prev.state != states.ACTIVE:
+            raise HyperspaceException(
+                f"Refresh is only supported in {states.ACTIVE} state. "
+                f"Current state: {prev.state}."
+            )
+
+    def op(self) -> None:
+        prev = self._previous_entry()
+        from ..index.index_config import IndexConfig
+
+        config = IndexConfig(prev.name, prev.indexed_columns, prev.included_columns)
+        self._builder.write(self._source_df(), config, self._index_data_path)
+
+    def log_entry(self) -> LogEntry:
+        if self._entry_cache is None:
+            prev = self._previous_entry()
+            from ..index.index_config import IndexConfig
+
+            config = IndexConfig(prev.name, prev.indexed_columns, prev.included_columns)
+            self._entry_cache = self._builder.derive_log_entry(
+                self._source_df(), config, self._index_path, self._index_data_path
+            )
+        return self._entry_cache
+
+    def event(self, message: str) -> HyperspaceEvent:
+        name = self._prev.name if self._prev else ""
+        return RefreshActionEvent(index_name=name, message=message)

@@ -320,11 +320,17 @@ class LogEntry:
     def from_json(text_or_dict) -> "LogEntry":
         """Polymorphic decode keyed on the version/kind fields
         (reference `LogEntry.fromJson`)."""
-        import json as _json
+        from ..util import json_utils
 
-        d = text_or_dict if isinstance(text_or_dict, dict) else _json.loads(text_or_dict)
-        entry = IndexLogEntry.from_json(d)
-        return entry
+        d = text_or_dict if isinstance(text_or_dict, dict) else json_utils.from_json(text_or_dict)
+        version = d.get("version")
+        if version != LogEntry.VERSION:
+            raise ValueError(f"Unsupported log entry version: {version!r}")
+        kind = d.get("kind", "CoveringIndex")
+        decoder = _ENTRY_DECODERS.get(kind)
+        if decoder is None:
+            raise ValueError(f"Unsupported log entry kind: {kind!r}")
+        return decoder(d)
 
 
 class IndexLogEntry(LogEntry):
@@ -434,3 +440,14 @@ class IndexLogEntry(LogEntry):
 
     def __hash__(self):
         return hash(self._eq_key())
+
+
+# Registry for polymorphic LogEntry decode; extension index kinds (e.g. DataSkipping)
+# register themselves here.
+_ENTRY_DECODERS = {
+    "CoveringIndex": IndexLogEntry.from_json,
+}
+
+
+def register_entry_kind(kind: str, decoder) -> None:
+    _ENTRY_DECODERS[kind] = decoder

@@ -97,8 +97,14 @@ class IndexLogManagerImpl(IndexLogManager):
         entry = self.get_log(log_id)
         if entry is None or entry.state not in STABLE_STATES:
             return False
+        # The pointer is an overwritable copy (reference uses FileUtil.copy with
+        # overwrite, IndexLogManager.scala:113-130) — unlike numbered entries it is
+        # NOT an OCC participant, so replace any existing pointer.
+        path = self._path_for(LATEST_STABLE)
+        if self._fs.exists(path):
+            self._fs.delete(path)
         text = json_utils.to_json(entry.to_json())
-        return self._fs.atomic_write_text(self._path_for(LATEST_STABLE), text)
+        return self._fs.atomic_write_text(path, text)
 
     def delete_latest_stable_log(self) -> bool:
         path = self._path_for(LATEST_STABLE)
@@ -108,7 +114,14 @@ class IndexLogManagerImpl(IndexLogManager):
         return True
 
     def write_log(self, log_id: int, entry: LogEntry) -> bool:
-        """OCC point: fails if ``log_id`` already exists (reference :146-162)."""
-        entry.id = log_id
-        text = json_utils.to_json(entry.to_json())
-        return self._fs.atomic_write_text(self._path_for(log_id), text)
+        """OCC point: fails if ``log_id`` already exists (reference :146-162).
+
+        The caller's entry is not mutated on a lost race: the id is stamped onto the
+        serialized record, and written back to the entry only after the commit wins."""
+        d = entry.to_json()
+        d["id"] = log_id
+        text = json_utils.to_json(d)
+        ok = self._fs.atomic_write_text(self._path_for(log_id), text)
+        if ok:
+            entry.id = log_id
+        return ok

@@ -171,8 +171,8 @@ class LocalFileSystem(FileSystem):
             return True
         except FileExistsError:
             return False
-        except OSError:
-            return False
+        # Any other OSError (no hard-link support, EACCES, ENOSPC) is a real IO
+        # failure, not an OCC conflict — let it propagate.
 
     def read_bytes(self, path: str) -> bytes:
         with open(path, "rb") as f:
@@ -226,22 +226,20 @@ class InMemoryFileSystem(FileSystem):
     def list_status(self, path: str) -> List[FileStatus]:
         p = self._norm(path)
         prefix = p + os.sep
-        children = set()
-        for f in list(self._files) + list(self._dirs):
-            if f.startswith(prefix):
-                rest = f[len(prefix):]
-                children.add(rest.split(os.sep)[0])
-        out = []
-        for c in sorted(children):
-            cp = os.path.join(p, c)
-            out.append(self.get_status(cp))
-        return out
+        with self._lock:
+            children = set()
+            for f in list(self._files) + list(self._dirs):
+                if f.startswith(prefix):
+                    rest = f[len(prefix):]
+                    children.add(rest.split(os.sep)[0])
+            return [self.get_status(os.path.join(p, c)) for c in sorted(children)]
 
     def get_status(self, path: str) -> FileStatus:
         p = self._norm(path)
-        if p in self._files:
-            return FileStatus(p, len(self._files[p]), self._mtimes.get(p, 0), False)
-        return FileStatus(p, 0, 0, True)
+        with self._lock:
+            if p in self._files:
+                return FileStatus(p, len(self._files[p]), self._mtimes.get(p, 0), False)
+            return FileStatus(p, 0, 0, True)
 
     def delete(self, path: str, recursive: bool = False) -> None:
         p = self._norm(path)

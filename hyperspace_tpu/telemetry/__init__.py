@@ -1,0 +1,19 @@
+from .event_logging import (  # noqa: F401
+    EventLogger,
+    EventLoggerFactory,
+    NoOpEventLogger,
+    RecordingEventLogger,
+)
+from .events import (  # noqa: F401
+    AppInfo,
+    CancelActionEvent,
+    CreateActionEvent,
+    DeleteActionEvent,
+    HyperspaceEvent,
+    HyperspaceIndexCRUDEvent,
+    HyperspaceIndexUsageEvent,
+    OptimizeActionEvent,
+    RefreshActionEvent,
+    RestoreActionEvent,
+    VacuumActionEvent,
+)

@@ -1,0 +1,60 @@
+"""Pluggable event logging.
+
+Parity: reference `telemetry/HyperspaceEventLogging.scala:30-68` — a mixin whose
+singleton `EventLogger` is loaded reflectively from conf key
+`spark.hyperspace.eventLoggerClass` (default no-op). Here the logger class is resolved
+by dotted path from `hyperspace.eventLoggerClass`.
+"""
+
+from __future__ import annotations
+
+import importlib
+import threading
+from typing import List, Optional
+
+from .events import HyperspaceEvent
+
+
+class EventLogger:
+    def log_event(self, event: HyperspaceEvent) -> None:
+        raise NotImplementedError
+
+
+class NoOpEventLogger(EventLogger):
+    def log_event(self, event: HyperspaceEvent) -> None:
+        pass
+
+
+class RecordingEventLogger(EventLogger):
+    """Keeps events in memory — used by tests and the explain subsystem."""
+
+    def __init__(self):
+        self.events: List[HyperspaceEvent] = []
+
+    def log_event(self, event: HyperspaceEvent) -> None:
+        self.events.append(event)
+
+
+class EventLoggerFactory:
+    """Caches one logger instance per class name (reference's singleton wrapper)."""
+
+    _lock = threading.Lock()
+    _cache = {}
+
+    @classmethod
+    def get_logger(cls, class_name: Optional[str]) -> EventLogger:
+        key = class_name or "noop"
+        with cls._lock:
+            if key not in cls._cache:
+                if class_name is None:
+                    cls._cache[key] = NoOpEventLogger()
+                else:
+                    module_name, _, attr = class_name.rpartition(".")
+                    mod = importlib.import_module(module_name)
+                    cls._cache[key] = getattr(mod, attr)()
+            return cls._cache[key]
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._lock:
+            cls._cache.clear()

@@ -1,0 +1,75 @@
+"""Telemetry event model.
+
+Parity: reference `telemetry/HyperspaceEvent.scala:28-123` — `AppInfo`, a base event,
+one event per lifecycle action, and `HyperspaceIndexUsageEvent` emitted when a rewrite
+rule applies an index.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass
+class AppInfo:
+    """Originating application info (reference `AppInfo`)."""
+
+    sparkUser: str = ""
+    appId: str = ""
+    appName: str = ""
+
+
+@dataclass
+class HyperspaceEvent:
+    app_info: AppInfo = field(default_factory=AppInfo)
+    message: str = ""
+    timestamp: float = field(default_factory=time.time)
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+
+@dataclass
+class HyperspaceIndexCRUDEvent(HyperspaceEvent):
+    index_name: str = ""
+
+
+class CreateActionEvent(HyperspaceIndexCRUDEvent):
+    pass
+
+
+class DeleteActionEvent(HyperspaceIndexCRUDEvent):
+    pass
+
+
+class RestoreActionEvent(HyperspaceIndexCRUDEvent):
+    pass
+
+
+class VacuumActionEvent(HyperspaceIndexCRUDEvent):
+    pass
+
+
+class RefreshActionEvent(HyperspaceIndexCRUDEvent):
+    pass
+
+
+class OptimizeActionEvent(HyperspaceIndexCRUDEvent):
+    """Extension event: optimizeIndex compaction (north-star; no v0 reference analogue)."""
+
+
+class CancelActionEvent(HyperspaceIndexCRUDEvent):
+    pass
+
+
+@dataclass
+class HyperspaceIndexUsageEvent(HyperspaceEvent):
+    """Emitted when a rewrite rule transforms a plan to use indexes
+    (reference `HyperspaceIndexUsageEvent`)."""
+
+    index_names: List[str] = field(default_factory=list)
+    plan_before: str = ""
+    plan_after: str = ""

@@ -21,6 +21,6 @@ def is_data_path(name: str) -> bool:
     data paths even when they begin with ``_`` (reference `PathUtils.DataPathFilter`).
     """
     base = os.path.basename(name.rstrip("/"))
-    if "=" in base:
-        return True
-    return not (base.startswith("_") or base.startswith("."))
+    # The '=' exception applies only to '_'-prefixed names; '.'-prefixed is always
+    # metadata (reference PathUtils.scala:33-38).
+    return not ((base.startswith("_") and "=" not in base) or base.startswith("."))

@@ -1,0 +1,288 @@
+"""Action FSM tests against a fake log backend.
+
+Mirrors reference tier 2 (SURVEY §4): `ActionTest` asserts the exact
+writeLog(base+1, transient) / writeLog(base+2, final) / deleteLatestStableLog /
+createLatestStableLog sequence against a mocked IndexLogManager; per-action tests cover
+validate() state checks and op() effects.
+"""
+
+import copy
+
+import pytest
+
+from hyperspace_tpu import HyperspaceException, IndexConfig
+from hyperspace_tpu.actions import states
+from hyperspace_tpu.actions.action import Action
+from hyperspace_tpu.actions.create import CreateAction, IndexerBuilder
+from hyperspace_tpu.actions.lifecycle import (
+    CancelAction,
+    DeleteAction,
+    RestoreAction,
+    VacuumAction,
+)
+from hyperspace_tpu.actions.refresh import RefreshAction
+from hyperspace_tpu.index.log_entry import (
+    Content,
+    CoveringIndexProperties,
+    Directory,
+    FileInfo,
+    IndexLogEntry,
+    LogicalPlanFingerprint,
+    Relation,
+    Signature,
+    Source,
+    SourcePlanProperties,
+)
+from hyperspace_tpu.index.log_manager import IndexLogManager
+from hyperspace_tpu.telemetry import RecordingEventLogger
+
+
+def make_entry(name="idx", state=states.ACTIVE, sig="s1"):
+    e = IndexLogEntry(
+        name,
+        CoveringIndexProperties(["a"], ["b"], "{}", 4),
+        Content(Directory("/idx/v__=0", files=[FileInfo("f", 1, 1)])),
+        Source(
+            SourcePlanProperties(
+                [Relation(["/src"], Content(Directory("/src")), "{}", "parquet")],
+                fingerprint=LogicalPlanFingerprint(signatures=[Signature("p", sig)]),
+            )
+        ),
+    )
+    e.state = state
+    return e
+
+
+class FakeLogManager(IndexLogManager):
+    """In-memory log manager recording the call sequence (the reference's Mockito mock)."""
+
+    def __init__(self, entries=None):
+        self.entries = dict(entries or {})
+        self.calls = []
+        self.stable_id = None
+
+    def get_log(self, log_id):
+        return copy.deepcopy(self.entries.get(log_id))
+
+    def get_latest_id(self):
+        return max(self.entries) if self.entries else None
+
+    def get_latest_stable_log(self):
+        if self.stable_id is not None:
+            return copy.deepcopy(self.entries.get(self.stable_id))
+        for i in sorted(self.entries, reverse=True):
+            if self.entries[i].state in states.STABLE_STATES:
+                return copy.deepcopy(self.entries[i])
+        return None
+
+    def create_latest_stable_log(self, log_id):
+        self.calls.append(("createLatestStable", log_id))
+        self.stable_id = log_id
+        return True
+
+    def delete_latest_stable_log(self):
+        self.calls.append(("deleteLatestStable",))
+        self.stable_id = None
+        return True
+
+    def write_log(self, log_id, entry):
+        self.calls.append(("writeLog", log_id, entry.state))
+        if log_id in self.entries:
+            return False
+        self.entries[log_id] = copy.deepcopy(entry)
+        return True
+
+
+class FakeBuilder(IndexerBuilder):
+    def __init__(self, entry=None):
+        self.writes = []
+        self.validated = []
+        self._entry = entry or make_entry(state="")
+
+    def validate_source(self, df, index_config):
+        self.validated.append((df, index_config))
+
+    def write(self, df, index_config, index_data_path):
+        self.writes.append((df, index_config, index_data_path))
+
+    def derive_log_entry(self, df, index_config, index_path, index_data_path):
+        return copy.deepcopy(self._entry)
+
+    def reconstruct_df(self, relation):
+        return ("df-from", tuple(relation.root_paths))
+
+
+class TestActionFSM:
+    def test_create_sequence_on_empty_log(self):
+        """Exact writeLog(0, CREATING) / writeLog(1, ACTIVE) / delete+createLatestStable(1)
+        sequence (reference ActionTest.scala:64-84)."""
+        mgr = FakeLogManager()
+        events = RecordingEventLogger()
+        action = CreateAction(
+            "df", IndexConfig("idx", ["a"], ["b"]), FakeBuilder(), mgr, "/idx", "/idx/v__=0",
+            event_logger=events,
+        )
+        action.run()
+        assert mgr.calls == [
+            ("writeLog", 0, states.CREATING),
+            ("writeLog", 1, states.ACTIVE),
+            ("deleteLatestStable",),
+            ("createLatestStable", 1),
+        ]
+        assert [e.message for e in events.events] == [
+            "Operation Started.",
+            "Operation Succeeded.",
+        ]
+
+    def test_occ_conflict_raises(self):
+        mgr = FakeLogManager({0: make_entry(state=states.CREATING)})
+        mgr.entries[1] = make_entry(state=states.CREATING)  # simulate concurrent begin
+        action = DeleteAction(mgr)
+        # base id = 1, begin writes 2, ok; but let's make conflict: prefill 2 and 3.
+        mgr.entries[2] = make_entry(state=states.DELETING)
+        with pytest.raises(HyperspaceException, match="in progress"):
+            action._base_id = 1
+            action.begin()
+
+    def test_failed_op_leaves_transient_state_and_logs_event(self):
+        class FailingBuilder(FakeBuilder):
+            def write(self, df, index_config, index_data_path):
+                raise RuntimeError("boom")
+
+        mgr = FakeLogManager()
+        events = RecordingEventLogger()
+        action = CreateAction(
+            "df", IndexConfig("idx", ["a"]), FailingBuilder(), mgr, "/i", "/i/v__=0",
+            event_logger=events,
+        )
+        with pytest.raises(RuntimeError):
+            action.run()
+        # The transient entry remains; no final entry was written (crash-consistent).
+        assert mgr.entries[0].state == states.CREATING
+        assert 1 not in mgr.entries
+        assert "Operation Failed" in events.events[-1].message
+
+
+class TestCreateAction:
+    def test_rejects_existing_live_index(self):
+        mgr = FakeLogManager({0: make_entry(state=states.ACTIVE)})
+        action = CreateAction(
+            "df", IndexConfig("idx", ["a"]), FakeBuilder(), mgr, "/i", "/i/v__=1"
+        )
+        with pytest.raises(HyperspaceException, match="already exists"):
+            action.validate()
+
+    def test_allows_create_over_doesnotexist(self):
+        mgr = FakeLogManager({0: make_entry(state=states.DOESNOTEXIST)})
+        action = CreateAction(
+            "df", IndexConfig("idx", ["a"]), FakeBuilder(), mgr, "/i", "/i/v__=1"
+        )
+        action.validate()  # no raise
+
+
+class TestRefreshAction:
+    def test_full_rebuild_from_logged_relation(self):
+        mgr = FakeLogManager({0: make_entry(state=states.ACTIVE)})
+        builder = FakeBuilder(make_entry(state=""))
+        action = RefreshAction(builder, mgr, "/i", "/i/v__=1")
+        action.run()
+        # df reconstructed from the logged relation's root paths
+        assert builder.writes[0][0] == ("df-from", ("/src",))
+        assert builder.writes[0][2] == "/i/v__=1"
+        assert mgr.entries[2].state == states.ACTIVE
+
+    def test_requires_active(self):
+        mgr = FakeLogManager({0: make_entry(state=states.DELETED)})
+        action = RefreshAction(FakeBuilder(), mgr, "/i", "/i/v__=1")
+        with pytest.raises(HyperspaceException, match="ACTIVE"):
+            action.validate()
+
+
+class TestDeleteRestore:
+    def test_delete_soft(self):
+        mgr = FakeLogManager({0: make_entry(state=states.ACTIVE)})
+        DeleteAction(mgr).run()
+        assert mgr.entries[2].state == states.DELETED
+
+    def test_delete_requires_active(self):
+        mgr = FakeLogManager({0: make_entry(state=states.DELETED)})
+        with pytest.raises(HyperspaceException):
+            DeleteAction(mgr).run()
+
+    def test_restore(self):
+        mgr = FakeLogManager({0: make_entry(state=states.DELETED)})
+        RestoreAction(mgr).run()
+        assert mgr.entries[2].state == states.ACTIVE
+
+    def test_restore_requires_deleted(self):
+        mgr = FakeLogManager({0: make_entry(state=states.ACTIVE)})
+        with pytest.raises(HyperspaceException):
+            RestoreAction(mgr).run()
+
+
+class FakeDataManager:
+    def __init__(self, latest=2):
+        self.latest = latest
+        self.deleted = []
+
+    def get_latest_version_id(self):
+        return self.latest
+
+    def get_path(self, vid):
+        return f"/i/v__={vid}"
+
+    def delete(self, vid):
+        self.deleted.append(vid)
+
+
+class TestVacuumAction:
+    def test_deletes_all_versions(self):
+        mgr = FakeLogManager({0: make_entry(state=states.DELETED)})
+        dm = FakeDataManager(latest=2)
+        VacuumAction(dm, mgr).run()
+        assert dm.deleted == [0, 1, 2]
+        assert mgr.entries[2].state == states.DOESNOTEXIST
+
+    def test_requires_deleted(self):
+        mgr = FakeLogManager({0: make_entry(state=states.ACTIVE)})
+        with pytest.raises(HyperspaceException):
+            VacuumAction(FakeDataManager(), mgr).run()
+
+
+class TestCancelAction:
+    def test_rolls_back_to_last_stable(self):
+        mgr = FakeLogManager(
+            {0: make_entry(state=states.ACTIVE), 1: make_entry(state=states.REFRESHING)}
+        )
+        CancelAction(mgr).run()
+        assert mgr.entries[3].state == states.ACTIVE  # last stable state restored
+
+    def test_vacuuming_cancels_to_doesnotexist(self):
+        mgr = FakeLogManager(
+            {
+                0: make_entry(state=states.DELETED),
+                1: make_entry(state=states.VACUUMING),
+            }
+        )
+        CancelAction(mgr).run()
+        assert mgr.entries[3].state == states.DOESNOTEXIST
+
+    def test_rejects_stable_state(self):
+        mgr = FakeLogManager({0: make_entry(state=states.ACTIVE)})
+        with pytest.raises(HyperspaceException, match="transient"):
+            CancelAction(mgr).run()
+
+
+class TestEventLoggerFactory:
+    def test_reflective_load_and_noop_default(self):
+        from hyperspace_tpu.telemetry import EventLoggerFactory, NoOpEventLogger, RecordingEventLogger
+
+        EventLoggerFactory.reset()
+        assert isinstance(EventLoggerFactory.get_logger(None), NoOpEventLogger)
+        logger = EventLoggerFactory.get_logger(
+            "hyperspace_tpu.telemetry.event_logging.RecordingEventLogger"
+        )
+        assert isinstance(logger, RecordingEventLogger)
+        assert EventLoggerFactory.get_logger(
+            "hyperspace_tpu.telemetry.event_logging.RecordingEventLogger"
+        ) is logger  # singleton per class
