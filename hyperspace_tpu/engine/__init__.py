@@ -1,0 +1,24 @@
+"""TPU-native columnar query engine (the Spark replacement layer).
+
+x64 is enabled at import: lakehouse data is routinely int64 (ids, timestamps), and the
+engine's join keys are 64-bit hashes. XLA:TPU lowers s64 vector ops; f64 columns are
+computed in f64 on CPU and may be downcast on TPU backends without f64 support.
+"""
+
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
+from .expr import BinaryOp, Col, Expr, IsIn, Lit, Not, col, lit  # noqa: F401,E402
+from .logical import (  # noqa: F401,E402
+    BucketSpec,
+    FilterNode,
+    JoinNode,
+    LogicalPlan,
+    ProjectNode,
+    ScanNode,
+    SourceRelation,
+)
+from .schema import Field, Schema  # noqa: F401,E402
+from .session import DataFrame, DataFrameReader, HyperspaceSession  # noqa: F401,E402
+from .table import Column, Table  # noqa: F401,E402

@@ -1,0 +1,167 @@
+"""Expression evaluation on device arrays.
+
+Filters and join-key computations run as jnp vector ops (VPU work under XLA). String
+semantics ride the sorted-dictionary encoding: literal comparisons are translated to
+code-space integer comparisons on the host (one dictionary binary-search per literal),
+then evaluated on device — no string processing ever reaches the TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..exceptions import HyperspaceException
+from .expr import BinaryOp, Col, Expr, IsIn, Lit, Not
+from .table import Column, Table, align_dictionaries
+
+
+class _Val:
+    """Evaluation result: numeric device array, string codes + dictionary, or literal."""
+
+    __slots__ = ("kind", "arr", "dictionary", "value")
+
+    def __init__(self, kind, arr=None, dictionary=None, value=None):
+        self.kind = kind  # "num" | "str" | "lit"
+        self.arr = arr
+        self.dictionary = dictionary
+        self.value = value
+
+
+def _device(table: Table, devcols: Dict[str, jnp.ndarray], name: str):
+    if name not in devcols:
+        devcols[name] = jnp.asarray(table.column(name).data)
+    return devcols[name]
+
+
+def _str_lit_compare(op: str, codes, dictionary: np.ndarray, lit: str):
+    """Translate a string-vs-literal comparison into code space (sorted dictionary ⇒
+    codes are order-preserving)."""
+    left_cut = int(np.searchsorted(dictionary, lit, side="left"))
+    present = left_cut < len(dictionary) and dictionary[left_cut] == lit
+    if op == "==":
+        if not present:
+            return jnp.zeros(codes.shape, dtype=bool)
+        return codes == left_cut
+    if op == "!=":
+        if not present:
+            return jnp.ones(codes.shape, dtype=bool)
+        return codes != left_cut
+    if op == "<":
+        return codes < left_cut
+    if op == ">=":
+        return codes >= left_cut
+    right_cut = int(np.searchsorted(dictionary, lit, side="right"))
+    if op == "<=":
+        return codes < right_cut
+    if op == ">":
+        return codes >= right_cut
+    raise HyperspaceException(f"Unsupported string comparison: {op}")
+
+
+def evaluate(expr: Expr, table: Table, devcols: Dict[str, jnp.ndarray]) -> _Val:
+    if isinstance(expr, Col):
+        col = table.column(expr.name)
+        arr = _device(table, devcols, expr.name)
+        if col.is_string:
+            return _Val("str", arr, col.dictionary)
+        return _Val("num", arr)
+
+    if isinstance(expr, Lit):
+        return _Val("lit", value=expr.value)
+
+    if isinstance(expr, Not):
+        v = evaluate(expr.child, table, devcols)
+        if v.kind != "num":
+            raise HyperspaceException("NOT requires a boolean operand")
+        return _Val("num", jnp.logical_not(v.arr))
+
+    if isinstance(expr, IsIn):
+        v = evaluate(expr.child, table, devcols)
+        if v.kind == "str":
+            wanted = [str(x) for x in expr.values]
+            positions = np.searchsorted(v.dictionary, wanted)
+            valid = [
+                int(c)
+                for c, x in zip(positions, wanted)
+                if c < len(v.dictionary) and v.dictionary[c] == x
+            ]
+            if not valid:
+                return _Val("num", jnp.zeros(v.arr.shape, dtype=bool))
+            return _Val("num", jnp.isin(v.arr, jnp.asarray(np.asarray(valid, np.int32))))
+        return _Val("num", jnp.isin(v.arr, jnp.asarray(np.asarray(expr.values))))
+
+    if isinstance(expr, BinaryOp):
+        l = evaluate(expr.left, table, devcols)
+        r = evaluate(expr.right, table, devcols)
+        op = expr.op
+
+        if op in BinaryOp.BOOLEAN:
+            if l.kind != "num" or r.kind != "num":
+                raise HyperspaceException(f"'{op}' requires boolean operands")
+            f = jnp.logical_and if op == "and" else jnp.logical_or
+            return _Val("num", f(l.arr, r.arr))
+
+        # String comparisons.
+        if l.kind == "str" or r.kind == "str":
+            if op not in BinaryOp.COMPARISONS:
+                raise HyperspaceException(f"Arithmetic on strings is not supported: {op}")
+            if l.kind == "str" and r.kind == "lit":
+                return _Val("num", _str_lit_compare(op, l.arr, l.dictionary, str(r.value)))
+            if r.kind == "str" and l.kind == "lit":
+                flipped = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "==": "==", "!=": "!="}
+                return _Val(
+                    "num", _str_lit_compare(flipped[op], r.arr, r.dictionary, str(l.value))
+                )
+            if l.kind == "str" and r.kind == "str":
+                # Cross-column compare: align over the union dictionary (host), then
+                # integer-compare codes on device.
+                lc = Column("string", np.asarray(l.arr, dtype=np.int32), l.dictionary)
+                rc = Column("string", np.asarray(r.arr, dtype=np.int32), r.dictionary)
+                la, ra = align_dictionaries(lc, rc)
+                return _Val(
+                    "num",
+                    _compare(op, jnp.asarray(la.data), jnp.asarray(ra.data)),
+                )
+            raise HyperspaceException("Cannot compare string with non-string")
+
+        lv = l.arr if l.kind == "num" else jnp.asarray(l.value)
+        rv = r.arr if r.kind == "num" else jnp.asarray(r.value)
+        if op in BinaryOp.COMPARISONS:
+            return _Val("num", _compare(op, lv, rv))
+        if op == "+":
+            return _Val("num", lv + rv)
+        if op == "-":
+            return _Val("num", lv - rv)
+        if op == "*":
+            return _Val("num", lv * rv)
+        if op == "/":
+            return _Val("num", lv / rv)
+
+    raise HyperspaceException(f"Cannot evaluate expression: {expr!r}")
+
+
+def _compare(op: str, a, b):
+    if op == "==":
+        return a == b
+    if op == "!=":
+        return a != b
+    if op == "<":
+        return a < b
+    if op == "<=":
+        return a <= b
+    if op == ">":
+        return a > b
+    if op == ">=":
+        return a >= b
+    raise HyperspaceException(op)
+
+
+def evaluate_predicate(expr: Expr, table: Table) -> jnp.ndarray:
+    """Evaluate a boolean expression over a table → device mask."""
+    v = evaluate(expr, table, {})
+    if v.kind != "num" or v.arr.dtype != jnp.bool_:
+        raise HyperspaceException(f"Not a boolean predicate: {expr!r}")
+    return v.arr

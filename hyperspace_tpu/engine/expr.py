@@ -1,0 +1,172 @@
+"""Expression IR for filters, projections, and join conditions.
+
+The engine analogue of Catalyst expressions — just enough surface for the reference's
+rule semantics: column refs, literals, comparisons, boolean algebra, arithmetic. The
+join rule needs to pattern-match equi-join CNF (`EqualTo`/`And` only,
+`JoinIndexRule.scala:188-194`), so the tree shape is kept explicit.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Set
+
+
+class Expr:
+    def references(self) -> Set[str]:
+        """All column names referenced by this expression."""
+        out: Set[str] = set()
+        self._collect_refs(out)
+        return out
+
+    def _collect_refs(self, out: Set[str]) -> None:
+        for c in self.children():
+            c._collect_refs(out)
+
+    def children(self) -> Sequence["Expr"]:
+        return ()
+
+    # -- operator sugar -----------------------------------------------------
+
+    def __eq__(self, other):  # type: ignore[override]
+        return BinaryOp("==", self, _lit(other))
+
+    def __ne__(self, other):  # type: ignore[override]
+        return BinaryOp("!=", self, _lit(other))
+
+    def __lt__(self, other):
+        return BinaryOp("<", self, _lit(other))
+
+    def __le__(self, other):
+        return BinaryOp("<=", self, _lit(other))
+
+    def __gt__(self, other):
+        return BinaryOp(">", self, _lit(other))
+
+    def __ge__(self, other):
+        return BinaryOp(">=", self, _lit(other))
+
+    def __and__(self, other):
+        return BinaryOp("and", self, _lit(other))
+
+    def __or__(self, other):
+        return BinaryOp("or", self, _lit(other))
+
+    def __invert__(self):
+        return Not(self)
+
+    def __add__(self, other):
+        return BinaryOp("+", self, _lit(other))
+
+    def __sub__(self, other):
+        return BinaryOp("-", self, _lit(other))
+
+    def __mul__(self, other):
+        return BinaryOp("*", self, _lit(other))
+
+    def __hash__(self):
+        return hash(repr(self))
+
+    def semantic_equals(self, other: "Expr") -> bool:
+        return repr(self) == repr(other)
+
+
+class Col(Expr):
+    def __init__(self, name: str):
+        self.name = name
+
+    def _collect_refs(self, out: Set[str]) -> None:
+        out.add(self.name)
+
+    def __repr__(self):
+        return f"col({self.name})"
+
+
+class Lit(Expr):
+    def __init__(self, value):
+        self.value = value
+
+    def __repr__(self):
+        return f"lit({self.value!r})"
+
+
+class BinaryOp(Expr):
+    COMPARISONS = ("==", "!=", "<", "<=", ">", ">=")
+    BOOLEAN = ("and", "or")
+    ARITHMETIC = ("+", "-", "*", "/")
+
+    def __init__(self, op: str, left: Expr, right: Expr):
+        assert op in self.COMPARISONS + self.BOOLEAN + self.ARITHMETIC, op
+        self.op = op
+        self.left = left
+        self.right = right
+
+    def children(self) -> Sequence[Expr]:
+        return (self.left, self.right)
+
+    def __repr__(self):
+        return f"({self.left!r} {self.op} {self.right!r})"
+
+
+class Not(Expr):
+    def __init__(self, child: Expr):
+        self.child = child
+
+    def children(self) -> Sequence[Expr]:
+        return (self.child,)
+
+    def __repr__(self):
+        return f"(not {self.child!r})"
+
+
+class IsIn(Expr):
+    def __init__(self, child: Expr, values: Sequence):
+        self.child = child
+        self.values = list(values)
+
+    def children(self) -> Sequence[Expr]:
+        return (self.child,)
+
+    def __repr__(self):
+        return f"({self.child!r} in {self.values!r})"
+
+
+def _lit(v) -> Expr:
+    return v if isinstance(v, Expr) else Lit(v)
+
+
+def col(name: str) -> Col:
+    return Col(name)
+
+
+def lit(value) -> Lit:
+    return Lit(value)
+
+
+# ---------------------------------------------------------------------------
+# Analysis helpers used by the rewrite rules
+# ---------------------------------------------------------------------------
+
+
+def split_conjuncts(e: Expr) -> List[Expr]:
+    """Flatten a tree of `and`s into conjuncts (CNF split)."""
+    if isinstance(e, BinaryOp) and e.op == "and":
+        return split_conjuncts(e.left) + split_conjuncts(e.right)
+    return [e]
+
+
+def extract_equi_join_keys(condition: Expr):
+    """If the condition is pure equi-join CNF (`==` joined by `and`, each side a bare
+    column), return the list of (left_col_name, right_col_name) pairs; else None.
+    Mirrors the reference's applicability check (`JoinIndexRule.scala:188-194`).
+
+    The caller still must orient each pair against the actual child plans (a == may be
+    written `right.c == left.c`)."""
+    pairs = []
+    for conj in split_conjuncts(condition):
+        if not (isinstance(conj, BinaryOp) and conj.op == "=="):
+            return None
+        l, r = conj.left, conj.right
+        if not (isinstance(l, Col) and isinstance(r, Col)):
+            return None
+        pairs.append((l.name, r.name))
+    return pairs

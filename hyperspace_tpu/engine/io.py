@@ -1,0 +1,178 @@
+"""File IO: parquet/csv/json readers and parquet writer (pyarrow-backed).
+
+The engine analogue of Spark's DataSource file formats. Source relations resolve their
+file inventory eagerly at read time (InMemoryFileIndex-style), which is what the
+file-based signature provider fingerprints.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.csv as pa_csv
+import pyarrow.json as pa_json
+import pyarrow.parquet as pq
+
+from ..exceptions import HyperspaceException
+from ..storage.filesystem import FileStatus, FileSystem, LocalFileSystem
+from ..util.path_utils import is_data_path
+from .schema import BOOL, FLOAT32, FLOAT64, INT32, INT64, STRING, Field, Schema
+from .table import Column, Table
+
+_FORMAT_EXTENSIONS = {"parquet": (".parquet",), "csv": (".csv",), "json": (".json",)}
+
+
+def list_data_files(path: str, file_format: str, fs: Optional[FileSystem] = None) -> List[FileStatus]:
+    """Resolve the data files of a root path (file or directory, recursive), applying
+    the metadata filter to every component below the root."""
+    fs = fs or LocalFileSystem()
+    if not fs.exists(path):
+        raise HyperspaceException(f"Path does not exist: {path}")
+    if not fs.is_dir(path):
+        return [fs.get_status(path)]
+    rootnorm = os.path.normpath(path)
+    exts = _FORMAT_EXTENSIONS.get(file_format, ())
+
+    out = []
+    for st in fs.list_leaf_files(path):
+        rel = os.path.relpath(os.path.normpath(st.path), rootnorm)
+        if not all(is_data_path(p) for p in rel.split(os.sep)):
+            continue
+        if exts and not st.path.endswith(exts):
+            continue
+        out.append(st)
+    return out
+
+
+def _arrow_to_table(at: pa.Table) -> Table:
+    cols: Dict[str, Column] = {}
+    for name in at.column_names:
+        arr = at.column(name)
+        if pa.types.is_temporal(arr.type):
+            # Dates/timestamps ride as strings (CSV/JSON readers infer them; the
+            # engine's type system keeps them lexicographically ordered strings).
+            arr = arr.cast(pa.string())
+        if arr.null_count > 0:
+            raise HyperspaceException(
+                f"Null values are not supported (column '{name}')."
+            )
+        np_arr = arr.to_numpy(zero_copy_only=False)
+        if np_arr.dtype.kind == "O":
+            np_arr = np.asarray([str(x) for x in np_arr])
+        cols[name] = Column.from_values(np_arr)
+    return Table(cols)
+
+
+def _read_one(path: str, file_format: str, columns: Optional[List[str]] = None) -> Table:
+    if file_format == "parquet":
+        return _arrow_to_table(pq.read_table(path, columns=columns))
+    if file_format == "csv":
+        # Keep date-like strings as strings (no timestamp inference) — the engine's
+        # type system treats temporal values as lexicographically ordered strings.
+        at = pa_csv.read_csv(
+            path, convert_options=pa_csv.ConvertOptions(timestamp_parsers=[])
+        )
+    elif file_format == "json":
+        at = _read_json_lines(path)
+    else:
+        raise HyperspaceException(f"Unsupported file format: {file_format}")
+    if columns:
+        at = at.select(columns)
+    return _arrow_to_table(at)
+
+
+def _read_json_lines(path: str) -> pa.Table:
+    """Line-delimited JSON reader via stdlib — unlike pyarrow.json it never reinterprets
+    date-like strings as timestamps."""
+    import json as _json
+
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                rows.append(_json.loads(line))
+    if not rows:
+        raise HyperspaceException(f"Empty JSON file: {path}")
+    names = list(rows[0].keys())
+    return pa.table({n: pa.array([r[n] for r in rows]) for n in names})
+
+
+def read_files(
+    files: List[str], file_format: str, columns: Optional[List[str]] = None
+) -> Table:
+    if not files:
+        raise HyperspaceException("No data files to read.")
+    tables = [_read_one(f, file_format, columns) for f in sorted(files)]
+    return tables[0] if len(tables) == 1 else Table.concat(tables)
+
+
+def infer_schema(files: List[str], file_format: str) -> Schema:
+    """Schema from the first file's footer/sample (cheap; no full read for parquet)."""
+    if not files:
+        raise HyperspaceException("No data files to infer schema from.")
+    f = sorted(files)[0]
+    if file_format == "parquet":
+        return arrow_schema_to_schema(pq.read_schema(f))
+    return _read_one(f, file_format).schema
+
+
+_ARROW_TO_DTYPE = {
+    pa.int32(): INT32,
+    pa.int64(): INT64,
+    pa.float32(): FLOAT32,
+    pa.float64(): FLOAT64,
+    pa.bool_(): BOOL,
+}
+
+
+def arrow_schema_to_schema(sch: pa.Schema) -> Schema:
+    fields = []
+    for f in sch:
+        if f.type in _ARROW_TO_DTYPE:
+            fields.append(Field(f.name, _ARROW_TO_DTYPE[f.type]))
+        elif pa.types.is_string(f.type) or pa.types.is_large_string(f.type):
+            fields.append(Field(f.name, STRING))
+        elif pa.types.is_dictionary(f.type):
+            fields.append(Field(f.name, STRING))
+        elif pa.types.is_temporal(f.type):
+            fields.append(Field(f.name, STRING))
+        elif pa.types.is_integer(f.type):
+            fields.append(Field(f.name, INT64))
+        else:
+            raise HyperspaceException(f"Unsupported arrow type: {f.type} ({f.name})")
+    return Schema(fields)
+
+
+def table_to_arrow(table: Table) -> pa.Table:
+    arrays = []
+    names = []
+    for name, col in table.columns.items():
+        names.append(name)
+        arrays.append(pa.array(col.decode()))
+    return pa.table(dict(zip(names, arrays)))
+
+
+def write_parquet(table: Table, path: str) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    pq.write_table(table_to_arrow(table), path)
+
+
+def write_csv(table: Table, path: str) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    pa_csv.write_csv(table_to_arrow(table), path)
+
+
+def write_json(table: Table, path: str) -> None:
+    """Line-delimited JSON writer (pyarrow has no JSON writer)."""
+    import json as _json
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    cols = {n: c.decode() for n, c in table.columns.items()}
+    with open(path, "w") as f:
+        for i in range(table.num_rows):
+            row = {n: v[i].item() if hasattr(v[i], "item") else v[i] for n, v in cols.items()}
+            f.write(_json.dumps(row) + "\n")

@@ -1,0 +1,421 @@
+"""Physical plan + executor.
+
+The engine analogue of Spark's SparkPlan/physical operators, executed with JAX device
+ops. Operator names matter: the explain subsystem counts them to show what a rewrite
+eliminated (`PhysicalOperatorAnalyzer.scala:30-57` counts `ShuffleExchange` removed),
+and the E2E tests assert which files a scan touched.
+
+Join strategy (TPU-first):
+- General equi-join: ShuffleExchange markers on both sides + a global hash-key
+  sort-merge (`ops.join.merge_join_pairs` over `ops.hashing.key64`), with exact
+  re-verification of key equality so hash collisions cannot corrupt results.
+- Co-bucketed index join (set up by the join rewrite rule): both sides arrive
+  hash-partitioned into the same number of buckets on the join keys, so the merge runs
+  per bucket pair with NO exchange — the whole point of the covering-index design
+  (reference `JoinIndexRule.scala:137-162`). On a device mesh the bucket axis shards
+  with zero cross-device traffic.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..exceptions import HyperspaceException
+from ..ops.hashing import key64
+from ..ops.join import merge_join_pairs, nonzero_indices
+from . import io as engine_io
+from .evaluate import evaluate_predicate
+from .expr import Col, Expr, extract_equi_join_keys
+from .logical import (
+    BucketSpec,
+    FilterNode,
+    JoinNode,
+    LogicalPlan,
+    ProjectNode,
+    ScanNode,
+    SourceRelation,
+)
+from .schema import Schema
+from .table import Column, Table, align_dictionaries
+
+_BUCKET_FILE_RE = re.compile(r"part-(\d+)")
+
+
+class ExecContext:
+    def __init__(self, session=None):
+        self.session = session
+
+
+class PhysicalNode:
+    name = "Physical"
+
+    def children(self) -> Sequence["PhysicalNode"]:
+        return ()
+
+    def execute(self, ctx: ExecContext) -> Table:
+        raise NotImplementedError
+
+    def simple_string(self) -> str:
+        return self.name
+
+    def tree_string(self, indent: int = 0) -> str:
+        lines = ["  " * indent + ("+- " if indent else "") + self.simple_string()]
+        for c in self.children():
+            lines.append(c.tree_string(indent + 1))
+        return "\n".join(lines)
+
+    def collect_nodes(self) -> List["PhysicalNode"]:
+        out: List[PhysicalNode] = [self]
+        for c in self.children():
+            out.extend(c.collect_nodes())
+        return out
+
+
+class ScanExec(PhysicalNode):
+    name = "Scan"
+
+    def __init__(self, relation: SourceRelation, columns: Optional[List[str]] = None):
+        self.relation = relation
+        self.columns = columns
+
+    def execute(self, ctx) -> Table:
+        files = [f.path for f in self.relation.files]
+        return engine_io.read_files(files, self.relation.file_format, self.columns)
+
+    def simple_string(self):
+        cols = f" [{', '.join(self.columns)}]" if self.columns else ""
+        tag = f" index={self.relation.index_name}" if self.relation.index_name else ""
+        return f"Scan{tag} {','.join(self.relation.root_paths)}{cols}"
+
+
+class BucketedIndexScanExec(PhysicalNode):
+    """Reads index data preserving bucket structure (list of per-bucket tables).
+
+    Only appears under a SortMergeJoinExec in bucketed mode; its bucket ids come from
+    the `part-<bucket>` file naming contract of the bucketed writer."""
+
+    name = "BucketedIndexScan"
+
+    def __init__(self, relation: SourceRelation, columns: Optional[List[str]] = None):
+        assert relation.bucket_spec is not None
+        self.relation = relation
+        self.columns = columns
+
+    def execute_buckets(self, ctx) -> List[Optional[Table]]:
+        spec = self.relation.bucket_spec
+        buckets: List[Optional[Table]] = [None] * spec.num_buckets
+        for f in self.relation.files:
+            m = _BUCKET_FILE_RE.search(os.path.basename(f.path))
+            if m is None:
+                raise HyperspaceException(f"Not a bucketed index file: {f.path}")
+            b = int(m.group(1))
+            t = engine_io.read_files([f.path], self.relation.file_format, self.columns)
+            buckets[b] = t if buckets[b] is None else Table.concat([buckets[b], t])
+        return buckets
+
+    def execute(self, ctx) -> Table:
+        tables = [t for t in self.execute_buckets(ctx) if t is not None]
+        if not tables:
+            # Empty index: synthesize an empty table with the pruned schema.
+            names = self.columns or self.relation.schema.names
+            return Table(
+                {
+                    n: _empty_column(self.relation.schema.field(n).dtype)
+                    for n in names
+                }
+            )
+        return Table.concat(tables)
+
+    def simple_string(self):
+        spec = self.relation.bucket_spec
+        return (
+            f"BucketedIndexScan index={self.relation.index_name} "
+            f"buckets={spec.num_buckets} by {list(spec.bucket_columns)}"
+        )
+
+
+def _empty_column(dtype: str) -> Column:
+    if dtype == "string":
+        return Column("string", np.empty(0, np.int32), np.empty(0, "<U1"))
+    return Column(dtype, np.empty(0, np.dtype(dtype)))
+
+
+class FilterExec(PhysicalNode):
+    name = "Filter"
+
+    def __init__(self, condition: Expr, child: PhysicalNode):
+        self.condition = condition
+        self.child = child
+
+    def children(self):
+        return (self.child,)
+
+    def execute(self, ctx) -> Table:
+        t = self.child.execute(ctx)
+        if t.num_rows == 0:
+            return t
+        mask = evaluate_predicate(self.condition, t)
+        return t.take(nonzero_indices(mask))
+
+    def simple_string(self):
+        return f"Filter {self.condition!r}"
+
+
+class ProjectExec(PhysicalNode):
+    name = "Project"
+
+    def __init__(self, column_names: Sequence[str], child: PhysicalNode):
+        self.column_names = list(column_names)
+        self.child = child
+
+    def children(self):
+        return (self.child,)
+
+    def execute(self, ctx) -> Table:
+        return self.child.execute(ctx).select(self.column_names)
+
+    def simple_string(self):
+        return f"Project [{', '.join(self.column_names)}]"
+
+
+class ShuffleExchangeExec(PhysicalNode):
+    """Hash-repartition marker — the operator the bucketed index path eliminates.
+
+    Single-process execution is a pass-through (all data shares one memory space); the
+    distributed executor replaces it with an all-to-all over the device mesh. Its
+    presence/absence in the plan is what explain's operator diff reports."""
+
+    name = "ShuffleExchange"
+
+    def __init__(self, keys: Sequence[str], child: PhysicalNode):
+        self.keys = list(keys)
+        self.child = child
+
+    def children(self):
+        return (self.child,)
+
+    def execute(self, ctx) -> Table:
+        return self.child.execute(ctx)
+
+    def simple_string(self):
+        return f"ShuffleExchange hashpartitioning({', '.join(self.keys)})"
+
+
+class SortExec(PhysicalNode):
+    """Sort marker (the SMJ's required child ordering).
+
+    Pass-through at execution time: the merge join sorts by key hash internally
+    (`merge_join_pairs`), so physically reordering here would double the work. The
+    node exists for plan-shape honesty — it is one of the operators the bucketed
+    index path eliminates, which explain's operator diff reports."""
+
+    name = "Sort"
+
+    def __init__(self, keys: Sequence[str], child: PhysicalNode):
+        self.keys = list(keys)
+        self.child = child
+
+    def children(self):
+        return (self.child,)
+
+    def execute(self, ctx) -> Table:
+        return self.child.execute(ctx)
+
+    def simple_string(self):
+        return f"Sort [{', '.join(self.keys)}]"
+
+
+def _join_tables(
+    left: Table,
+    right: Table,
+    left_keys: List[str],
+    right_keys: List[str],
+) -> Table:
+    """Hash-key merge join of two tables with exact verification."""
+    lcols = [left.column(k) for k in left_keys]
+    rcols = [right.column(k) for k in right_keys]
+    l64 = key64(lcols, [jnp.asarray(c.data) for c in lcols])
+    r64 = key64(rcols, [jnp.asarray(c.data) for c in rcols])
+    li, ri = merge_join_pairs(l64, r64)
+
+    if len(li):
+        # Exact verification: eliminate 64-bit hash collisions.
+        keep = np.ones(len(li), dtype=bool)
+        for lc, rc in zip(lcols, rcols):
+            if lc.is_string != rc.is_string:
+                raise HyperspaceException("Join key type mismatch (string vs numeric)")
+            lv = lc.decode()[li]
+            rv = rc.decode()[ri]
+            keep &= lv == rv
+        if not keep.all():
+            li, ri = li[keep], ri[keep]
+
+    lt = left.take(li)
+    rt = right.take(ri)
+    out: Dict[str, Column] = dict(lt.columns)
+    for n, c in rt.columns.items():
+        out[n if n not in out else f"{n}_r"] = c
+    return Table(out)
+
+
+class SortMergeJoinExec(PhysicalNode):
+    name = "SortMergeJoin"
+
+    def __init__(
+        self,
+        left: PhysicalNode,
+        right: PhysicalNode,
+        left_keys: List[str],
+        right_keys: List[str],
+        bucketed: bool = False,
+    ):
+        self.left = left
+        self.right = right
+        self.left_keys = left_keys
+        self.right_keys = right_keys
+        self.bucketed = bucketed
+
+    def children(self):
+        return (self.left, self.right)
+
+    def execute(self, ctx) -> Table:
+        if self.bucketed:
+            return self._execute_bucketed(ctx)
+        lt = self.left.execute(ctx)
+        rt = self.right.execute(ctx)
+        return _join_tables(lt, rt, self.left_keys, self.right_keys)
+
+    def _execute_bucketed(self, ctx) -> Table:
+        """Per-bucket merge join: equal keys are co-located by construction (both
+        sides hash-partitioned with the same function and bucket count), so bucket
+        pairs join independently with no data exchange."""
+        assert isinstance(self.left, BucketedIndexScanExec)
+        assert isinstance(self.right, BucketedIndexScanExec)
+        lbuckets = self.left.execute_buckets(ctx)
+        rbuckets = self.right.execute_buckets(ctx)
+        assert len(lbuckets) == len(rbuckets)
+        parts: List[Table] = []
+        for lb, rb in zip(lbuckets, rbuckets):
+            if lb is None or rb is None or lb.num_rows == 0 or rb.num_rows == 0:
+                continue
+            parts.append(_join_tables(lb, rb, self.left_keys, self.right_keys))
+        if not parts:
+            # No overlapping buckets: empty result with the joined schema — no IO,
+            # just empty tables with each side's pruned schema.
+            def empty_side(scan: BucketedIndexScanExec) -> Table:
+                names = scan.columns or scan.relation.schema.names
+                return Table(
+                    {n: _empty_column(scan.relation.schema.field(n).dtype) for n in names}
+                )
+
+            return _join_tables(
+                empty_side(self.left), empty_side(self.right), self.left_keys, self.right_keys
+            )
+        return Table.concat(parts)
+
+    def simple_string(self):
+        mode = " (bucketed, no exchange)" if self.bucketed else ""
+        pairs = ", ".join(f"{l}={r}" for l, r in zip(self.left_keys, self.right_keys))
+        return f"SortMergeJoin [{pairs}]{mode}"
+
+
+# ---------------------------------------------------------------------------
+# Planner: logical → physical
+# ---------------------------------------------------------------------------
+
+
+def _orient_join_keys(
+    pairs: List[Tuple[str, str]], left_schema: Schema, right_schema: Schema
+) -> Tuple[List[str], List[str]]:
+    lkeys, rkeys = [], []
+    for a, b in pairs:
+        a_in_l, a_in_r = a in left_schema, a in right_schema
+        b_in_l, b_in_r = b in left_schema, b in right_schema
+        if a_in_l and b_in_r and not (a_in_r and b_in_l):
+            lkeys.append(a)
+            rkeys.append(b)
+        elif a_in_r and b_in_l and not (a_in_l and b_in_r):
+            lkeys.append(b)
+            rkeys.append(a)
+        elif a_in_l and b_in_r:
+            # Ambiguous (name exists on both sides): default left-to-right.
+            lkeys.append(a)
+            rkeys.append(b)
+        else:
+            raise HyperspaceException(
+                f"Cannot resolve join condition column(s) {a!r}/{b!r}"
+            )
+    return lkeys, rkeys
+
+
+def plan_physical(logical: LogicalPlan, required: Optional[List[str]] = None) -> PhysicalNode:
+    """Compile a logical plan to a physical one, pushing column pruning into scans."""
+    if isinstance(logical, ScanNode):
+        rel = logical.relation
+        cols = None
+        if required is not None:
+            cols = [n for n in rel.schema.names if n in set(required)]
+        if rel.bucket_spec is not None:
+            return BucketedIndexScanExec(rel, cols)
+        return ScanExec(rel, cols)
+
+    if isinstance(logical, FilterNode):
+        child_required = None
+        if required is not None:
+            child_required = list(dict.fromkeys(list(required) + sorted(logical.condition.references())))
+        return FilterExec(logical.condition, plan_physical(logical.child, child_required))
+
+    if isinstance(logical, ProjectNode):
+        return ProjectExec(
+            logical.column_names, plan_physical(logical.child, list(logical.column_names))
+        )
+
+    if isinstance(logical, JoinNode):
+        if logical.how != "inner":
+            raise HyperspaceException(f"Unsupported join type: {logical.how}")
+        pairs = extract_equi_join_keys(logical.condition)
+        if pairs is None:
+            raise HyperspaceException(
+                f"Only equi-joins are supported: {logical.condition!r}"
+            )
+        lschema, rschema = logical.left.output_schema, logical.right.output_schema
+        lkeys, rkeys = _orient_join_keys(pairs, lschema, rschema)
+
+        lreq = rreq = None
+        if required is not None:
+            req = set(required)
+            lreq = [n for n in lschema.names if n in req] + lkeys
+            rreq = [n for n in rschema.names if n in req] + rkeys
+            lreq = list(dict.fromkeys(lreq))
+            rreq = list(dict.fromkeys(rreq))
+
+        lphys = plan_physical(logical.left, lreq)
+        rphys = plan_physical(logical.right, rreq)
+
+        # Bucketed fast path: both sides are bucketed index scans, partitioned on
+        # exactly the join keys, with equal bucket counts → no exchange needed.
+        if (
+            isinstance(lphys, BucketedIndexScanExec)
+            and isinstance(rphys, BucketedIndexScanExec)
+            and list(lphys.relation.bucket_spec.bucket_columns) == lkeys
+            and list(rphys.relation.bucket_spec.bucket_columns) == rkeys
+            and lphys.relation.bucket_spec.num_buckets
+            == rphys.relation.bucket_spec.num_buckets
+        ):
+            return SortMergeJoinExec(lphys, rphys, lkeys, rkeys, bucketed=True)
+
+        # General path: exchange + sort both sides.
+        if isinstance(lphys, BucketedIndexScanExec):
+            lphys = ScanExec(lphys.relation, lphys.columns)
+        if isinstance(rphys, BucketedIndexScanExec):
+            rphys = ScanExec(rphys.relation, rphys.columns)
+        lside = SortExec(lkeys, ShuffleExchangeExec(lkeys, lphys))
+        rside = SortExec(rkeys, ShuffleExchangeExec(rkeys, rphys))
+        return SortMergeJoinExec(lside, rside, lkeys, rkeys, bucketed=False)
+
+    raise HyperspaceException(f"Cannot plan logical node: {logical.simple_string()}")

@@ -1,0 +1,102 @@
+"""Schema model for columnar tables.
+
+The engine analogue of Spark's StructType (which the reference stores as
+`schemaString` JSON in the index metadata, `IndexLogEntry.scala:231-239`). Kept
+deliberately small: the six types the TPU execution path supports.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import List, Sequence
+
+import numpy as np
+
+INT32 = "int32"
+INT64 = "int64"
+FLOAT32 = "float32"
+FLOAT64 = "float64"
+BOOL = "bool"
+STRING = "string"
+
+_NUMPY_TO_TYPE = {
+    np.dtype(np.int32): INT32,
+    np.dtype(np.int64): INT64,
+    np.dtype(np.float32): FLOAT32,
+    np.dtype(np.float64): FLOAT64,
+    np.dtype(np.bool_): BOOL,
+}
+
+_TYPE_TO_NUMPY = {
+    INT32: np.dtype(np.int32),
+    INT64: np.dtype(np.int64),
+    FLOAT32: np.dtype(np.float32),
+    FLOAT64: np.dtype(np.float64),
+    BOOL: np.dtype(np.bool_),
+}
+
+
+def dtype_from_numpy(dt: np.dtype) -> str:
+    if dt in _NUMPY_TO_TYPE:
+        return _NUMPY_TO_TYPE[dt]
+    if dt.kind in ("U", "O", "S"):
+        return STRING
+    raise ValueError(f"Unsupported numpy dtype: {dt}")
+
+
+def numpy_dtype(type_name: str) -> np.dtype:
+    if type_name == STRING:
+        raise ValueError("string columns are dictionary-encoded; no direct numpy dtype")
+    return _TYPE_TO_NUMPY[type_name]
+
+
+@dataclass(frozen=True)
+class Field:
+    name: str
+    dtype: str
+
+    def to_json(self) -> dict:
+        return {"name": self.name, "type": self.dtype}
+
+
+@dataclass(frozen=True)
+class Schema:
+    fields: tuple
+
+    def __init__(self, fields: Sequence[Field]):
+        object.__setattr__(self, "fields", tuple(fields))
+
+    @property
+    def names(self) -> List[str]:
+        return [f.name for f in self.fields]
+
+    def field(self, name: str) -> Field:
+        for f in self.fields:
+            if f.name == name:
+                return f
+        raise KeyError(name)
+
+    def __contains__(self, name: str) -> bool:
+        return any(f.name == name for f in self.fields)
+
+    def select(self, names: Sequence[str]) -> "Schema":
+        return Schema([self.field(n) for n in names])
+
+    def to_json_string(self) -> str:
+        return json.dumps({"fields": [f.to_json() for f in self.fields]})
+
+    @staticmethod
+    def from_json_string(s: str) -> "Schema":
+        d = json.loads(s)
+        return Schema([Field(f["name"], f["type"]) for f in d["fields"]])
+
+    def __eq__(self, other):
+        return isinstance(other, Schema) and self.fields == other.fields
+
+    def __hash__(self):
+        return hash(self.fields)
+
+    def __repr__(self):
+        inner = ", ".join(f"{f.name}:{f.dtype}" for f in self.fields)
+        return f"Schema({inner})"

@@ -1,0 +1,156 @@
+"""Session + DataFrame API.
+
+The engine analogue of SparkSession + DataFrame, sized to what the reference's
+workflows need: read parquet/csv/json into a lazily-planned DataFrame, filter/select/
+join, collect on the TPU execution path. The session carries the conf, the filesystem,
+and the optimizer extension point (`extra_optimizations`) that `enable_hyperspace`
+plugs the rewrite rules into (the analogue of
+`experimentalMethods.extraOptimizations`, reference `package.scala:46-51`).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+from ..config import HyperspaceConf, SessionConf
+from ..exceptions import HyperspaceException
+from ..storage.filesystem import FileSystem, LocalFileSystem
+from . import io as engine_io
+from .expr import Expr
+from .logical import FilterNode, JoinNode, LogicalPlan, ProjectNode, ScanNode, SourceRelation
+from .physical import ExecContext, PhysicalNode, plan_physical
+from .table import Table
+
+
+class DataFrame:
+    def __init__(self, session: "HyperspaceSession", plan: LogicalPlan):
+        self.session = session
+        self.plan = plan
+
+    # -- transformations ----------------------------------------------------
+
+    def filter(self, condition: Expr) -> "DataFrame":
+        return DataFrame(self.session, FilterNode(condition, self.plan))
+
+    where = filter
+
+    def select(self, *columns: str) -> "DataFrame":
+        names = list(columns[0]) if len(columns) == 1 and isinstance(columns[0], (list, tuple)) else list(columns)
+        missing = [n for n in names if n not in self.plan.output_schema]
+        if missing:
+            raise HyperspaceException(f"Column(s) not found: {missing}")
+        return DataFrame(self.session, ProjectNode(names, self.plan))
+
+    def join(self, other: "DataFrame", on: Expr, how: str = "inner") -> "DataFrame":
+        return DataFrame(self.session, JoinNode(self.plan, other.plan, on, how))
+
+    # -- actions ------------------------------------------------------------
+
+    @property
+    def schema(self):
+        return self.plan.output_schema
+
+    def optimized_plan(self) -> LogicalPlan:
+        return self.session.optimize(self.plan)
+
+    def physical_plan(self) -> PhysicalNode:
+        return plan_physical(self.optimized_plan())
+
+    def collect(self) -> Table:
+        phys = self.physical_plan()
+        return phys.execute(ExecContext(self.session))
+
+    def count(self) -> int:
+        return self.collect().num_rows
+
+    def to_pydict(self) -> Dict[str, list]:
+        return self.collect().to_pydict()
+
+    def sorted_rows(self):
+        return self.collect().sorted_rows()
+
+    def explain_string(self) -> str:
+        return self.physical_plan().tree_string()
+
+
+class DataFrameReader:
+    def __init__(self, session: "HyperspaceSession"):
+        self._session = session
+
+    def _read(self, paths, file_format: str) -> DataFrame:
+        path_list = [paths] if isinstance(paths, str) else list(paths)
+        files = []
+        for p in path_list:
+            files.extend(engine_io.list_data_files(p, file_format, self._session.fs))
+        if not files:
+            raise HyperspaceException(f"No {file_format} files found under {path_list}")
+        schema = engine_io.infer_schema([f.path for f in files], file_format)
+        rel = SourceRelation(
+            root_paths=[os.path.abspath(p) for p in path_list],
+            file_format=file_format,
+            schema=schema,
+            files=files,
+        )
+        return DataFrame(self._session, ScanNode(rel))
+
+    def parquet(self, *paths) -> DataFrame:
+        return self._read(paths if len(paths) > 1 else paths[0], "parquet")
+
+    def csv(self, *paths) -> DataFrame:
+        return self._read(paths if len(paths) > 1 else paths[0], "csv")
+
+    def json(self, *paths) -> DataFrame:
+        return self._read(paths if len(paths) > 1 else paths[0], "json")
+
+
+class HyperspaceSession:
+    """One session = conf + filesystem + optimizer rules + warehouse location."""
+
+    _active: Optional["HyperspaceSession"] = None
+
+    def __init__(
+        self,
+        warehouse: str = ".",
+        conf: Optional[SessionConf] = None,
+        fs: Optional[FileSystem] = None,
+    ):
+        self.warehouse = warehouse
+        self.conf = conf or SessionConf()
+        self.hs_conf = HyperspaceConf(self.conf)
+        self.fs = fs or LocalFileSystem()
+        # Rule protocol: rule.apply(plan, session) -> plan.
+        self.extra_optimizations: List = []
+        HyperspaceSession._active = self
+
+    @classmethod
+    def active(cls) -> "HyperspaceSession":
+        if cls._active is None:
+            raise HyperspaceException("No active HyperspaceSession.")
+        return cls._active
+
+    @property
+    def read(self) -> DataFrameReader:
+        return DataFrameReader(self)
+
+    def optimize(self, plan: LogicalPlan) -> LogicalPlan:
+        for rule in self.extra_optimizations:
+            plan = rule.apply(plan, self)
+        return plan
+
+    # -- data creation helpers (test/SampleData parity) ---------------------
+
+    def create_table(self, data: Dict[str, list]) -> Table:
+        return Table.from_pydict(data)
+
+    def write_parquet(self, data: Union[Table, Dict[str, list]], path: str) -> None:
+        t = data if isinstance(data, Table) else Table.from_pydict(data)
+        engine_io.write_parquet(t, os.path.join(path, "part-00000.parquet"))
+
+    def write_csv(self, data: Union[Table, Dict[str, list]], path: str) -> None:
+        t = data if isinstance(data, Table) else Table.from_pydict(data)
+        engine_io.write_csv(t, os.path.join(path, "part-00000.csv"))
+
+    def write_json(self, data: Union[Table, Dict[str, list]], path: str) -> None:
+        t = data if isinstance(data, Table) else Table.from_pydict(data)
+        engine_io.write_json(t, os.path.join(path, "part-00000.json"))

@@ -1,0 +1,155 @@
+"""Columnar in-memory table: numpy on host, JAX arrays on device.
+
+The engine analogue of a materialized Spark DataFrame partition. Design points:
+
+- **Strings are dictionary-encoded** with a *sorted* dictionary, so int32 codes are
+  order-preserving within a column: range filters on strings become integer compares on
+  device, and the index build's sort-by-string is an integer sort (TPU arrays are
+  numeric; SURVEY §7 "hard parts").
+- Host representation is authoritative; `device_columns()` materializes jnp arrays for
+  the jitted compute path.
+- No null support in v1: ingestion raises on nulls (honest failure, not silent wrong
+  answers).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..exceptions import HyperspaceException
+from .schema import BOOL, STRING, Field, Schema, dtype_from_numpy
+
+
+@dataclass
+class Column:
+    """One column: numeric data, or dictionary-encoded strings (codes + dictionary)."""
+
+    dtype: str
+    data: np.ndarray  # numeric values, or int32 codes into `dictionary`
+    dictionary: Optional[np.ndarray] = None  # sorted unique strings (dtype '<U*')
+
+    def __post_init__(self):
+        if self.dtype == STRING:
+            assert self.dictionary is not None
+            assert self.data.dtype == np.int32
+        else:
+            assert self.dictionary is None
+
+    def __len__(self) -> int:
+        return len(self.data)
+
+    @property
+    def is_string(self) -> bool:
+        return self.dtype == STRING
+
+    def decode(self) -> np.ndarray:
+        """Materialize values (strings decoded through the dictionary)."""
+        if self.is_string:
+            return self.dictionary[self.data]
+        return self.data
+
+    def take(self, indices: np.ndarray) -> "Column":
+        return Column(self.dtype, self.data[indices], self.dictionary)
+
+    @staticmethod
+    def from_values(values: np.ndarray) -> "Column":
+        """Ingest a numpy array; strings get dictionary-encoded with a sorted dict."""
+        if values.dtype.kind in ("U", "O", "S"):
+            if values.dtype.kind == "O":
+                if any(v is None for v in values):
+                    raise HyperspaceException("Null values are not supported.")
+                values = values.astype(str)
+            dictionary, codes = np.unique(values, return_inverse=True)
+            return Column(STRING, codes.astype(np.int32), dictionary)
+        return Column(dtype_from_numpy(values.dtype), values)
+
+
+def _remap_codes(col: Column, new_dictionary: np.ndarray) -> np.ndarray:
+    """Remap a string column's codes into a (sorted) superset dictionary."""
+    positions = np.searchsorted(new_dictionary, col.dictionary)
+    return positions.astype(np.int32)[col.data]
+
+
+def align_dictionaries(a: Column, b: Column):
+    """Re-encode two string columns over their union dictionary so codes are directly
+    comparable across tables (needed for cross-table joins on strings)."""
+    if not (a.is_string and b.is_string):
+        raise ValueError("align_dictionaries requires string columns")
+    union = np.union1d(a.dictionary, b.dictionary)
+    return (
+        Column(STRING, _remap_codes(a, union), union),
+        Column(STRING, _remap_codes(b, union), union),
+    )
+
+
+class Table:
+    """Ordered name→Column mapping with equal lengths."""
+
+    def __init__(self, columns: Dict[str, Column]):
+        lengths = {len(c) for c in columns.values()}
+        if len(lengths) > 1:
+            raise ValueError(f"ragged columns: { {k: len(v) for k, v in columns.items()} }")
+        self.columns: Dict[str, Column] = dict(columns)
+        self.num_rows: int = lengths.pop() if lengths else 0
+
+    @property
+    def schema(self) -> Schema:
+        return Schema([Field(n, c.dtype) for n, c in self.columns.items()])
+
+    @property
+    def column_names(self) -> List[str]:
+        return list(self.columns.keys())
+
+    def column(self, name: str) -> Column:
+        return self.columns[name]
+
+    def select(self, names: Sequence[str]) -> "Table":
+        return Table({n: self.columns[n] for n in names})
+
+    def take(self, indices: np.ndarray) -> "Table":
+        return Table({n: c.take(indices) for n, c in self.columns.items()})
+
+    def rename(self, mapping: Dict[str, str]) -> "Table":
+        return Table({mapping.get(n, n): c for n, c in self.columns.items()})
+
+    def to_pydict(self) -> Dict[str, list]:
+        return {n: c.decode().tolist() for n, c in self.columns.items()}
+
+    def rows(self) -> List[tuple]:
+        decoded = [c.decode() for c in self.columns.values()]
+        return [tuple(col[i] for col in decoded) for i in range(self.num_rows)]
+
+    def sorted_rows(self) -> List[tuple]:
+        """Canonical row order for result comparison — the reference E2E oracle
+        compares sorted collected rows (`E2EHyperspaceRulesTests.scala:454-470`)."""
+        return sorted(self.rows(), key=lambda r: tuple(str(x) for x in r))
+
+    @staticmethod
+    def from_pydict(data: Dict[str, list]) -> "Table":
+        return Table({n: Column.from_values(np.asarray(v)) for n, v in data.items()})
+
+    @staticmethod
+    def concat(tables: List["Table"]) -> "Table":
+        """Concatenate tables with identical column names/types (multi-file scans).
+        String columns are re-encoded over the union dictionary."""
+        if not tables:
+            return Table({})
+        names = tables[0].column_names
+        out: Dict[str, Column] = {}
+        for n in names:
+            cols = [t.columns[n] for t in tables]
+            if cols[0].is_string:
+                union = cols[0].dictionary
+                for c in cols[1:]:
+                    union = np.union1d(union, c.dictionary)
+                codes = np.concatenate([_remap_codes(c, union) for c in cols])
+                out[n] = Column(STRING, codes, union)
+            else:
+                out[n] = Column(cols[0].dtype, np.concatenate([c.data for c in cols]))
+        return Table(out)
+
+    def __repr__(self):
+        return f"Table({self.schema}, rows={self.num_rows})"

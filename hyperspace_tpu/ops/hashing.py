@@ -1,0 +1,124 @@
+"""Stable on-device hashing for bucket assignment and join keys.
+
+TPU-first design: bucket ids and join keys are computed on device with uint32 vector
+ops (murmur3 finalizer mixing), so the index build's partitioning step
+(the analogue of Spark's `repartition(numBuckets, indexedCols)` hash partitioning,
+`CreateActionBase.scala:130-131`) runs on the VPU, not the host.
+
+- Numeric columns hash on device from their bit patterns.
+- String columns hash via their dictionary: one host-side blake2b per *unique* value,
+  then a device gather through the codes — O(dict) host work, O(n) device work.
+- Multi-column keys combine per-column hashes with a murmur-style mixer.
+- Join keys are 64-bit (two independent 32-bit lanes packed), verified exactly at join
+  time, so hash collisions can never produce wrong results.
+
+Hash stability matters: the same value must hash identically in any table on any
+backend (bucket co-location across independently-built indexes is what makes the
+shuffle-free bucketed join sound — reference `JoinIndexRule.scala:144-156`).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..engine.table import Column
+
+_SEED1 = np.uint32(0x9747B28C)
+_SEED2 = np.uint32(0x85EBCA6B)
+
+
+def fmix32(h):
+    """murmur3 32-bit finalizer — a cheap, well-mixed bijection on uint32."""
+    h = h.astype(jnp.uint32)
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+def _mix_combine(h, k):
+    """Combine an accumulated hash with a new lane (murmur-style stream step)."""
+    h = h ^ fmix32(k)
+    h = (h * jnp.uint32(5)) + jnp.uint32(0xE6546B64)
+    return h
+
+
+def _words_u32(arr):
+    """Split an array into two uint32 word arrays from its canonical bit pattern.
+
+    Values are canonicalized to 64-bit first (ints/bools → int64, floats → float64) so
+    that equal values hash equal regardless of storage width — an int32 id column must
+    bucket/join against an int64 one (equal-value-equal-hash is what makes bucket
+    co-location across independently built indexes sound)."""
+    x = jnp.asarray(arr)
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        x = x.astype(jnp.float64)
+        # Normalize -0.0 to +0.0 so equal floats hash equal.
+        x = jnp.where(x == 0, jnp.zeros_like(x), x)
+        bits = jax.lax.bitcast_convert_type(x, jnp.uint32)  # shape (..., 2)
+        return [bits[..., 0], bits[..., 1]]
+    x = x.astype(jnp.int64)
+    lo = (x & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
+    hi = ((x >> jnp.int64(32)) & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
+    return [lo, hi]
+
+
+def hash_device_values(arr, seed: np.uint32):
+    """uint32 hash of a numeric device array's values."""
+    words = _words_u32(arr)
+    h = jnp.full(words[0].shape, jnp.uint32(seed))
+    for w in words:
+        h = _mix_combine(h, w)
+    return fmix32(h)
+
+
+def host_hash_dictionary(dictionary: np.ndarray, seed: int) -> np.ndarray:
+    """Stable uint32 hash per unique string (host side, once per dictionary entry)."""
+    out = np.empty(len(dictionary), dtype=np.uint32)
+    seed_bytes = int(seed).to_bytes(4, "little")
+    for i, s in enumerate(dictionary):
+        d = hashlib.blake2b(str(s).encode("utf-8"), digest_size=4, salt=seed_bytes).digest()
+        out[i] = np.frombuffer(d, dtype=np.uint32)[0]
+    return out
+
+
+def column_hash_u32(column: Column, device_data, seed: np.uint32):
+    """uint32 hash of one column's values (device array in, device array out).
+
+    ``device_data`` is the column's device representation (codes for strings)."""
+    if column.is_string:
+        dict_hashes = jnp.asarray(host_hash_dictionary(column.dictionary, int(seed)))
+        return dict_hashes[device_data]
+    return hash_device_values(device_data, seed)
+
+
+def combined_hash_u32(columns, device_arrays, seed: np.uint32):
+    """Combine multiple key columns into one uint32 hash."""
+    h = None
+    for col, arr in zip(columns, device_arrays):
+        hc = column_hash_u32(col, arr, seed)
+        h = hc if h is None else fmix32(_mix_combine(h, hc))
+    return h
+
+
+def key64(columns, device_arrays):
+    """Signed 64-bit join/sort key from two independent 32-bit hash lanes.
+
+    Equal key tuples always map to equal key64 (value-based hashing); unequal tuples
+    collide with probability ~2^-64 and are removed by the join's exact-equality
+    verification pass."""
+    h1 = combined_hash_u32(columns, device_arrays, _SEED1)
+    h2 = combined_hash_u32(columns, device_arrays, _SEED2)
+    return (h1.astype(jnp.int64) << jnp.int64(32)) | h2.astype(jnp.int64)
+
+
+def bucket_id(columns, device_arrays, num_buckets: int):
+    """Bucket assignment: h1 % num_buckets (the repartition hash)."""
+    h1 = combined_hash_u32(columns, device_arrays, _SEED1)
+    return (h1 % jnp.uint32(num_buckets)).astype(jnp.int32)

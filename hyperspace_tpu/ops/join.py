@@ -1,0 +1,60 @@
+"""Device-side sort-merge join primitive.
+
+TPU-first: both sides' join keys are 64-bit value hashes (`ops.hashing.key64`), so the
+merge works on a single comparable integer key regardless of column count or string
+dictionaries. The pipeline is sort → searchsorted range probe → two-pass expansion
+(count, then scatter), which keeps every step static-shaped for XLA except one scalar
+sync for the output size — the classic way around ragged output shapes on TPU
+(SURVEY §7 "hard parts": two-pass partitioning).
+
+Equal key tuples always produce equal key64s; unequal tuples that collide (~2^-64) are
+eliminated by the caller's exact-equality verification on the gathered rows, so results
+are exact.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def merge_join_pairs(l_key64, r_key64) -> Tuple[np.ndarray, np.ndarray]:
+    """All (left_index, right_index) pairs with equal keys, as host numpy arrays.
+
+    Works on unsorted inputs: sorts both sides internally and maps positions back to
+    the original row order."""
+    l_key64 = jnp.asarray(l_key64)
+    r_key64 = jnp.asarray(r_key64)
+    if l_key64.shape[0] == 0 or r_key64.shape[0] == 0:
+        return np.empty(0, np.int64), np.empty(0, np.int64)
+
+    l_order = jnp.argsort(l_key64)
+    r_order = jnp.argsort(r_key64)
+    ls = l_key64[l_order]
+    rs = r_key64[r_order]
+
+    lo = jnp.searchsorted(rs, ls, side="left")
+    hi = jnp.searchsorted(rs, ls, side="right")
+    counts = hi - lo
+    total = int(counts.sum())  # the one scalar sync (dynamic output size)
+    if total == 0:
+        return np.empty(0, np.int64), np.empty(0, np.int64)
+
+    starts = jnp.cumsum(counts) - counts  # exclusive prefix sum
+    l_pos = jnp.repeat(
+        jnp.arange(ls.shape[0]), counts, total_repeat_length=total
+    )
+    offset = jnp.arange(total) - starts[l_pos]
+    r_pos = lo[l_pos] + offset
+    return np.asarray(l_order[l_pos]), np.asarray(r_order[r_pos])
+
+
+def nonzero_indices(mask) -> np.ndarray:
+    """Compact a device boolean mask into host row indices (one scalar sync)."""
+    mask = jnp.asarray(mask)
+    n = int(mask.sum())
+    if n == 0:
+        return np.empty(0, np.int64)
+    return np.asarray(jnp.nonzero(mask, size=n)[0])
