@@ -12,4 +12,19 @@ from .config import HyperspaceConf, IndexConstants, SessionConf  # noqa: F401
 from .exceptions import HyperspaceException  # noqa: F401
 from .index.index_config import IndexConfig  # noqa: F401
 
+
+def __getattr__(name):
+    # Facade exports are lazy: the engine stack (jax import, x64 config) only loads
+    # when actually used, keeping `import hyperspace_tpu` light for metadata-only use.
+    if name in ("Hyperspace", "enable_hyperspace", "disable_hyperspace", "is_hyperspace_enabled"):
+        from . import hyperspace as _h
+
+        return getattr(_h, name)
+    if name == "HyperspaceSession":
+        from .engine.session import HyperspaceSession
+
+        return HyperspaceSession
+    raise AttributeError(name)
+
+
 __version__ = "0.1.0"

@@ -62,7 +62,6 @@ class CreateAction(Action):
         self._builder = builder
         self._index_path = index_path
         self._index_data_path = index_data_path
-        self._entry_cache: Optional[IndexLogEntry] = None
 
     @property
     def transient_state(self) -> str:
@@ -86,11 +85,11 @@ class CreateAction(Action):
         self._builder.write(self._df, self._config, self._index_data_path)
 
     def log_entry(self) -> LogEntry:
-        if self._entry_cache is None:
-            self._entry_cache = self._builder.derive_log_entry(
-                self._df, self._config, self._index_path, self._index_data_path
-            )
-        return self._entry_cache
+        # Derived fresh per phase — the end() entry must inventory the index files
+        # that op() wrote, so it cannot be cached from begin().
+        return self._builder.derive_log_entry(
+            self._df, self._config, self._index_path, self._index_data_path
+        )
 
     def event(self, message: str) -> HyperspaceEvent:
         return CreateActionEvent(index_name=self._config.index_name, message=message)

@@ -35,7 +35,6 @@ class RefreshAction(Action):
         self._builder = builder
         self._index_path = index_path
         self._index_data_path = index_data_path
-        self._entry_cache: Optional[IndexLogEntry] = None
         self._prev: Optional[IndexLogEntry] = None
         self._df = None
 
@@ -80,15 +79,15 @@ class RefreshAction(Action):
         self._builder.write(self._source_df(), config, self._index_data_path)
 
     def log_entry(self) -> LogEntry:
-        if self._entry_cache is None:
-            prev = self._previous_entry()
-            from ..index.index_config import IndexConfig
+        # Derived fresh per phase (see CreateAction.log_entry): the end() entry must
+        # inventory the files op() wrote.
+        prev = self._previous_entry()
+        from ..index.index_config import IndexConfig
 
-            config = IndexConfig(prev.name, prev.indexed_columns, prev.included_columns)
-            self._entry_cache = self._builder.derive_log_entry(
-                self._source_df(), config, self._index_path, self._index_data_path
-            )
-        return self._entry_cache
+        config = IndexConfig(prev.name, prev.indexed_columns, prev.included_columns)
+        return self._builder.derive_log_entry(
+            self._source_df(), config, self._index_path, self._index_data_path
+        )
 
     def event(self, message: str) -> HyperspaceEvent:
         name = self._prev.name if self._prev else ""

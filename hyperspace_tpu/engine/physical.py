@@ -118,17 +118,17 @@ class BucketedIndexScanExec(PhysicalNode):
             buckets[b] = t if buckets[b] is None else Table.concat([buckets[b], t])
         return buckets
 
+    def empty_table(self) -> Table:
+        """Empty table with this scan's (pruned) schema."""
+        names = self.columns or self.relation.schema.names
+        return Table(
+            {n: _empty_column(self.relation.schema.field(n).dtype) for n in names}
+        )
+
     def execute(self, ctx) -> Table:
         tables = [t for t in self.execute_buckets(ctx) if t is not None]
         if not tables:
-            # Empty index: synthesize an empty table with the pruned schema.
-            names = self.columns or self.relation.schema.names
-            return Table(
-                {
-                    n: _empty_column(self.relation.schema.field(n).dtype)
-                    for n in names
-                }
-            )
+            return self.empty_table()
         return Table.concat(tables)
 
     def simple_string(self):
@@ -230,21 +230,18 @@ class SortExec(PhysicalNode):
         return f"Sort [{', '.join(self.keys)}]"
 
 
-def _join_tables(
+def _gather_verified(
     left: Table,
     right: Table,
     left_keys: List[str],
     right_keys: List[str],
+    li: np.ndarray,
+    ri: np.ndarray,
 ) -> Table:
-    """Hash-key merge join of two tables with exact verification."""
+    """Gather matched rows, dropping 64-bit hash collisions via exact key equality."""
     lcols = [left.column(k) for k in left_keys]
     rcols = [right.column(k) for k in right_keys]
-    l64 = key64(lcols, [jnp.asarray(c.data) for c in lcols])
-    r64 = key64(rcols, [jnp.asarray(c.data) for c in rcols])
-    li, ri = merge_join_pairs(l64, r64)
-
     if len(li):
-        # Exact verification: eliminate 64-bit hash collisions.
         keep = np.ones(len(li), dtype=bool)
         for lc, rc in zip(lcols, rcols):
             if lc.is_string != rc.is_string:
@@ -254,13 +251,30 @@ def _join_tables(
             keep &= lv == rv
         if not keep.all():
             li, ri = li[keep], ri[keep]
-
     lt = left.take(li)
     rt = right.take(ri)
     out: Dict[str, Column] = dict(lt.columns)
     for n, c in rt.columns.items():
         out[n if n not in out else f"{n}_r"] = c
     return Table(out)
+
+
+def _table_key64(table: Table, keys: List[str]):
+    cols = [table.column(k) for k in keys]
+    return key64(cols, [jnp.asarray(c.data) for c in cols])
+
+
+def _join_tables(
+    left: Table,
+    right: Table,
+    left_keys: List[str],
+    right_keys: List[str],
+) -> Table:
+    """Hash-key merge join of two tables with exact verification."""
+    li, ri = merge_join_pairs(
+        _table_key64(left, left_keys), _table_key64(right, right_keys)
+    )
+    return _gather_verified(left, right, left_keys, right_keys, li, ri)
 
 
 class SortMergeJoinExec(PhysicalNode):
@@ -291,32 +305,38 @@ class SortMergeJoinExec(PhysicalNode):
         return _join_tables(lt, rt, self.left_keys, self.right_keys)
 
     def _execute_bucketed(self, ctx) -> Table:
-        """Per-bucket merge join: equal keys are co-located by construction (both
-        sides hash-partitioned with the same function and bucket count), so bucket
-        pairs join independently with no data exchange."""
+        """Batched co-bucketed merge join: equal keys are co-located by construction
+        (both sides hash-partitioned with the same function and bucket count), so all
+        bucket pairs join independently — executed as ONE device program over padded
+        [num_buckets, cap] matrices (`ops.bucket_join`), with no data exchange."""
         assert isinstance(self.left, BucketedIndexScanExec)
         assert isinstance(self.right, BucketedIndexScanExec)
-        lbuckets = self.left.execute_buckets(ctx)
-        rbuckets = self.right.execute_buckets(ctx)
-        assert len(lbuckets) == len(rbuckets)
-        parts: List[Table] = []
-        for lb, rb in zip(lbuckets, rbuckets):
-            if lb is None or rb is None or lb.num_rows == 0 or rb.num_rows == 0:
-                continue
-            parts.append(_join_tables(lb, rb, self.left_keys, self.right_keys))
-        if not parts:
-            # No overlapping buckets: empty result with the joined schema — no IO,
-            # just empty tables with each side's pruned schema.
-            def empty_side(scan: BucketedIndexScanExec) -> Table:
-                names = scan.columns or scan.relation.schema.names
-                return Table(
-                    {n: _empty_column(scan.relation.schema.field(n).dtype) for n in names}
-                )
+        from ..ops.bucket_join import bucketed_merge_join_pairs
 
-            return _join_tables(
-                empty_side(self.left), empty_side(self.right), self.left_keys, self.right_keys
+        def concat_with_starts(scan: BucketedIndexScanExec):
+            buckets = scan.execute_buckets(ctx)
+            sizes = [0 if t is None else t.num_rows for t in buckets]
+            starts = np.zeros(len(buckets) + 1, dtype=np.int64)
+            np.cumsum(sizes, out=starts[1:])
+            tables = [t for t in buckets if t is not None and t.num_rows > 0]
+            if not tables:
+                return scan.empty_table(), starts
+            return Table.concat(tables), starts
+
+        left, l_starts = concat_with_starts(self.left)
+        right, r_starts = concat_with_starts(self.right)
+        if left.num_rows == 0 or right.num_rows == 0:
+            return _gather_verified(
+                left, right, self.left_keys, self.right_keys,
+                np.empty(0, np.int64), np.empty(0, np.int64),
             )
-        return Table.concat(parts)
+        li, ri = bucketed_merge_join_pairs(
+            _table_key64(left, self.left_keys),
+            l_starts,
+            _table_key64(right, self.right_keys),
+            r_starts,
+        )
+        return _gather_verified(left, right, self.left_keys, self.right_keys, li, ri)
 
     def simple_string(self):
         mode = " (bucketed, no exchange)" if self.bucketed else ""
@@ -359,7 +379,8 @@ def plan_physical(logical: LogicalPlan, required: Optional[List[str]] = None) ->
         rel = logical.relation
         cols = None
         if required is not None:
-            cols = [n for n in rel.schema.names if n in set(required)]
+            wanted = {r.lower() for r in required}
+            cols = [n for n in rel.schema.names if n.lower() in wanted]
         if rel.bucket_spec is not None:
             return BucketedIndexScanExec(rel, cols)
         return ScanExec(rel, cols)
@@ -388,9 +409,9 @@ def plan_physical(logical: LogicalPlan, required: Optional[List[str]] = None) ->
 
         lreq = rreq = None
         if required is not None:
-            req = set(required)
-            lreq = [n for n in lschema.names if n in req] + lkeys
-            rreq = [n for n in rschema.names if n in req] + rkeys
+            req = {r.lower() for r in required}
+            lreq = [n for n in lschema.names if n.lower() in req] + lkeys
+            rreq = [n for n in rschema.names if n.lower() in req] + rkeys
             lreq = list(dict.fromkeys(lreq))
             rreq = list(dict.fromkeys(rreq))
 
@@ -398,16 +419,37 @@ def plan_physical(logical: LogicalPlan, required: Optional[List[str]] = None) ->
         rphys = plan_physical(logical.right, rreq)
 
         # Bucketed fast path: both sides are bucketed index scans, partitioned on
-        # exactly the join keys, with equal bucket counts → no exchange needed.
-        if (
-            isinstance(lphys, BucketedIndexScanExec)
-            and isinstance(rphys, BucketedIndexScanExec)
-            and list(lphys.relation.bucket_spec.bucket_columns) == lkeys
-            and list(rphys.relation.bucket_spec.bucket_columns) == rkeys
-            and lphys.relation.bucket_spec.num_buckets
-            == rphys.relation.bucket_spec.num_buckets
+        # exactly the join keys, listing bucket columns in the same order under the
+        # L→R key mapping, with equal bucket counts → no exchange needed. (This is
+        # the planner-side re-check of the join rule's compatibility condition.)
+        if isinstance(lphys, BucketedIndexScanExec) and isinstance(
+            rphys, BucketedIndexScanExec
         ):
-            return SortMergeJoinExec(lphys, rphys, lkeys, rkeys, bucketed=True)
+            lspec = lphys.relation.bucket_spec
+            rspec = rphys.relation.bucket_spec
+            # A left key equated to two different right keys (l.a==r.x AND l.a==r.y)
+            # cannot ride the bucketed path: bucketing covers only one of the pairs.
+            pair_map: Dict[str, str] = {}
+            consistent = True
+            for l, r in zip(lkeys, rkeys):
+                if pair_map.get(l.lower(), r).lower() != r.lower():
+                    consistent = False
+                    break
+                pair_map[l.lower()] = r
+            lbc = list(lspec.bucket_columns)
+            rbc = list(rspec.bucket_columns)
+            if (
+                consistent
+                and len(set(k.lower() for k in lkeys)) == len(lkeys)
+                and lspec.num_buckets == rspec.num_buckets
+                and {c.lower() for c in lbc} == {k.lower() for k in lkeys}
+                and [pair_map.get(c.lower(), "").lower() for c in lbc]
+                == [c.lower() for c in rbc]
+            ):
+                # Join keys in bucket-column order so per-bucket key hashing pairs up.
+                jl = lbc
+                jr = [pair_map[c.lower()] for c in lbc]
+                return SortMergeJoinExec(lphys, rphys, jl, jr, bucketed=True)
 
         # General path: exchange + sort both sides.
         if isinstance(lphys, BucketedIndexScanExec):

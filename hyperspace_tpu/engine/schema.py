@@ -72,13 +72,23 @@ class Schema:
         return [f.name for f in self.fields]
 
     def field(self, name: str) -> Field:
+        """Resolve a field by name: exact match first, then unique case-insensitive
+        match (Spark-default case-insensitive resolution, which the reference's
+        E2E suite exercises both ways)."""
         for f in self.fields:
             if f.name == name:
                 return f
+        ci = [f for f in self.fields if f.name.lower() == name.lower()]
+        if len(ci) == 1:
+            return ci[0]
         raise KeyError(name)
 
     def __contains__(self, name: str) -> bool:
-        return any(f.name == name for f in self.fields)
+        try:
+            self.field(name)
+            return True
+        except KeyError:
+            return False
 
     def select(self, names: Sequence[str]) -> "Schema":
         return Schema([self.field(n) for n in names])

@@ -103,11 +103,23 @@ class Table:
     def column_names(self) -> List[str]:
         return list(self.columns.keys())
 
+    def _resolve(self, name: str) -> str:
+        """Exact match first, then unique case-insensitive match (Spark-default
+        case-insensitive column resolution)."""
+        if name in self.columns:
+            return name
+        ci = [n for n in self.columns if n.lower() == name.lower()]
+        if len(ci) == 1:
+            return ci[0]
+        raise KeyError(name)
+
     def column(self, name: str) -> Column:
-        return self.columns[name]
+        return self.columns[self._resolve(name)]
 
     def select(self, names: Sequence[str]) -> "Table":
-        return Table({n: self.columns[n] for n in names})
+        # Output columns keep the *requested* spelling (resolution is case-insensitive
+        # but the user's projection names win, matching Spark's output naming).
+        return Table({n: self.columns[self._resolve(n)] for n in names})
 
     def take(self, indices: np.ndarray) -> "Table":
         return Table({n: c.take(indices) for n, c in self.columns.items()})

@@ -1,0 +1,103 @@
+"""The Hyperspace facade + session enable/disable implicits.
+
+Parity: reference `Hyperspace.scala:24-133` (user-facing CRUD + explain; one manager
+per session via a cached context) and `package.scala:34-74` (`enableHyperspace`
+appends JoinIndexRule :: FilterIndexRule — join first, deliberately: join indexes
+typically beat filter indexes — `disableHyperspace` removes them,
+`isHyperspaceEnabled` checks).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .engine.session import DataFrame, HyperspaceSession
+from .engine.table import Table
+from .index.collection_manager import CachingIndexCollectionManager, IndexManager
+from .index.index_config import IndexConfig
+from .rules.filter_index_rule import FilterIndexRule
+from .rules.join_index_rule import JoinIndexRule
+
+_MANAGER_ATTR = "_hyperspace_index_manager"
+
+
+def _index_manager_for(session: HyperspaceSession) -> IndexManager:
+    """Per-session cached manager (the reference's HyperspaceContext,
+    `Hyperspace.scala:108-133`)."""
+    mgr = getattr(session, _MANAGER_ATTR, None)
+    if mgr is None:
+        mgr = CachingIndexCollectionManager(session)
+        setattr(session, _MANAGER_ATTR, mgr)
+    return mgr
+
+
+class Hyperspace:
+    def __init__(self, session: Optional[HyperspaceSession] = None):
+        self._session = session or HyperspaceSession.active()
+        self._manager = _index_manager_for(self._session)
+
+    # -- index CRUD (reference Hyperspace.scala:40-104) ---------------------
+
+    def create_index(self, df: DataFrame, index_config: IndexConfig) -> None:
+        self._manager.create(df, index_config)
+
+    def delete_index(self, index_name: str) -> None:
+        self._manager.delete(index_name)
+
+    def restore_index(self, index_name: str) -> None:
+        self._manager.restore(index_name)
+
+    def vacuum_index(self, index_name: str) -> None:
+        self._manager.vacuum(index_name)
+
+    def refresh_index(self, index_name: str) -> None:
+        self._manager.refresh(index_name)
+
+    def cancel(self, index_name: str) -> None:
+        self._manager.cancel(index_name)
+
+    def indexes(self) -> Table:
+        return self._manager.indexes()
+
+    def explain(self, df: DataFrame, verbose: bool = False, redirect=None) -> Optional[str]:
+        """Plan diff with indexes on vs off (reference `Hyperspace.scala:101-104`).
+        Prints unless `redirect` is given (a callable receiving the string)."""
+        from .plananalysis.plan_analyzer import explain_string
+
+        s = explain_string(df, self._session, self._manager.indexes(), verbose)
+        if redirect is not None:
+            redirect(s)
+            return None
+        print(s)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Session implicits (reference package.scala:34-74)
+# ---------------------------------------------------------------------------
+
+
+def enable_hyperspace(session: HyperspaceSession) -> HyperspaceSession:
+    """Plug the rewrite rules into the optimizer: JoinIndexRule first, then
+    FilterIndexRule (ordering is deliberate, reference `package.scala:24-33`)."""
+    if not is_hyperspace_enabled(session):
+        session.extra_optimizations = session.extra_optimizations + [
+            JoinIndexRule(),
+            FilterIndexRule(),
+        ]
+    return session
+
+
+def disable_hyperspace(session: HyperspaceSession) -> HyperspaceSession:
+    session.extra_optimizations = [
+        r
+        for r in session.extra_optimizations
+        if not isinstance(r, (JoinIndexRule, FilterIndexRule))
+    ]
+    return session
+
+
+def is_hyperspace_enabled(session: HyperspaceSession) -> bool:
+    return any(
+        isinstance(r, (JoinIndexRule, FilterIndexRule)) for r in session.extra_optimizations
+    )

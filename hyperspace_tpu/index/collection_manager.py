@@ -1,0 +1,253 @@
+"""Index management layer: CRUD orchestration + metadata cache.
+
+Parity: reference `index/IndexManager.scala:24-90` (contract),
+`index/IndexCollectionManager.scala:26-191` (wires actions to per-index log/data
+managers via factories; `indexes` summary excludes DOESNOTEXIST),
+`index/CachingIndexCollectionManager.scala:37-168` + `index/Cache.scala` (TTL read
+cache cleared by every mutation).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Optional, Sequence
+
+from ..actions import states
+from ..actions.create import CreateAction
+from ..actions.lifecycle import CancelAction, DeleteAction, RestoreAction, VacuumAction
+from ..actions.refresh import RefreshAction
+from ..engine.session import DataFrame, HyperspaceSession
+from ..engine.table import Table
+from ..exceptions import HyperspaceException
+from ..telemetry.event_logging import EventLoggerFactory
+from .builder import CoveringIndexBuilder
+from .data_manager import IndexDataManagerImpl
+from .factories import FileSystemFactory, IndexDataManagerFactory, IndexLogManagerFactory
+from .index_config import IndexConfig
+from .log_entry import IndexLogEntry
+from .path_resolver import PathResolver
+
+
+class IndexManager:
+    """CRUD + listing contract (reference `IndexManager.scala:24-90`)."""
+
+    def create(self, df: DataFrame, index_config: IndexConfig) -> None:
+        raise NotImplementedError
+
+    def delete(self, index_name: str) -> None:
+        raise NotImplementedError
+
+    def restore(self, index_name: str) -> None:
+        raise NotImplementedError
+
+    def vacuum(self, index_name: str) -> None:
+        raise NotImplementedError
+
+    def refresh(self, index_name: str) -> None:
+        raise NotImplementedError
+
+    def cancel(self, index_name: str) -> None:
+        raise NotImplementedError
+
+    def indexes(self) -> Table:
+        raise NotImplementedError
+
+    def get_indexes(self, states_filter: Optional[Sequence[str]] = None) -> List[IndexLogEntry]:
+        raise NotImplementedError
+
+
+class IndexCollectionManager(IndexManager):
+    def __init__(
+        self,
+        session: HyperspaceSession,
+        log_manager_factory: Optional[IndexLogManagerFactory] = None,
+        data_manager_factory: Optional[IndexDataManagerFactory] = None,
+        fs_factory: Optional[FileSystemFactory] = None,
+    ):
+        self._session = session
+        self._log_factory = log_manager_factory or IndexLogManagerFactory()
+        self._data_factory = data_manager_factory or IndexDataManagerFactory()
+        self._fs_factory = fs_factory or FileSystemFactory()
+        self._resolver = PathResolver(session.conf, session.fs, warehouse=session.warehouse)
+
+    def _event_logger(self):
+        return EventLoggerFactory.get_logger(self._session.hs_conf.event_logger_class)
+
+    def _managers_for(self, name: str):
+        index_path = self._resolver.get_index_path(name)
+        fs = self._fs_factory.create(index_path)
+        return (
+            self._log_factory.create(index_path, fs),
+            self._data_factory.create(index_path, fs),
+            index_path,
+        )
+
+    def _existing_log_manager(self, name: str):
+        """Resolve an EXISTING index by name (reference `withLogManager`,
+        `IndexCollectionManager.scala:107-118`)."""
+        log_mgr, data_mgr, index_path = self._managers_for(name)
+        if log_mgr.get_latest_id() is None:
+            raise HyperspaceException(f"Index with name {name} could not be found.")
+        return log_mgr, data_mgr, index_path
+
+    # -- CRUD ---------------------------------------------------------------
+
+    def create(self, df: DataFrame, index_config: IndexConfig) -> None:
+        log_mgr, data_mgr, index_path = self._managers_for(index_config.index_name)
+        latest = data_mgr.get_latest_version_id()
+        next_version = 0 if latest is None else latest + 1
+        builder = CoveringIndexBuilder(self._session)
+        CreateAction(
+            df,
+            index_config,
+            builder,
+            log_mgr,
+            index_path,
+            data_mgr.get_path(next_version),
+            self._event_logger(),
+        ).run()
+
+    def refresh(self, index_name: str) -> None:
+        log_mgr, data_mgr, index_path = self._existing_log_manager(index_name)
+        latest = data_mgr.get_latest_version_id()
+        next_version = 0 if latest is None else latest + 1
+        builder = CoveringIndexBuilder(self._session)
+        RefreshAction(
+            builder, log_mgr, index_path, data_mgr.get_path(next_version), self._event_logger()
+        ).run()
+
+    def delete(self, index_name: str) -> None:
+        log_mgr, _, _ = self._existing_log_manager(index_name)
+        DeleteAction(log_mgr, self._event_logger()).run()
+
+    def restore(self, index_name: str) -> None:
+        log_mgr, _, _ = self._existing_log_manager(index_name)
+        RestoreAction(log_mgr, self._event_logger()).run()
+
+    def vacuum(self, index_name: str) -> None:
+        log_mgr, data_mgr, _ = self._existing_log_manager(index_name)
+        VacuumAction(data_mgr, log_mgr, self._event_logger()).run()
+
+    def cancel(self, index_name: str) -> None:
+        log_mgr, _, _ = self._existing_log_manager(index_name)
+        CancelAction(log_mgr, self._event_logger()).run()
+
+    # -- listing (reference IndexCollectionManager.scala:79-105) ------------
+
+    def get_indexes(self, states_filter: Optional[Sequence[str]] = None) -> List[IndexLogEntry]:
+        system = self._resolver.system_path()
+        fs = self._session.fs
+        out: List[IndexLogEntry] = []
+        if not fs.exists(system):
+            return out
+        for st in fs.list_status(system):
+            if not st.is_dir:
+                continue
+            log_mgr = self._log_factory.create(st.path, self._fs_factory.create(st.path))
+            entry = log_mgr.get_latest_log()
+            if entry is None:
+                continue
+            if states_filter is None or entry.state in states_filter:
+                out.append(entry)
+        return out
+
+    def indexes(self) -> Table:
+        """Summary table (reference `IndexSummary`, :151-191), excluding DOESNOTEXIST."""
+        rows = {
+            "name": [],
+            "indexedColumns": [],
+            "includedColumns": [],
+            "numBuckets": [],
+            "schema": [],
+            "indexLocation": [],
+            "state": [],
+        }
+        for e in self.get_indexes():
+            if e.state == states.DOESNOTEXIST:
+                continue
+            rows["name"].append(e.name)
+            rows["indexedColumns"].append(",".join(e.indexed_columns))
+            rows["includedColumns"].append(",".join(e.included_columns))
+            rows["numBuckets"].append(e.num_buckets)
+            rows["schema"].append(e.schema_json)
+            rows["indexLocation"].append(e.index_location())
+            rows["state"].append(e.state)
+        return Table.from_pydict(rows)
+
+
+# ---------------------------------------------------------------------------
+# Caching wrapper (reference CachingIndexCollectionManager.scala + Cache.scala)
+# ---------------------------------------------------------------------------
+
+
+class CreationTimeBasedIndexCache:
+    """TTL cache of the full entry list (reference `CreationTimeBasedIndexCache`,
+    :117-168)."""
+
+    def __init__(self, expiry_seconds_fn):
+        self._expiry_fn = expiry_seconds_fn
+        self._entries: Optional[List[IndexLogEntry]] = None
+        self._set_time: float = 0.0
+
+    def get(self) -> Optional[List[IndexLogEntry]]:
+        if self._entries is None:
+            return None
+        if time.time() - self._set_time > self._expiry_fn():
+            self.clear()
+            return None
+        return self._entries
+
+    def set(self, entries: List[IndexLogEntry]) -> None:
+        self._entries = list(entries)
+        self._set_time = time.time()
+
+    def clear(self) -> None:
+        self._entries = None
+        self._set_time = 0.0
+
+
+class CachingIndexCollectionManager(IndexCollectionManager):
+    """Read-path cache; every mutating API clears it (reference :77-100)."""
+
+    def __init__(self, session: HyperspaceSession, **kwargs):
+        super().__init__(session, **kwargs)
+        self._cache = CreationTimeBasedIndexCache(
+            lambda: session.hs_conf.cache_expiry_seconds
+        )
+
+    def get_indexes(self, states_filter: Optional[Sequence[str]] = None) -> List[IndexLogEntry]:
+        cached = self._cache.get()
+        if cached is None:
+            cached = super().get_indexes(None)
+            self._cache.set(cached)
+        if states_filter is None:
+            return list(cached)
+        return [e for e in cached if e.state in states_filter]
+
+    def clear_cache(self) -> None:
+        self._cache.clear()
+
+    def create(self, df, index_config) -> None:
+        self.clear_cache()
+        super().create(df, index_config)
+
+    def delete(self, index_name: str) -> None:
+        self.clear_cache()
+        super().delete(index_name)
+
+    def restore(self, index_name: str) -> None:
+        self.clear_cache()
+        super().restore(index_name)
+
+    def vacuum(self, index_name: str) -> None:
+        self.clear_cache()
+        super().vacuum(index_name)
+
+    def refresh(self, index_name: str) -> None:
+        self.clear_cache()
+        super().refresh(index_name)
+
+    def cancel(self, index_name: str) -> None:
+        self.clear_cache()
+        super().cancel(index_name)

@@ -1,0 +1,90 @@
+"""Signature providers: fingerprint a query plan to decide index applicability.
+
+Parity: reference `index/LogicalPlanSignatureProvider.scala` (trait + reflective
+factory), `FileBasedSignatureProvider.scala:39-79` (md5 fold over every source file's
+(length, modTime, path)), `PlanSignatureProvider.scala:36-43` (fold over operator
+names), `IndexSignatureProvider.scala:33-49` (combined = default). An index created
+against a plan is applicable to a query iff the recorded provider recomputes the same
+signature on the query's plan — this is what makes the rewrite rules safe against
+changed source data.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..engine.logical import LogicalPlan, ScanNode
+from ..exceptions import HyperspaceException
+from ..util.hashing_utils import md5_hex
+
+
+class LogicalPlanSignatureProvider:
+    """Contract: signature(plan) -> hex digest or None if the plan is unsupported."""
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    def signature(self, plan: LogicalPlan) -> Optional[str]:
+        raise NotImplementedError
+
+
+class FileBasedSignatureProvider(LogicalPlanSignatureProvider):
+    """Fingerprint of all source data files reachable from the plan's relations
+    (reference `FileBasedSignatureProvider.scala:48-66`)."""
+
+    def signature(self, plan: LogicalPlan) -> Optional[str]:
+        acc = ""
+        found = False
+        for node in plan.collect_nodes():
+            if isinstance(node, ScanNode):
+                found = True
+                for f in node.relation.files:
+                    acc = md5_hex(acc + f"{f.size}{f.modified_time}{f.path}")
+        return acc if found else None
+
+
+class PlanSignatureProvider(LogicalPlanSignatureProvider):
+    """Fingerprint of the plan shape: fold over operator names
+    (reference `PlanSignatureProvider.scala:36-43`)."""
+
+    def signature(self, plan: LogicalPlan) -> Optional[str]:
+        acc = ""
+        for node in plan.collect_nodes():
+            acc = md5_hex(acc + type(node).__name__)
+        return acc
+
+
+class IndexSignatureProvider(LogicalPlanSignatureProvider):
+    """Combined file+plan fingerprint — the default recorded by index creation
+    (reference `IndexSignatureProvider.scala:33-49`)."""
+
+    def signature(self, plan: LogicalPlan) -> Optional[str]:
+        f = FileBasedSignatureProvider().signature(plan)
+        if f is None:
+            return None
+        p = PlanSignatureProvider().signature(plan)
+        return md5_hex(f + p)
+
+
+_BUILTIN = {
+    "IndexSignatureProvider": IndexSignatureProvider,
+    "FileBasedSignatureProvider": FileBasedSignatureProvider,
+    "PlanSignatureProvider": PlanSignatureProvider,
+}
+
+
+def create_provider(name: Optional[str] = None) -> LogicalPlanSignatureProvider:
+    """Factory; default = IndexSignatureProvider; dotted paths load reflectively
+    (reference `LogicalPlanSignatureProvider.scala:28-62`)."""
+    if name is None:
+        return IndexSignatureProvider()
+    if name in _BUILTIN:
+        return _BUILTIN[name]()
+    import importlib
+
+    module_name, _, attr = name.rpartition(".")
+    if not module_name:
+        raise HyperspaceException(f"Unknown signature provider: {name}")
+    mod = importlib.import_module(module_name)
+    return getattr(mod, attr)()

@@ -1,0 +1,54 @@
+"""On-device bucketize: hash-partition + in-bucket sort in ONE XLA sort.
+
+This is the TPU replacement for the reference's index-build hot path —
+`df.repartition(numBuckets, indexedCols)` (a full Spark shuffle) followed by
+per-bucket sort in the bucketed writer (`CreateActionBase.scala:119-140`,
+`DataFrameWriterExtensions.scala:49-81`). Here both steps collapse into a single
+`lax.sort` over the composite key (bucket_id, indexed_cols...): after the sort, rows
+are grouped by bucket AND sorted by the indexed columns within each bucket, so bucket
+extraction is a contiguous slice. Static shapes throughout; one device sort is the
+whole job.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..engine.table import Column, Table
+from .hashing import bucket_id
+
+
+@partial(jax.jit, static_argnums=(2,))
+def _sort_perm(bucket, keys: Tuple, n: int):
+    """Permutation ordering rows by (bucket, key1, key2, ...)."""
+    iota = jnp.arange(n, dtype=jnp.int32)
+    operands = (bucket, *keys, iota)
+    res = jax.lax.sort(operands, num_keys=1 + len(keys))
+    return res[-1], res[0]  # (permutation, sorted bucket ids)
+
+
+def _sortable(arr: jnp.ndarray) -> jnp.ndarray:
+    if arr.dtype == jnp.bool_:
+        return arr.astype(jnp.int32)
+    return arr
+
+
+def bucketize_table(
+    table: Table, bucket_columns: Sequence[str], num_buckets: int
+) -> Tuple[Table, np.ndarray]:
+    """Hash-partition `table` into `num_buckets` by `bucket_columns`, sorted by those
+    columns within each bucket. Returns (reordered table, bucket start offsets of
+    length num_buckets+1): bucket b = rows[starts[b]:starts[b+1]]."""
+    cols = [table.column(c) for c in bucket_columns]
+    arrs = [jnp.asarray(c.data) for c in cols]
+    b = bucket_id(cols, arrs, num_buckets)
+    perm, sorted_b = _sort_perm(b, tuple(_sortable(a) for a in arrs), table.num_rows)
+    perm_host = np.asarray(perm)
+    sorted_b_host = np.asarray(sorted_b)
+    starts = np.searchsorted(sorted_b_host, np.arange(num_buckets + 1))
+    return table.take(perm_host), starts

@@ -1,0 +1,2 @@
+from .filter_index_rule import FilterIndexRule  # noqa: F401
+from .join_index_rule import JoinIndexRule  # noqa: F401

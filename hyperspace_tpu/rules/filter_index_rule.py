@@ -1,0 +1,138 @@
+"""FilterIndexRule: rewrite Filter-over-relation plans to scan a covering index.
+
+Parity: reference `index/rules/FilterIndexRule.scala:38-253`:
+- Pattern: Project? > Filter > Relation (via `ExtractFilterNode`, :211-253).
+- Applicability: the index covers all output + filter columns AND the filter references
+  the head (first) indexed column (:183-195).
+- Rewrite: replace the relation with a parquet scan over the index's files, with NO
+  bucket spec — full scan parallelism is preferred for filters (:100-132).
+- Ranking is first-candidate (reference TODO, :202-208).
+- Any exception → return the original plan unchanged (:74-78).
+- Emits HyperspaceIndexUsageEvent on success (:121-127).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..engine.expr import Expr
+from ..engine.logical import (
+    FilterNode,
+    LogicalPlan,
+    ProjectNode,
+    ScanNode,
+    SourceRelation,
+)
+from ..index.log_entry import IndexLogEntry
+from ..telemetry.event_logging import EventLoggerFactory
+from ..telemetry.events import HyperspaceIndexUsageEvent
+from ..util.resolver_utils import resolve, resolve_all
+from .rule_utils import get_candidate_indexes, index_files_as_statuses
+
+
+def _extract_filter_node(plan: LogicalPlan):
+    """Match Project?>Filter>Scan; returns (project_or_none, filter, scan) or None."""
+    if isinstance(plan, ProjectNode) and isinstance(plan.child, FilterNode):
+        f = plan.child
+        if isinstance(f.child, ScanNode):
+            return plan, f, f.child
+    if isinstance(plan, FilterNode) and isinstance(plan.child, ScanNode):
+        return None, plan, plan.child
+    return None
+
+
+def index_covers_plan(
+    output_columns: List[str],
+    filter_columns: List[str],
+    entry: IndexLogEntry,
+    case_sensitive: bool = False,
+) -> bool:
+    """All referenced columns ⊆ index columns AND the filter references the head
+    indexed column (reference :183-195)."""
+    index_cols = entry.indexed_columns + entry.included_columns
+    head = entry.indexed_columns[0]
+    if resolve(head, filter_columns, case_sensitive) is None:
+        return False
+    return resolve_all(output_columns + filter_columns, index_cols, case_sensitive) is not None
+
+
+class FilterIndexRule:
+    """Rule protocol: apply(plan, session) -> plan."""
+
+    def apply(self, plan: LogicalPlan, session) -> LogicalPlan:
+        from ..hyperspace import _index_manager_for  # late import to avoid cycle
+
+        try:
+            index_manager = _index_manager_for(session)
+
+            def rewrite(node: LogicalPlan) -> LogicalPlan:
+                m = _extract_filter_node(node)
+                if m is None:
+                    return node
+                project, filt, scan = m
+                if scan.relation.index_name is not None:
+                    return node  # already rewritten
+                output_columns = (
+                    project.column_names if project is not None else scan.output_schema.names
+                )
+                filter_columns = sorted(filt.condition.references())
+                candidates = get_candidate_indexes(index_manager, scan)
+                usable = [
+                    e
+                    for e in candidates
+                    if index_covers_plan(list(output_columns), filter_columns, e)
+                ]
+                if not usable:
+                    return node
+                best = rank(usable)
+                new_scan = ScanNode(_index_relation(best))
+                new_filter = FilterNode(filt.condition, new_scan)
+                # Always project: preserves the original output column order (the
+                # index stores columns in indexed+included order, not source order).
+                new_plan: LogicalPlan = ProjectNode(list(output_columns), new_filter)
+                EventLoggerFactory.get_logger(
+                    session.hs_conf.event_logger_class
+                ).log_event(
+                    HyperspaceIndexUsageEvent(
+                        index_names=[best.name],
+                        plan_before=node.tree_string(),
+                        plan_after=new_plan.tree_string(),
+                        message="Filter index rule applied.",
+                    )
+                )
+                return new_plan
+
+            return plan.transform_up(rewrite)
+        except Exception:
+            # Never break the user's query over an index problem (reference :74-78).
+            return plan
+
+
+def rank(candidates: List[IndexLogEntry]) -> IndexLogEntry:
+    """FilterIndexRanker: first candidate (reference TODO at :202-208)."""
+    return candidates[0]
+
+
+def _index_relation(entry: IndexLogEntry, with_bucket_spec: bool = False) -> SourceRelation:
+    """Build the substituted relation over the index's own data files.
+
+    No BucketSpec for filter scans (parallelism over all files, reference :100-132);
+    the join rule passes with_bucket_spec=True."""
+    from ..engine.logical import BucketSpec
+    from ..engine.schema import Schema
+
+    spec = None
+    if with_bucket_spec:
+        spec = BucketSpec(
+            num_buckets=entry.num_buckets,
+            bucket_columns=tuple(entry.indexed_columns),
+            sort_columns=tuple(entry.indexed_columns),
+        )
+    return SourceRelation(
+        root_paths=[entry.index_location()],
+        file_format="parquet",
+        schema=Schema.from_json_string(entry.schema_json),
+        files=index_files_as_statuses(entry),
+        bucket_spec=spec,
+        index_name=entry.name,
+    )

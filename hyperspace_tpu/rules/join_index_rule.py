@@ -1,0 +1,204 @@
+"""JoinIndexRule: rewrite equi-joins to co-bucketed, shuffle-free index joins.
+
+Parity: reference `index/rules/JoinIndexRule.scala:54-564`:
+- `transformUp` on inner Join nodes (:59-87).
+- Applicability: condition is equi-join CNF (`EqualTo`/`And` only, :188-194); both
+  subplans linear with a single base relation (:219-220); every condition column maps
+  L↔R in an exclusive one-to-one fashion (:287-326).
+- Index selection per side (:407-418, :481-493): the index's indexed columns must be
+  set-equal to that side's join columns, and every column of the side referenced in
+  the plan must be covered by the index.
+- Compatible pairs (:516-563): both indexes must list their indexed columns in the
+  same order under the L→R mapping — this is what makes bucket b of the left index
+  hold exactly the keys that bucket b of the right index holds.
+- Ranking via JoinIndexRanker; rewrite substitutes each side's relation with its index
+  scan WITH a BucketSpec so the sort-merge join runs with no shuffle (:137-162).
+- Any exception → original plan; emits HyperspaceIndexUsageEvent on success.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..engine.expr import Expr, extract_equi_join_keys
+from ..engine.logical import JoinNode, LogicalPlan, ScanNode, find_single_relation
+from ..index.log_entry import IndexLogEntry
+from ..telemetry.event_logging import EventLoggerFactory
+from ..telemetry.events import HyperspaceIndexUsageEvent
+from .rule_utils import get_candidate_indexes
+
+
+def _lower(names) -> List[str]:
+    return [n.lower() for n in names]
+
+
+def _collect_expr_refs(plan: LogicalPlan) -> List[str]:
+    refs: List[str] = []
+    from ..engine.logical import FilterNode, ProjectNode
+
+    for node in plan.collect_nodes():
+        if isinstance(node, FilterNode):
+            refs.extend(node.condition.references())
+        elif isinstance(node, ProjectNode):
+            refs.extend(node.column_names)
+    return refs
+
+
+def _orient_pairs(
+    pairs: List[Tuple[str, str]], lschema_names: List[str], rschema_names: List[str]
+) -> Optional[List[Tuple[str, str]]]:
+    """Orient each (a, b) pair as (left_col, right_col); None if any column is
+    ambiguous or unresolvable (reference requires attrs to resolve to exactly one
+    base relation, :287-326)."""
+    lset, rset = set(_lower(lschema_names)), set(_lower(rschema_names))
+    out = []
+    for a, b in pairs:
+        al, bl = a.lower(), b.lower()
+        a_in_l, a_in_r = al in lset, al in rset
+        b_in_l, b_in_r = bl in lset, bl in rset
+        if a_in_l and b_in_r and not (a_in_r or b_in_l):
+            out.append((a, b))
+        elif a_in_r and b_in_l and not (a_in_l or b_in_r):
+            out.append((b, a))
+        else:
+            return None  # ambiguous or not from the two base relations
+    return out
+
+
+def _one_to_one(oriented: List[Tuple[str, str]]) -> Optional[Dict[str, str]]:
+    """Exclusive one-to-one L→R column mapping; duplicates of the same pair are fine,
+    conflicting mappings are not (reference :287-326)."""
+    fwd: Dict[str, str] = {}
+    bwd: Dict[str, str] = {}
+    for l, r in oriented:
+        ll, rl = l.lower(), r.lower()
+        if fwd.get(ll, rl) != rl or bwd.get(rl, ll) != ll:
+            return None
+        fwd[ll] = rl
+        bwd[rl] = ll
+    return fwd
+
+
+def _usable_indexes(
+    candidates: List[IndexLogEntry], join_cols: List[str], required_cols: List[str]
+) -> List[IndexLogEntry]:
+    """indexedCols set-equal to join cols AND all required ⊆ index cols
+    (reference :481-493)."""
+    out = []
+    jset = set(_lower(join_cols))
+    rset = set(_lower(required_cols))
+    for e in candidates:
+        indexed = set(_lower(e.indexed_columns))
+        all_cols = set(_lower(e.indexed_columns + e.included_columns))
+        if indexed == jset and rset <= all_cols:
+            out.append(e)
+    return out
+
+
+def _compatible_pairs(
+    l_indexes: List[IndexLogEntry],
+    r_indexes: List[IndexLogEntry],
+    l_to_r: Dict[str, str],
+) -> List[Tuple[IndexLogEntry, IndexLogEntry]]:
+    """Pairs listing indexed columns in the same order under the mapping
+    (reference :516-563)."""
+    out = []
+    for li in l_indexes:
+        mapped = [l_to_r[c] for c in _lower(li.indexed_columns)]
+        for ri in r_indexes:
+            if _lower(ri.indexed_columns) == mapped:
+                out.append((li, ri))
+    return out
+
+
+def rank_join_pairs(
+    pairs: List[Tuple[IndexLogEntry, IndexLogEntry]]
+) -> List[Tuple[IndexLogEntry, IndexLogEntry]]:
+    """JoinIndexRanker: equal-bucket pairs first (zero shuffle), then higher bucket
+    counts (more parallelism) (reference `rankers/JoinIndexRanker.scala:40-55`)."""
+
+    def key(p):
+        li, ri = p
+        equal = li.num_buckets == ri.num_buckets
+        return (0 if equal else 1, -(li.num_buckets + ri.num_buckets))
+
+    return sorted(pairs, key=key)
+
+
+class JoinIndexRule:
+    """Rule protocol: apply(plan, session) -> plan."""
+
+    def apply(self, plan: LogicalPlan, session) -> LogicalPlan:
+        from .filter_index_rule import _index_relation
+        from ..hyperspace import _index_manager_for
+
+        try:
+            index_manager = _index_manager_for(session)
+
+            def rewrite(node: LogicalPlan) -> LogicalPlan:
+                if not isinstance(node, JoinNode) or node.how != "inner":
+                    return node
+                pairs = extract_equi_join_keys(node.condition)
+                if not pairs:
+                    return node
+                l_scan = find_single_relation(node.left)
+                r_scan = find_single_relation(node.right)
+                if l_scan is None or r_scan is None:
+                    return node
+                if l_scan.relation.index_name or r_scan.relation.index_name:
+                    return node  # already rewritten
+
+                lnames = l_scan.output_schema.names
+                rnames = r_scan.output_schema.names
+                oriented = _orient_pairs(pairs, lnames, rnames)
+                if oriented is None:
+                    return node
+                l_to_r = _one_to_one(oriented)
+                if l_to_r is None:
+                    return node
+
+                lkeys = list(dict.fromkeys(l for l, _ in oriented))
+                rkeys = [l_to_r[k.lower()] for k in lkeys]
+
+                l_required = list(dict.fromkeys(lnames + _collect_expr_refs(node.left)))
+                r_required = list(dict.fromkeys(rnames + _collect_expr_refs(node.right)))
+
+                l_candidates = get_candidate_indexes(index_manager, l_scan)
+                r_candidates = get_candidate_indexes(index_manager, r_scan)
+                l_usable = _usable_indexes(l_candidates, lkeys, l_required)
+                r_usable = _usable_indexes(r_candidates, rkeys, r_required)
+                compatible = _compatible_pairs(l_usable, r_usable, l_to_r)
+                if not compatible:
+                    return node
+                li, ri = rank_join_pairs(compatible)[0]
+
+                def substitute(side: LogicalPlan, scan: ScanNode, entry: IndexLogEntry):
+                    new_rel = _index_relation(entry, with_bucket_spec=True)
+
+                    def replace(n: LogicalPlan) -> LogicalPlan:
+                        if n is scan or (
+                            isinstance(n, ScanNode) and n.relation is scan.relation
+                        ):
+                            return ScanNode(new_rel)
+                        return n
+
+                    return side.transform_up(replace)
+
+                new_left = substitute(node.left, l_scan, li)
+                new_right = substitute(node.right, r_scan, ri)
+                new_plan = JoinNode(new_left, new_right, node.condition, node.how)
+                EventLoggerFactory.get_logger(
+                    session.hs_conf.event_logger_class
+                ).log_event(
+                    HyperspaceIndexUsageEvent(
+                        index_names=[li.name, ri.name],
+                        plan_before=node.tree_string(),
+                        plan_after=new_plan.tree_string(),
+                        message="Join index rule applied.",
+                    )
+                )
+                return new_plan
+
+            return plan.transform_up(rewrite)
+        except Exception:
+            return plan

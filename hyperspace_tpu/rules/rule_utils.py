@@ -1,0 +1,45 @@
+"""Shared rule machinery.
+
+Parity: reference `index/rules/RuleUtils.scala` — `getCandidateIndexes` fetches ACTIVE
+indexes and keeps those whose recorded signature provider recomputes the same
+signature on the query's relation node (memoized per provider name);
+`getLogicalRelation` extracts the single relation of a linear plan.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..actions import states
+from ..engine.logical import LogicalPlan, ScanNode, find_single_relation
+from ..index.log_entry import FileInfo, IndexLogEntry
+from ..index.signatures import create_provider
+from ..storage.filesystem import FileStatus
+
+
+def get_candidate_indexes(index_manager, plan: LogicalPlan) -> List[IndexLogEntry]:
+    """ACTIVE indexes whose signature matches `plan` (normally a relation node)."""
+    signature_map: Dict[str, Optional[str]] = {}
+
+    def signature_valid(entry: IndexLogEntry) -> bool:
+        source_sig = entry.signature()
+        if source_sig.provider not in signature_map:
+            provider = create_provider(source_sig.provider)
+            signature_map[source_sig.provider] = provider.signature(plan)
+        computed = signature_map[source_sig.provider]
+        return computed is not None and computed == source_sig.value
+
+    all_indexes = index_manager.get_indexes([states.ACTIVE])
+    return [e for e in all_indexes if e.created and signature_valid(e)]
+
+
+def get_scan_node(plan: LogicalPlan) -> Optional[ScanNode]:
+    return find_single_relation(plan)
+
+
+def index_files_as_statuses(entry: IndexLogEntry) -> List[FileStatus]:
+    """The index's data files as FileStatus (for building the substituted relation)."""
+    return [
+        FileStatus(path=f.name, size=f.size, modified_time=f.modified_time, is_dir=False)
+        for f in entry.content.file_infos()
+    ]
