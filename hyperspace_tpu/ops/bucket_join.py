@@ -56,9 +56,12 @@ def _probe(ls, rs, l_len, r_len):
     return lo, counts
 
 
-@partial(jax.jit, static_argnums=(6,))
 def _expand(lo, counts, l_order, r_order, l_starts, r_starts, total: int):
-    """Expand count ranges into global (left_row, right_row) index pairs."""
+    """Expand count ranges into global (left_row, right_row) index pairs.
+
+    Deliberately NOT jitted: `total` is data-dependent, so a jit keyed on it would
+    recompile for every distinct join result size (same reasoning as
+    `ops.join.merge_join_pairs`)."""
     B, cap = counts.shape
     counts_flat = counts.reshape(-1)
     lo_flat = lo.reshape(-1)

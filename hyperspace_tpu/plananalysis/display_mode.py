@@ -1,0 +1,55 @@
+"""Explain display modes.
+
+Parity: reference `index/plananalysis/DisplayMode.scala:26-89` — `PlainTextMode`
+(`<---- ---->` highlight tags), `HTMLMode` (`<pre>` wrap, bold highlights, `<br/>`
+newlines), `ConsoleMode` (ANSI green background); begin/end tags overridable via conf.
+"""
+
+from __future__ import annotations
+
+from ..config import IndexConstants, SessionConf
+
+
+class DisplayMode:
+    new_line = "\n"
+    begin_end_tag = ("", "")
+
+    def __init__(self, conf: SessionConf):
+        self.highlight_tag = (
+            conf.get(IndexConstants.HIGHLIGHT_BEGIN_TAG, self.default_highlight[0]),
+            conf.get(IndexConstants.HIGHLIGHT_END_TAG, self.default_highlight[1]),
+        )
+
+    @property
+    def default_highlight(self):
+        return ("", "")
+
+
+class PlainTextMode(DisplayMode):
+    @property
+    def default_highlight(self):
+        return ("<----", "---->")
+
+
+class HTMLMode(DisplayMode):
+    new_line = "<br/>"
+    begin_end_tag = ("<pre>", "</pre>")
+
+    @property
+    def default_highlight(self):
+        return ('<b style="background: #ff9900">', "</b>")
+
+
+class ConsoleMode(DisplayMode):
+    @property
+    def default_highlight(self):
+        return ("[42m", "[0m")
+
+
+def create_display_mode(conf: SessionConf) -> DisplayMode:
+    name = (conf.get(IndexConstants.DISPLAY_MODE) or "plaintext").lower()
+    if name == "html":
+        return HTMLMode(conf)
+    if name == "console":
+        return ConsoleMode(conf)
+    return PlainTextMode(conf)

@@ -1,0 +1,34 @@
+"""Physical operator frequency analysis (reference
+`index/plananalysis/PhysicalOperatorAnalyzer.scala:30-57`): counts operator
+occurrences per plan and pairs the two plans' counts for the explain verbose table."""
+
+from __future__ import annotations
+
+from collections import Counter
+from dataclasses import dataclass
+from typing import Dict, List
+
+from ..engine.physical import PhysicalNode
+
+
+@dataclass
+class PhysicalOperatorComparison:
+    name: str
+    num_occurrences_before: int
+    num_occurrences_after: int
+
+
+def count_operators(plan: PhysicalNode) -> Dict[str, int]:
+    return Counter(n.name for n in plan.collect_nodes())
+
+
+def compare_operators(
+    before: PhysicalNode, after: PhysicalNode
+) -> List[PhysicalOperatorComparison]:
+    b = count_operators(before)
+    a = count_operators(after)
+    names = sorted(set(b) | set(a))
+    return [
+        PhysicalOperatorComparison(n, b.get(n, 0), a.get(n, 0))
+        for n in names
+    ]

@@ -1,0 +1,114 @@
+"""PlanAnalyzer: the explain subsystem.
+
+Parity: reference `index/plananalysis/PlanAnalyzer.scala:34-410` — compiles the
+physical plan twice (Hyperspace enabled vs disabled; no job executed), prints both
+trees highlighting the subtrees that differ, lists "Indexes used" (matched by index
+location against the chosen plan's scans), and in verbose mode a physical-operator
+frequency diff table.
+"""
+
+from __future__ import annotations
+
+from typing import List, Set
+
+from ..engine.physical import PhysicalNode
+from ..engine.session import DataFrame, HyperspaceSession
+from .buffer_stream import BufferStream
+from .display_mode import create_display_mode
+from .op_analyzer import compare_operators
+
+
+def _subtree_strings(plan: PhysicalNode) -> Set[str]:
+    return {n.tree_string() for n in plan.collect_nodes()}
+
+
+def _write_plan(buffer: BufferStream, plan: PhysicalNode, other: PhysicalNode) -> None:
+    """Write a plan tree, highlighting every node whose subtree does not appear in
+    the other plan (the whole differing subtree ends up highlighted)."""
+    other_subtrees = _subtree_strings(other)
+
+    def walk(node: PhysicalNode, indent: int):
+        line = "  " * indent + ("+- " if indent else "") + node.simple_string()
+        if node.tree_string() in other_subtrees:
+            buffer.write_line(line)
+        else:
+            buffer.highlight_line(line)
+        for c in node.children():
+            walk(c, indent + 1)
+
+    walk(plan, 0)
+
+
+def _with_hyperspace_state(df: DataFrame, session: HyperspaceSession, enabled: bool) -> PhysicalNode:
+    """Compile the physical plan with hyperspace forced on/off, restoring the session
+    state afterwards (reference `withHyperspaceState`, :341-360)."""
+    from ..hyperspace import disable_hyperspace, enable_hyperspace, is_hyperspace_enabled
+
+    was_enabled = is_hyperspace_enabled(session)
+    try:
+        (enable_hyperspace if enabled else disable_hyperspace)(session)
+        return df.physical_plan()
+    finally:
+        (enable_hyperspace if was_enabled else disable_hyperspace)(session)
+
+
+def explain_string(
+    df: DataFrame,
+    session: HyperspaceSession,
+    indexes_table,
+    verbose: bool = False,
+) -> str:
+    mode = create_display_mode(session.conf)
+    buffer = BufferStream(mode)
+
+    plan_with = _with_hyperspace_state(df, session, enabled=True)
+    plan_without = _with_hyperspace_state(df, session, enabled=False)
+
+    buffer.write_line("=============================================================")
+    buffer.write_line("Plan with indexes:")
+    buffer.write_line("=============================================================")
+    _write_plan(buffer, plan_with, plan_without)
+    buffer.write_line()
+
+    buffer.write_line("=============================================================")
+    buffer.write_line("Plan without indexes:")
+    buffer.write_line("=============================================================")
+    _write_plan(buffer, plan_without, plan_with)
+    buffer.write_line()
+
+    buffer.write_line("=============================================================")
+    buffer.write_line("Indexes used:")
+    buffer.write_line("=============================================================")
+    used = {}
+    for n in plan_with.collect_nodes():
+        rel = getattr(n, "relation", None)
+        if rel is not None and rel.index_name:
+            used[rel.index_name] = rel.root_paths[0]
+    idx = indexes_table.to_pydict() if indexes_table.num_rows else {"name": [], "indexLocation": []}
+    for name, location in sorted(used.items()):
+        # Cross-check against the registry like the reference (:209-221).
+        if name in idx.get("name", []):
+            i = idx["name"].index(name)
+            location = idx["indexLocation"][i]
+        buffer.write_line(f"{name}:{location}")
+    buffer.write_line()
+
+    if verbose:
+        buffer.write_line("=============================================================")
+        buffer.write_line("Physical operator stats:")
+        buffer.write_line("=============================================================")
+        comparisons = compare_operators(plan_without, plan_with)
+        name_w = max([len("Physical Operator")] + [len(c.name) for c in comparisons]) + 2
+        header = (
+            f"{'Physical Operator':<{name_w}}|{'Hyperspace Disabled':>21}|"
+            f"{'Hyperspace Enabled':>20}|{'Difference':>12}"
+        )
+        buffer.write_line(header)
+        buffer.write_line("-" * len(header))
+        for c in comparisons:
+            diff = c.num_occurrences_after - c.num_occurrences_before
+            buffer.write_line(
+                f"{c.name:<{name_w}}|{c.num_occurrences_before:>21}|"
+                f"{c.num_occurrences_after:>20}|{diff:>12}"
+            )
+    return buffer.to_string()

@@ -160,8 +160,19 @@ class JoinIndexRule:
                 lkeys = list(dict.fromkeys(l for l, _ in oriented))
                 rkeys = [l_to_r[k.lower()] for k in lkeys]
 
-                l_required = list(dict.fromkeys(lnames + _collect_expr_refs(node.left)))
-                r_required = list(dict.fromkeys(rnames + _collect_expr_refs(node.right)))
+                # Required = the side plan's OUTPUT (post-projection) + every column
+                # referenced inside the side (filters/projects) + its join keys — not
+                # the base relation's full schema (reference :407-418).
+                l_required = list(
+                    dict.fromkeys(
+                        node.left.output_schema.names + _collect_expr_refs(node.left) + lkeys
+                    )
+                )
+                r_required = list(
+                    dict.fromkeys(
+                        node.right.output_schema.names + _collect_expr_refs(node.right) + rkeys
+                    )
+                )
 
                 l_candidates = get_candidate_indexes(index_manager, l_scan)
                 r_candidates = get_candidate_indexes(index_manager, r_scan)

@@ -1,0 +1,1 @@
+from .plan_serde import deserialize_plan, serialize_plan  # noqa: F401

@@ -233,6 +233,31 @@ class TestJoinIndexE2E:
         q = l.join(o, col("orderkey") == col("o_orderkey")).select("qty", "o_status")
         assert scanned_index_names(q) == set()
 
+    def test_join_side_projection_narrows_required_columns(self, session, tmp_path):
+        """A projection on a join side means the index only needs to cover the
+        projected + key columns, not the relation's full schema."""
+        session.write_parquet(
+            {"orderkey": [1, 2], "qty": [5, 6], "extra1": [0, 0], "extra2": [0, 0]},
+            str(tmp_path / "wide"),
+        )
+        session.write_parquet({"o_orderkey": [1, 2], "o_status": ["O", "F"]}, str(tmp_path / "o2"))
+        hs = Hyperspace(session)
+        hs.create_index(
+            session.read.parquet(str(tmp_path / "wide")),
+            IndexConfig("wideIdx", ["orderkey"], ["qty"]),  # does NOT cover extra1/2
+        )
+        hs.create_index(
+            session.read.parquet(str(tmp_path / "o2")),
+            IndexConfig("o2Idx", ["o_orderkey"], ["o_status"]),
+        )
+
+        def make_df():
+            l = session.read.parquet(str(tmp_path / "wide")).select("orderkey", "qty")
+            o = session.read.parquet(str(tmp_path / "o2"))
+            return l.join(o, col("orderkey") == col("o_orderkey")).select("qty", "o_status")
+
+        verify_index_usage(session, make_df, ["wideIdx", "o2Idx"])
+
     def test_join_requires_indexed_cols_equal_join_cols(self, session, tmp_path):
         """An index whose indexed cols are a superset of the join cols is NOT usable
         (reference: set equality required)."""

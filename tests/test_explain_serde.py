@@ -1,0 +1,180 @@
+"""Explain subsystem + plan serde + cache tests.
+
+Mirrors reference `ExplainTest` (golden-ish assertions on explain output in display
+modes), `PhysicalOperatorAnalyzerTest`, `BufferStreamTest`, `DisplayModeTest`,
+`LogicalPlanSerDeTests` (round-trip), `IndexCacheTest`.
+"""
+
+import time
+
+import pytest
+
+from hyperspace_tpu import IndexConfig, IndexConstants
+from hyperspace_tpu.engine import HyperspaceSession, col
+from hyperspace_tpu.hyperspace import Hyperspace, enable_hyperspace
+from hyperspace_tpu.plananalysis import (
+    BufferStream,
+    ConsoleMode,
+    HTMLMode,
+    PlainTextMode,
+    create_display_mode,
+)
+from hyperspace_tpu.serde import deserialize_plan, serialize_plan
+
+
+@pytest.fixture()
+def session(tmp_path):
+    s = HyperspaceSession(warehouse=str(tmp_path))
+    s.conf.set(IndexConstants.INDEX_SYSTEM_PATH, str(tmp_path / "indexes"))
+    s.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
+    return s
+
+
+SAMPLE = {
+    "c1": ["a", "b", "c", "d"],
+    "c2": [1, 2, 3, 4],
+    "c3": ["x", "x", "y", "y"],
+}
+
+
+class TestDisplayModes:
+    def test_plaintext_default_tags(self):
+        from hyperspace_tpu.config import SessionConf
+
+        m = PlainTextMode(SessionConf())
+        assert m.highlight_tag == ("<----", "---->")
+
+    def test_html_mode(self):
+        from hyperspace_tpu.config import SessionConf
+
+        m = HTMLMode(SessionConf())
+        b = BufferStream(m)
+        b.write_line("x").highlight("y")
+        assert b.to_string() == '<pre>x<br/><b style="background: #ff9900">y</b></pre>'
+
+    def test_tags_overridable_via_conf(self):
+        from hyperspace_tpu.config import SessionConf
+
+        conf = SessionConf()
+        conf.set(IndexConstants.DISPLAY_MODE, "console")
+        conf.set(IndexConstants.HIGHLIGHT_BEGIN_TAG, ">>")
+        conf.set(IndexConstants.HIGHLIGHT_END_TAG, "<<")
+        m = create_display_mode(conf)
+        assert isinstance(m, ConsoleMode)
+        assert m.highlight_tag == (">>", "<<")
+
+
+class TestExplain:
+    def test_explain_shows_diff_and_indexes_used(self, session, tmp_path):
+        session.write_parquet(SAMPLE, str(tmp_path / "t"))
+        df = session.read.parquet(str(tmp_path / "t"))
+        hs = Hyperspace(session)
+        hs.create_index(df, IndexConfig("exIdx", ["c3"], ["c2"]))
+
+        q = session.read.parquet(str(tmp_path / "t")).filter(col("c3") == "x").select("c2")
+        out = []
+        hs.explain(q, verbose=True, redirect=out.append)
+        s = out[0]
+        assert "Plan with indexes:" in s
+        assert "Plan without indexes:" in s
+        assert "exIdx" in s
+        assert "<----" in s  # differing subtree highlighted
+        assert "Physical operator stats:" in s
+        # operator table counts the Scan in both columns
+        assert "Scan" in s
+
+    def test_explain_join_counts_eliminated_exchanges(self, session, tmp_path):
+        session.write_parquet({"k": [1, 2, 3], "v": [1, 2, 3]}, str(tmp_path / "l"))
+        session.write_parquet({"k2": [1, 2, 3], "w": [4, 5, 6]}, str(tmp_path / "r"))
+        hs = Hyperspace(session)
+        hs.create_index(
+            session.read.parquet(str(tmp_path / "l")), IndexConfig("lIdx", ["k"], ["v"])
+        )
+        hs.create_index(
+            session.read.parquet(str(tmp_path / "r")), IndexConfig("rIdx", ["k2"], ["w"])
+        )
+        l = session.read.parquet(str(tmp_path / "l"))
+        r = session.read.parquet(str(tmp_path / "r"))
+        q = l.join(r, col("k") == col("k2")).select("v", "w")
+        out = []
+        hs.explain(q, verbose=True, redirect=out.append)
+        s = out[0]
+        # ShuffleExchange: 2 disabled, 0 enabled, diff -2
+        import re
+
+        m = re.search(r"ShuffleExchange\s*\|\s*2\|\s*0\|\s*-2", s)
+        assert m, s
+
+    def test_explain_leaves_session_state(self, session, tmp_path):
+        from hyperspace_tpu.hyperspace import is_hyperspace_enabled
+
+        session.write_parquet(SAMPLE, str(tmp_path / "t"))
+        df = session.read.parquet(str(tmp_path / "t"))
+        hs = Hyperspace(session)
+        hs.create_index(df, IndexConfig("stIdx", ["c3"], ["c2"]))
+        q = session.read.parquet(str(tmp_path / "t")).filter(col("c3") == "x").select("c2")
+        assert not is_hyperspace_enabled(session)
+        hs.explain(q, redirect=lambda s: None)
+        assert not is_hyperspace_enabled(session)
+        enable_hyperspace(session)
+        hs.explain(q, redirect=lambda s: None)
+        assert is_hyperspace_enabled(session)
+
+
+class TestPlanSerde:
+    def test_roundtrip_filter_project(self, session, tmp_path):
+        session.write_parquet(SAMPLE, str(tmp_path / "t"))
+        df = (
+            session.read.parquet(str(tmp_path / "t"))
+            .filter((col("c2") > 1) & (col("c3") == "y"))
+            .select("c1", "c2")
+        )
+        s = serialize_plan(df.plan)
+        restored = deserialize_plan(s)
+        assert restored.tree_string() == df.plan.tree_string()
+        # restored plan executes identically
+        from hyperspace_tpu.engine.session import DataFrame
+
+        assert DataFrame(session, restored).sorted_rows() == df.sorted_rows()
+
+    def test_roundtrip_join_with_bucketspec(self, session, tmp_path):
+        session.write_parquet({"k": [1]}, str(tmp_path / "l"))
+        session.write_parquet({"k2": [1]}, str(tmp_path / "r"))
+        l = session.read.parquet(str(tmp_path / "l"))
+        r = session.read.parquet(str(tmp_path / "r"))
+        j = l.join(r, col("k") == col("k2"))
+        restored = deserialize_plan(serialize_plan(j.plan))
+        assert restored.tree_string() == j.plan.tree_string()
+
+    def test_version_check(self):
+        import base64
+        import json
+
+        from hyperspace_tpu import HyperspaceException
+
+        bad = base64.b64encode(json.dumps({"version": "99", "plan": {}}).encode()).decode()
+        with pytest.raises(HyperspaceException, match="version"):
+            deserialize_plan(bad)
+
+
+class TestCache:
+    def test_ttl_and_mutation_clear(self, session, tmp_path):
+        from hyperspace_tpu.index.collection_manager import CachingIndexCollectionManager
+
+        session.write_parquet(SAMPLE, str(tmp_path / "t"))
+        df = session.read.parquet(str(tmp_path / "t"))
+        mgr = CachingIndexCollectionManager(session)
+        mgr.create(df, IndexConfig("cIdx", ["c3"], ["c2"]))
+        first = mgr.get_indexes()
+        assert [e.name for e in first] == ["cIdx"]
+        # cached: poke the cache to prove reads come from it
+        mgr._cache.set([])
+        assert mgr.get_indexes() == []
+        # mutation clears cache
+        mgr.delete("cIdx")
+        assert [e.state for e in mgr.get_indexes()] == ["DELETED"]
+        # expiry clears
+        session.conf.set(IndexConstants.INDEX_CACHE_EXPIRY_DURATION_SECONDS, 0)
+        mgr._cache.set([])
+        time.sleep(0.01)
+        assert [e.name for e in mgr.get_indexes()] == ["cIdx"]
