@@ -63,8 +63,13 @@ class PhysicalNode:
     def simple_string(self) -> str:
         return self.name
 
+    def format_line(self, indent: int) -> str:
+        """One tree line for this node — the single source of the tree format (the
+        explain renderer reuses it for highlight-aware output)."""
+        return "  " * indent + ("+- " if indent else "") + self.simple_string()
+
     def tree_string(self, indent: int = 0) -> str:
-        lines = ["  " * indent + ("+- " if indent else "") + self.simple_string()]
+        lines = [self.format_line(indent)]
         for c in self.children():
             lines.append(c.tree_string(indent + 1))
         return "\n".join(lines)

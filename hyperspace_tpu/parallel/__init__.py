@@ -1,0 +1,7 @@
+from .mesh import BUCKET_AXIS, make_mesh, replicated, row_sharding  # noqa: F401
+from .distributed import (  # noqa: F401
+    distributed_bucketed_join_counts,
+    distributed_bucketize,
+    exchange_counts,
+    exchange_rows,
+)

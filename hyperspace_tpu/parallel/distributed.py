@@ -1,0 +1,158 @@
+"""Distributed index build + join over a jax.sharding.Mesh.
+
+This is the TPU-native replacement for Spark's cluster-wide shuffle machinery
+(SURVEY §2.11): the build's `repartition(numBuckets, cols)` becomes an XLA
+`all_to_all` over the mesh's ICI, and the co-bucketed join needs NO communication at
+all because both sides' bucket blocks are co-located by construction.
+
+Build exchange (two-pass, static shapes — the standard way around ragged all-to-all):
+1. Count pass (shard_map): each device computes its per-destination row counts.
+2. Host sync: capacity = global max count (one scalar per mesh; amortized, and
+   stable across repeated builds of similar data).
+3. Exchange pass (shard_map): rows sorted by destination, scattered into a padded
+   [n_dev, cap] send matrix per column, `lax.all_to_all` over the bucket axis,
+   then a local (bucket, keys...) sort of the received rows.
+
+Device d ends up owning buckets [d*B/n, (d+1)*B/n) fully sorted — exactly the layout
+the bucketed writer persists and the co-bucketed join consumes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .mesh import BUCKET_AXIS
+
+_PAD_SLOT = -1
+
+
+def _dest_of(h1, num_buckets: int, n_dev: int):
+    bucket = (h1 % jnp.uint32(num_buckets)).astype(jnp.int32)
+    return bucket * n_dev // num_buckets, bucket
+
+
+def exchange_counts(mesh: Mesh, h1, num_buckets: int) -> np.ndarray:
+    """Pass 1: [n_dev, n_dev] matrix of rows device i sends to device j."""
+    n_dev = mesh.devices.size
+
+    def count_fn(h1_local):
+        dest, _ = _dest_of(h1_local, num_buckets, n_dev)
+        one_hot = jax.nn.one_hot(dest, n_dev, dtype=jnp.int32)
+        return jnp.sum(one_hot, axis=0, keepdims=True)  # [1, n_dev]
+
+    counts = jax.shard_map(
+        count_fn, mesh=mesh, in_specs=P(BUCKET_AXIS), out_specs=P(BUCKET_AXIS)
+    )(h1)
+    return np.asarray(counts)
+
+
+def exchange_rows(
+    mesh: Mesh,
+    h1,
+    payload: Sequence[jnp.ndarray],
+    sort_keys: Sequence[jnp.ndarray],
+    num_buckets: int,
+    cap: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, List[jnp.ndarray]]:
+    """Pass 2: all-to-all exchange + local in-bucket sort.
+
+    Returns (bucket_ids [n_dev*cap], valid mask, payload arrays), each sharded over
+    the mesh: device d's block holds its bucket range, valid rows sorted by
+    (bucket, sort_keys...) and grouped before padding."""
+    n_dev = mesh.devices.size
+
+    def fn(h1_local, payload_local, keys_local):
+        n_local = h1_local.shape[0]
+        dest, _ = _dest_of(h1_local, num_buckets, n_dev)
+        order = jnp.argsort(dest)
+        dest_s = dest[order]
+        starts = jnp.searchsorted(dest_s, jnp.arange(n_dev))
+        slot = jnp.arange(n_local) - starts[dest_s]
+
+        def scatter(col):
+            send = jnp.zeros((n_dev, cap), dtype=col.dtype)
+            send = send.at[dest_s, slot].set(col[order])
+            return jax.lax.all_to_all(
+                send, BUCKET_AXIS, split_axis=0, concat_axis=0, tiled=False
+            )
+
+        # Validity travels as its own lane.
+        valid_send = jnp.zeros((n_dev, cap), dtype=jnp.int32)
+        valid_send = valid_send.at[dest_s, slot].set(1)
+        valid_recv = jax.lax.all_to_all(
+            valid_send, BUCKET_AXIS, split_axis=0, concat_axis=0, tiled=False
+        )
+
+        h1_recv = scatter(h1_local)
+        payload_recv = [scatter(c) for c in payload_local]
+        keys_recv = [scatter(c) for c in keys_local]
+
+        # Local sort: invalid rows last, then by (bucket, sort keys...).
+        flat_valid = valid_recv.reshape(-1)
+        bucket = (h1_recv.reshape(-1) % jnp.uint32(num_buckets)).astype(jnp.int32)
+        sort_operands = (
+            1 - flat_valid,
+            bucket,
+            *[k.reshape(-1) for k in keys_recv],
+            jnp.arange(flat_valid.shape[0], dtype=jnp.int32),
+        )
+        res = jax.lax.sort(sort_operands, num_keys=2 + len(keys_recv))
+        perm = res[-1]
+        out_bucket = bucket[perm][None]
+        out_valid = flat_valid[perm][None]
+        out_payload = [c.reshape(-1)[perm][None] for c in payload_recv]
+        return out_bucket, out_valid, out_payload
+
+    out_bucket, out_valid, out_payload = jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(P(BUCKET_AXIS), P(BUCKET_AXIS), P(BUCKET_AXIS)),
+        out_specs=(P(BUCKET_AXIS), P(BUCKET_AXIS), P(BUCKET_AXIS)),
+    )(h1, list(payload), list(sort_keys))
+    return out_bucket, out_valid, out_payload
+
+
+def distributed_bucketize(
+    mesh: Mesh, h1, payload: Sequence[jnp.ndarray], sort_keys: Sequence[jnp.ndarray], num_buckets: int
+):
+    """Full two-pass distributed bucketize. Rows arrive sharded over the mesh; the
+    result is (bucket_ids, valid, payload) blocks, one bucket range per device."""
+    counts = exchange_counts(mesh, h1, num_buckets)
+    cap = int(counts.max()) if counts.size else 0
+    cap = max(cap, 1)
+    return exchange_rows(mesh, h1, payload, sort_keys, num_buckets, cap)
+
+
+# ---------------------------------------------------------------------------
+# Distributed co-bucketed join: zero-communication by construction
+# ---------------------------------------------------------------------------
+
+
+def distributed_bucketed_join_counts(
+    mesh: Mesh, l_sorted_keys, r_sorted_keys, l_len, r_len
+):
+    """Per-bucket match counts for co-located padded bucket matrices [B, cap] sharded
+    over the mesh's bucket axis. Runs entirely device-local (the proof that the
+    co-bucketed layout needs no collectives: the jitted HLO contains none)."""
+
+    def fn(ls, rs, ll, rl):
+        lo = jax.vmap(lambda r, l: jnp.searchsorted(r, l, side="left"))(rs, ls)
+        hi = jax.vmap(lambda r, l: jnp.searchsorted(r, l, side="right"))(rs, ls)
+        rl_b = rl[:, None]
+        lo = jnp.minimum(lo, rl_b)
+        hi = jnp.minimum(hi, rl_b)
+        valid = jnp.arange(ls.shape[1])[None, :] < ll[:, None]
+        return jnp.sum(jnp.where(valid, hi - lo, 0), axis=1)
+
+    return jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(P(BUCKET_AXIS), P(BUCKET_AXIS), P(BUCKET_AXIS), P(BUCKET_AXIS)),
+        out_specs=P(BUCKET_AXIS),
+    )(l_sorted_keys, r_sorted_keys, l_len, r_len)

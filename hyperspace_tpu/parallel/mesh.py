@@ -1,0 +1,35 @@
+"""Device mesh helpers.
+
+The framework's distribution axis is "buckets": index data is hash-partitioned into
+`num_buckets` buckets, and on a mesh each device owns a contiguous bucket block. Both
+the build's all-to-all exchange and the co-bucketed join's zero-communication
+execution ride this one axis (ICI within a slice, DCN across slices — the axis order
+in `jax.devices()` already reflects the platform's topology).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+BUCKET_AXIS = "buckets"
+
+
+def make_mesh(num_devices: Optional[int] = None) -> Mesh:
+    devices = jax.devices()
+    n = num_devices or len(devices)
+    if n > len(devices):
+        raise ValueError(f"Requested {n} devices; only {len(devices)} available.")
+    return Mesh(np.asarray(devices[:n]), (BUCKET_AXIS,))
+
+
+def row_sharding(mesh: Mesh) -> NamedSharding:
+    """Rows sharded over the mesh (axis 0)."""
+    return NamedSharding(mesh, PartitionSpec(BUCKET_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

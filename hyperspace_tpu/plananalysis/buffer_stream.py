@@ -29,6 +29,9 @@ class BufferStream:
         self._parts.append(self._mode.new_line)
         return self
 
+    def __repr__(self):
+        return f"BufferStream({len(self._parts)} parts)"
+
     def to_string(self) -> str:
         begin, end = self._mode.begin_end_tag
         return begin + "".join(self._parts) + end

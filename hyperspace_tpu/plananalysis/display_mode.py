@@ -47,9 +47,13 @@ class ConsoleMode(DisplayMode):
 
 
 def create_display_mode(conf: SessionConf) -> DisplayMode:
+    from ..exceptions import HyperspaceException
+
     name = (conf.get(IndexConstants.DISPLAY_MODE) or "plaintext").lower()
     if name == "html":
         return HTMLMode(conf)
     if name == "console":
         return ConsoleMode(conf)
-    return PlainTextMode(conf)
+    if name == "plaintext":
+        return PlainTextMode(conf)
+    raise HyperspaceException(f"Display mode: {name} not supported.")

@@ -28,7 +28,7 @@ def _write_plan(buffer: BufferStream, plan: PhysicalNode, other: PhysicalNode) -
     other_subtrees = _subtree_strings(other)
 
     def walk(node: PhysicalNode, indent: int):
-        line = "  " * indent + ("+- " if indent else "") + node.simple_string()
+        line = node.format_line(indent)
         if node.tree_string() in other_subtrees:
             buffer.write_line(line)
         else:
@@ -84,7 +84,7 @@ def explain_string(
         rel = getattr(n, "relation", None)
         if rel is not None and rel.index_name:
             used[rel.index_name] = rel.root_paths[0]
-    idx = indexes_table.to_pydict() if indexes_table.num_rows else {"name": [], "indexLocation": []}
+    idx = indexes_table.to_pydict()
     for name, location in sorted(used.items()):
         # Cross-check against the registry like the reference (:209-221).
         if name in idx.get("name", []):
