@@ -44,6 +44,12 @@ class IndexerBuilder:
         """Rebuild a dataframe from a logged Relation (reference `RefreshAction.scala:44-56`)."""
         raise NotImplementedError
 
+    def config_from_entry(self, entry: IndexLogEntry):
+        """Reconstruct the index spec from a log entry (used by refresh)."""
+        from ..index.index_config import IndexConfig
+
+        return IndexConfig(entry.name, entry.indexed_columns, entry.included_columns)
+
 
 class CreateAction(Action):
     def __init__(

@@ -1,0 +1,146 @@
+"""OptimizeAction: compact an index's small per-bucket files.
+
+North-star extension (BASELINE.md config 5) — absent from the v0 reference snapshot.
+After incremental refreshes an index's buckets are spread over many small files (one
+per version dir); optimize merges them: ACTIVE → OPTIMIZING → ACTIVE, new version dir
+holds one merged, re-sorted file per optimized bucket.
+
+Modes: "quick" merges only files below `hyperspace.index.optimize.fileSizeThreshold`
+(default 256 MB); "full" merges everything.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+from ..exceptions import HyperspaceException
+from ..index.log_entry import Content, FileInfo, IndexLogEntry, LogEntry
+from ..telemetry.events import HyperspaceEvent, OptimizeActionEvent
+from . import states
+from .action import Action
+
+OPTIMIZE_FILE_SIZE_THRESHOLD = "hyperspace.index.optimize.fileSizeThreshold"
+OPTIMIZE_FILE_SIZE_THRESHOLD_DEFAULT = 256 * 1024 * 1024
+OPTIMIZE_MODES = ("quick", "full")
+
+_BUCKET_RE = re.compile(r"part-(\d+)")
+
+
+class OptimizeAction(Action):
+    def __init__(
+        self,
+        builder,
+        session,
+        log_manager,
+        index_path: str,
+        index_data_path: str,
+        mode: str = "quick",
+        event_logger=None,
+    ):
+        super().__init__(log_manager, event_logger)
+        if mode not in OPTIMIZE_MODES:
+            raise HyperspaceException(
+                f"Unsupported optimize mode '{mode}'; supported: {OPTIMIZE_MODES}."
+            )
+        self._builder = builder
+        self._session = session
+        self._index_data_path = index_data_path
+        self._mode = mode
+        self._prev: Optional[IndexLogEntry] = None
+
+    @property
+    def transient_state(self) -> str:
+        return states.OPTIMIZING
+
+    @property
+    def final_state(self) -> str:
+        return states.ACTIVE
+
+    def _previous_entry(self) -> IndexLogEntry:
+        if self._prev is None:
+            prev = self._log_manager.get_log(self.base_id)
+            if prev is None:
+                raise HyperspaceException("Optimize is only supported on an existing index.")
+            self._prev = prev
+        return self._prev
+
+    def _partition_files(self):
+        """Split content files into (to_merge per bucket, untouched)."""
+        prev = self._previous_entry()
+        threshold = int(
+            self._session.conf.get(
+                OPTIMIZE_FILE_SIZE_THRESHOLD, str(OPTIMIZE_FILE_SIZE_THRESHOLD_DEFAULT)
+            )
+        )
+        per_bucket: Dict[int, List[FileInfo]] = defaultdict(list)
+        untouched: List[FileInfo] = []
+        for f in prev.content.file_infos():
+            m = _BUCKET_RE.search(os.path.basename(f.name))
+            if m is None:
+                untouched.append(f)
+                continue
+            if self._mode == "full" or f.size < threshold:
+                per_bucket[int(m.group(1))].append(f)
+            else:
+                untouched.append(f)
+        # A bucket with a single (small) file gains nothing from merging.
+        for b in [b for b, fs in per_bucket.items() if len(fs) < 2]:
+            untouched.extend(per_bucket.pop(b))
+        return per_bucket, untouched
+
+    def validate(self) -> None:
+        prev = self._previous_entry()
+        if prev.state != states.ACTIVE:
+            raise HyperspaceException(
+                f"Optimize is only supported in {states.ACTIVE} state."
+            )
+        if prev.kind != "CoveringIndex":
+            # Sketch files are tiny; compacting a DataSkippingIndex is just a full
+            # refresh. Rejecting here (pre-begin) leaves the index ACTIVE.
+            raise HyperspaceException(
+                f"Optimize is only supported for CoveringIndex (got {prev.kind}); "
+                "use refresh_index(mode='full') instead."
+            )
+        per_bucket, _ = self._partition_files()
+        if not per_bucket:
+            raise HyperspaceException(
+                "Optimize aborted as no optimizable index files found "
+                f"(mode={self._mode})."
+            )
+
+    def op(self) -> None:
+        from ..engine import io as engine_io
+        from ..engine.table import Table
+        from ..ops.partition import bucketize_table
+        import numpy as np
+
+        prev = self._previous_entry()
+        per_bucket, _ = self._partition_files()
+        os.makedirs(self._index_data_path, exist_ok=True)
+        for b, files in sorted(per_bucket.items()):
+            merged = engine_io.read_files([f.name for f in files], "parquet")
+            # Re-sort within the bucket by the indexed columns (same contract as the
+            # original bucketed write).
+            sorted_t, _ = bucketize_table(merged, prev.indexed_columns, prev.num_buckets)
+            engine_io.write_parquet(
+                sorted_t, os.path.join(self._index_data_path, f"part-{b:05d}.parquet")
+            )
+
+    def log_entry(self) -> LogEntry:
+        import copy
+
+        prev = self._previous_entry()
+        entry = copy.deepcopy(prev)
+        _, untouched = self._partition_files()
+        merged_content = Content.from_directory(self._index_data_path, self._session.fs)
+        entry.content = Content.merge(
+            [Content.from_file_infos(untouched), merged_content]
+        )
+        return entry
+
+    def event(self, message: str) -> HyperspaceEvent:
+        name = self._prev.name if self._prev else ""
+        return OptimizeActionEvent(index_name=name, message=message)

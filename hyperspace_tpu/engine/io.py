@@ -67,6 +67,8 @@ def _arrow_to_table(at: pa.Table) -> Table:
 
 
 def _read_one(path: str, file_format: str, columns: Optional[List[str]] = None) -> Table:
+    if file_format == "delta":
+        file_format = "parquet"  # delta data files are parquet
     if file_format == "parquet":
         return _arrow_to_table(pq.read_table(path, columns=columns))
     if file_format == "csv":
@@ -115,7 +117,7 @@ def infer_schema(files: List[str], file_format: str) -> Schema:
     if not files:
         raise HyperspaceException("No data files to infer schema from.")
     f = sorted(files)[0]
-    if file_format == "parquet":
+    if file_format in ("parquet", "delta"):
         return arrow_schema_to_schema(pq.read_schema(f))
     return _read_one(f, file_format).schema
 

@@ -33,10 +33,29 @@ class SourceRelation:
     # Set when this relation is an index scan substituted by a rewrite rule:
     bucket_spec: Optional["BucketSpec"] = None
     index_name: Optional[str] = None
+    # Hybrid Scan: source files appended after the index was built, merged in at
+    # execution time (shuffle-union into buckets for the join path):
+    hybrid_append: Optional["HybridAppend"] = None
+    # Data-skipping: names of indexes whose sketches pruned this scan's file list:
+    pruned_by: List[str] = field(default_factory=list)
 
     def __repr__(self):
         tag = f" index={self.index_name}" if self.index_name else ""
+        if self.hybrid_append is not None:
+            tag += f" (+{len(self.hybrid_append.files)} appended)"
+        if self.pruned_by:
+            tag += f" (files pruned by {','.join(self.pruned_by)})"
         return f"Relation[{self.file_format}]({','.join(self.root_paths)}{tag})"
+
+
+@dataclass
+class HybridAppend:
+    """Appended source files + how to read them (their format/schema are the
+    SOURCE's, not the index's)."""
+
+    files: List[FileStatus]
+    file_format: str
+    schema: Schema
 
 
 @dataclass(frozen=True)
@@ -142,6 +161,33 @@ class ProjectNode(LogicalPlan):
 
     def simple_string(self):
         return f"Project [{', '.join(self.column_names)}]"
+
+
+class UnionNode(LogicalPlan):
+    """Row-union of same-schema children (the Hybrid Scan merge shape: index data ∪
+    appended source files)."""
+
+    def __init__(self, children: Sequence[LogicalPlan]):
+        self._children = list(children)
+        first = self._children[0].output_schema.names
+        for c in self._children[1:]:
+            if [n.lower() for n in c.output_schema.names] != [n.lower() for n in first]:
+                raise ValueError(
+                    f"Union children schemas differ: {first} vs {c.output_schema.names}"
+                )
+
+    def children(self):
+        return tuple(self._children)
+
+    @property
+    def output_schema(self) -> Schema:
+        return self._children[0].output_schema
+
+    def with_children(self, children):
+        return UnionNode(children)
+
+    def simple_string(self):
+        return f"Union ({len(self._children)} children)"
 
 
 class JoinNode(LogicalPlan):

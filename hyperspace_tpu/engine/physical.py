@@ -39,6 +39,7 @@ from .logical import (
     ProjectNode,
     ScanNode,
     SourceRelation,
+    UnionNode,
 )
 from .schema import Schema
 from .table import Column, Table, align_dictionaries
@@ -89,12 +90,24 @@ class ScanExec(PhysicalNode):
         self.columns = columns
 
     def execute(self, ctx) -> Table:
+        if self.relation.hybrid_append is not None and self.relation.bucket_spec is not None:
+            # Demoted bucketed index scan (general join path / plain read): still must
+            # merge the hybrid-appended rows.
+            return BucketedIndexScanExec(self.relation, self.columns).execute(ctx)
         files = [f.path for f in self.relation.files]
+        if not files:
+            # Every file pruned (data skipping) or an empty source: empty table.
+            names = self.columns or self.relation.schema.names
+            return Table(
+                {n: _empty_column(self.relation.schema.field(n).dtype) for n in names}
+            )
         return engine_io.read_files(files, self.relation.file_format, self.columns)
 
     def simple_string(self):
         cols = f" [{', '.join(self.columns)}]" if self.columns else ""
         tag = f" index={self.relation.index_name}" if self.relation.index_name else ""
+        if self.relation.pruned_by:
+            tag += f" (files pruned by {','.join(self.relation.pruned_by)})"
         return f"Scan{tag} {','.join(self.relation.root_paths)}{cols}"
 
 
@@ -121,7 +134,44 @@ class BucketedIndexScanExec(PhysicalNode):
             b = int(m.group(1))
             t = engine_io.read_files([f.path], self.relation.file_format, self.columns)
             buckets[b] = t if buckets[b] is None else Table.concat([buckets[b], t])
+        if self.relation.hybrid_append is not None:
+            self._merge_appended(buckets)
         return buckets
+
+    def _merge_appended(self, buckets: List[Optional[Table]]) -> None:
+        """Hybrid Scan shuffle-union: bucketize the appended source rows with the
+        index's own partitioning (same hash, same bucket count) and merge them into
+        the bucket tables — the on-the-fly analogue of the index build, so the
+        co-bucketed join stays correct with no shuffle of the INDEX data."""
+        from ..config import IndexConstants
+        from ..ops.partition import bucketize_table
+
+        ha = self.relation.hybrid_append
+        spec = self.relation.bucket_spec
+        wanted = self.columns or self.relation.schema.names
+        lineage_col = IndexConstants.DATA_FILE_NAME_COLUMN
+        source_cols = [c for c in wanted if c.lower() != lineage_col]
+        parts = []
+        for f in ha.files:
+            t = engine_io.read_files([f.path], ha.file_format, source_cols)
+            if any(c.lower() == lineage_col for c in wanted):
+                cols = dict(t.columns)
+                cols[lineage_col] = Table.from_pydict(
+                    {lineage_col: [f.path] * t.num_rows}
+                ).column(lineage_col)
+                t = Table(cols)
+            parts.append(t)
+        appended = Table.concat(parts) if len(parts) > 1 else parts[0]
+        appended = appended.select(wanted)
+        sorted_t, starts = bucketize_table(
+            appended, list(spec.bucket_columns), spec.num_buckets
+        )
+        for b in range(spec.num_buckets):
+            lo, hi = int(starts[b]), int(starts[b + 1])
+            if hi <= lo:
+                continue
+            part = sorted_t.take(np.arange(lo, hi))
+            buckets[b] = part if buckets[b] is None else Table.concat([buckets[b], part])
 
     def empty_table(self) -> Table:
         """Empty table with this scan's (pruned) schema."""
@@ -186,6 +236,26 @@ class ProjectExec(PhysicalNode):
 
     def simple_string(self):
         return f"Project [{', '.join(self.column_names)}]"
+
+
+class UnionExec(PhysicalNode):
+    name = "Union"
+
+    def __init__(self, children: Sequence[PhysicalNode]):
+        self._children = list(children)
+
+    def children(self):
+        return tuple(self._children)
+
+    def execute(self, ctx) -> Table:
+        tables = [c.execute(ctx) for c in self._children]
+        # Align column order/spelling to the first child before concatenating.
+        names = tables[0].column_names
+        tables = [t if t.column_names == names else t.select(names) for t in tables]
+        return Table.concat([t for t in tables])
+
+    def simple_string(self):
+        return f"Union ({len(self._children)})"
 
 
 class ShuffleExchangeExec(PhysicalNode):
@@ -400,6 +470,9 @@ def plan_physical(logical: LogicalPlan, required: Optional[List[str]] = None) ->
         return ProjectExec(
             logical.column_names, plan_physical(logical.child, list(logical.column_names))
         )
+
+    if isinstance(logical, UnionNode):
+        return UnionExec([plan_physical(c, required) for c in logical.children()])
 
     if isinstance(logical, JoinNode):
         if logical.how != "inner":

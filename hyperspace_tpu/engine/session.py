@@ -103,6 +103,23 @@ class DataFrameReader:
     def json(self, *paths) -> DataFrame:
         return self._read(paths if len(paths) > 1 else paths[0], "json")
 
+    def delta(self, path: str) -> DataFrame:
+        """Snapshot read of a delta-style transactional table (extension): the file
+        set is resolved from the `_delta_log`, not a directory listing."""
+        from ..storage import delta as delta_log
+
+        files = delta_log.active_files(path, self._session.fs)
+        if not files:
+            raise HyperspaceException(f"Delta table has no active files: {path}")
+        schema = engine_io.infer_schema([f.path for f in files], "delta")
+        rel = SourceRelation(
+            root_paths=[os.path.abspath(path)],
+            file_format="delta",
+            schema=schema,
+            files=files,
+        )
+        return DataFrame(self._session, ScanNode(rel))
+
 
 class HyperspaceSession:
     """One session = conf + filesystem + optimizer rules + warehouse location."""
@@ -154,3 +171,11 @@ class HyperspaceSession:
     def write_json(self, data: Union[Table, Dict[str, list]], path: str) -> None:
         t = data if isinstance(data, Table) else Table.from_pydict(data)
         engine_io.write_json(t, os.path.join(path, "part-00000.json"))
+
+    def write_delta(
+        self, data: Union[Table, Dict[str, list]], path: str, mode: str = "append"
+    ) -> None:
+        from ..storage import delta as delta_log
+
+        t = data if isinstance(data, Table) else Table.from_pydict(data)
+        delta_log.write_delta(t, path, mode, self.fs)

@@ -15,8 +15,11 @@ from .engine.session import DataFrame, HyperspaceSession
 from .engine.table import Table
 from .index.collection_manager import CachingIndexCollectionManager, IndexManager
 from .index.index_config import IndexConfig
+from .rules.data_skipping_rule import DataSkippingFilterRule
 from .rules.filter_index_rule import FilterIndexRule
 from .rules.join_index_rule import JoinIndexRule
+
+_ALL_RULES = (JoinIndexRule, FilterIndexRule, DataSkippingFilterRule)
 
 _MANAGER_ATTR = "_hyperspace_index_manager"
 
@@ -50,8 +53,14 @@ class Hyperspace:
     def vacuum_index(self, index_name: str) -> None:
         self._manager.vacuum(index_name)
 
-    def refresh_index(self, index_name: str) -> None:
-        self._manager.refresh(index_name)
+    def refresh_index(self, index_name: str, mode: str = "full") -> None:
+        """mode="full": rebuild from scratch (reference behavior).
+        mode="incremental": index only appended source files (extension)."""
+        self._manager.refresh(index_name, mode)
+
+    def optimize_index(self, index_name: str, mode: str = "quick") -> None:
+        """Compact small per-bucket index files (extension; quick/full modes)."""
+        self._manager.optimize(index_name, mode)
 
     def cancel(self, index_name: str) -> None:
         self._manager.cancel(index_name)
@@ -79,25 +88,24 @@ class Hyperspace:
 
 def enable_hyperspace(session: HyperspaceSession) -> HyperspaceSession:
     """Plug the rewrite rules into the optimizer: JoinIndexRule first, then
-    FilterIndexRule (ordering is deliberate, reference `package.scala:24-33`)."""
+    FilterIndexRule (ordering is deliberate, reference `package.scala:24-33`), then
+    the data-skipping file-pruning rule (extension) for scans the covering rules
+    left in place."""
     if not is_hyperspace_enabled(session):
         session.extra_optimizations = session.extra_optimizations + [
             JoinIndexRule(),
             FilterIndexRule(),
+            DataSkippingFilterRule(),
         ]
     return session
 
 
 def disable_hyperspace(session: HyperspaceSession) -> HyperspaceSession:
     session.extra_optimizations = [
-        r
-        for r in session.extra_optimizations
-        if not isinstance(r, (JoinIndexRule, FilterIndexRule))
+        r for r in session.extra_optimizations if not isinstance(r, _ALL_RULES)
     ]
     return session
 
 
 def is_hyperspace_enabled(session: HyperspaceSession) -> bool:
-    return any(
-        isinstance(r, (JoinIndexRule, FilterIndexRule)) for r in session.extra_optimizations
-    )
+    return any(isinstance(r, _ALL_RULES) for r in session.extra_optimizations)

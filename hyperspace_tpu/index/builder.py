@@ -161,4 +161,23 @@ class CoveringIndexBuilder(IndexerBuilder):
             return reader.csv(*relation.root_paths)
         if fmt == "json":
             return reader.json(*relation.root_paths)
+        if fmt == "delta":
+            return reader.delta(*relation.root_paths)
         raise HyperspaceException(f"Unsupported file format: {fmt}")
+
+    def restrict_df_to_files(self, df: DataFrame, file_paths) -> DataFrame:
+        """A view of the same relation limited to a subset of its files (used by
+        incremental refresh to index only appended data)."""
+        from ..engine.logical import ScanNode, SourceRelation
+        from ..engine.session import DataFrame as DF
+
+        rel = df.plan.relation
+        wanted = set(file_paths)
+        sub = SourceRelation(
+            root_paths=list(rel.root_paths),
+            file_format="parquet" if rel.file_format == "delta" else rel.file_format,
+            schema=rel.schema,
+            files=[f for f in rel.files if f.path in wanted],
+            options=dict(rel.options),
+        )
+        return DF(self._session, ScanNode(sub))

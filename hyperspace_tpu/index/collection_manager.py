@@ -44,7 +44,10 @@ class IndexManager:
     def vacuum(self, index_name: str) -> None:
         raise NotImplementedError
 
-    def refresh(self, index_name: str) -> None:
+    def refresh(self, index_name: str, mode: str = "full") -> None:
+        raise NotImplementedError
+
+    def optimize(self, index_name: str, mode: str = "quick") -> None:
         raise NotImplementedError
 
     def cancel(self, index_name: str) -> None:
@@ -93,11 +96,25 @@ class IndexCollectionManager(IndexManager):
 
     # -- CRUD ---------------------------------------------------------------
 
+    def _builder_for_config(self, index_config):
+        from .dataskipping import DataSkippingIndexBuilder, DataSkippingIndexConfig
+
+        if isinstance(index_config, DataSkippingIndexConfig):
+            return DataSkippingIndexBuilder(self._session)
+        return CoveringIndexBuilder(self._session)
+
+    def _builder_for_entry(self, entry):
+        from .dataskipping import DATA_SKIPPING_KIND, DataSkippingIndexBuilder
+
+        if entry is not None and entry.kind == DATA_SKIPPING_KIND:
+            return DataSkippingIndexBuilder(self._session)
+        return CoveringIndexBuilder(self._session)
+
     def create(self, df: DataFrame, index_config: IndexConfig) -> None:
         log_mgr, data_mgr, index_path = self._managers_for(index_config.index_name)
         latest = data_mgr.get_latest_version_id()
         next_version = 0 if latest is None else latest + 1
-        builder = CoveringIndexBuilder(self._session)
+        builder = self._builder_for_config(index_config)
         CreateAction(
             df,
             index_config,
@@ -108,13 +125,40 @@ class IndexCollectionManager(IndexManager):
             self._event_logger(),
         ).run()
 
-    def refresh(self, index_name: str) -> None:
+    def refresh(self, index_name: str, mode: str = "full") -> None:
+        from ..actions.refresh import RefreshIncrementalAction
+
+        log_mgr, data_mgr, index_path = self._existing_log_manager(index_name)
+        latest = data_mgr.get_latest_version_id()
+        next_version = 0 if latest is None else latest + 1
+        builder = self._builder_for_entry(log_mgr.get_latest_log())
+        if mode == "incremental":
+            action_cls = RefreshIncrementalAction
+        elif mode == "full":
+            action_cls = RefreshAction
+        else:
+            raise HyperspaceException(
+                f"Unsupported refresh mode '{mode}'; supported: full, incremental."
+            )
+        action_cls(
+            builder, log_mgr, index_path, data_mgr.get_path(next_version), self._event_logger()
+        ).run()
+
+    def optimize(self, index_name: str, mode: str = "quick") -> None:
+        from ..actions.optimize import OptimizeAction
+
         log_mgr, data_mgr, index_path = self._existing_log_manager(index_name)
         latest = data_mgr.get_latest_version_id()
         next_version = 0 if latest is None else latest + 1
         builder = CoveringIndexBuilder(self._session)
-        RefreshAction(
-            builder, log_mgr, index_path, data_mgr.get_path(next_version), self._event_logger()
+        OptimizeAction(
+            builder,
+            self._session,
+            log_mgr,
+            index_path,
+            data_mgr.get_path(next_version),
+            mode,
+            self._event_logger(),
         ).run()
 
     def delete(self, index_name: str) -> None:
@@ -244,9 +288,13 @@ class CachingIndexCollectionManager(IndexCollectionManager):
         self.clear_cache()
         super().vacuum(index_name)
 
-    def refresh(self, index_name: str) -> None:
+    def refresh(self, index_name: str, mode: str = "full") -> None:
         self.clear_cache()
-        super().refresh(index_name)
+        super().refresh(index_name, mode)
+
+    def optimize(self, index_name: str, mode: str = "quick") -> None:
+        self.clear_cache()
+        super().optimize(index_name, mode)
 
     def cancel(self, index_name: str) -> None:
         self.clear_cache()

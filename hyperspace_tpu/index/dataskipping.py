@@ -1,0 +1,271 @@
+"""DataSkippingIndex: per-source-file sketches that prune files from scans.
+
+North-star extension (BASELINE.md config 4) — absent from the v0 reference snapshot.
+Two sketch types:
+
+- MinMaxSketch(col): per-file min/max, prunes range/equality filters.
+- BloomFilterSketch(col, num_bits, num_hashes): per-file bloom filter over the
+  column's values, prunes equality/IN filters.
+
+TPU-first: the per-file scan that feeds each sketch runs on device — min/max are
+jnp reductions; the bloom filter is built by hashing the whole column with the same
+murmur lanes the join path uses and scattering bits in one vectorized `.at[].max`.
+Sketch data persists as one parquet file per index version (bloom bitsets hex-encoded),
+and the metadata record reuses the covering-index log machinery with
+kind="DataSkippingIndex".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..actions.create import IndexerBuilder
+from ..engine import io as engine_io
+from ..engine.logical import ScanNode
+from ..engine.schema import Schema
+from ..engine.table import Column, Table
+from ..exceptions import HyperspaceException
+from ..ops.hashing import _SEED1, _SEED2, column_hash_u32
+from ..util.resolver_utils import resolve_all
+from .index_config import IndexConfig
+from .log_entry import (
+    Content,
+    CoveringIndexProperties,
+    Directory,
+    IndexLogEntry,
+    LogicalPlanFingerprint,
+    Relation,
+    Signature,
+    Source,
+    SourcePlanProperties,
+    register_entry_kind,
+)
+from .signatures import create_provider
+
+DATA_SKIPPING_KIND = "DataSkippingIndex"
+_FILE_COL = "_file"
+
+
+class Sketch:
+    kind = "Sketch"
+
+    def __init__(self, column: str):
+        self.column = column
+
+    def to_json(self) -> dict:
+        return {"kind": self.kind, "column": self.column}
+
+    @staticmethod
+    def from_json(d: dict) -> "Sketch":
+        if d["kind"] == "MinMaxSketch":
+            return MinMaxSketch(d["column"])
+        if d["kind"] == "BloomFilterSketch":
+            return BloomFilterSketch(d["column"], d.get("numBits", 1024), d.get("numHashes", 5))
+        raise HyperspaceException(f"Unknown sketch kind: {d['kind']}")
+
+
+class MinMaxSketch(Sketch):
+    kind = "MinMaxSketch"
+
+
+class BloomFilterSketch(Sketch):
+    kind = "BloomFilterSketch"
+
+    def __init__(self, column: str, num_bits: int = 1024, num_hashes: int = 5):
+        super().__init__(column)
+        self.num_bits = num_bits
+        self.num_hashes = num_hashes
+
+    def to_json(self) -> dict:
+        d = super().to_json()
+        d.update({"numBits": self.num_bits, "numHashes": self.num_hashes})
+        return d
+
+
+class DataSkippingIndexConfig:
+    """Spec: name + sketches (the DataSkippingIndexConfig analogue)."""
+
+    def __init__(self, index_name: str, sketches: Sequence[Sketch]):
+        if not index_name or not index_name.strip():
+            raise HyperspaceException("Index name cannot be empty.")
+        if not sketches:
+            raise HyperspaceException("At least one sketch is required.")
+        self.index_name = index_name
+        self.sketches = list(sketches)
+
+    # IndexConfig-compatible surface for the action/manager machinery:
+    @property
+    def indexed_columns(self) -> List[str]:
+        return list(dict.fromkeys(s.column for s in self.sketches))
+
+    @property
+    def included_columns(self) -> List[str]:
+        return []
+
+
+# ---------------------------------------------------------------------------
+# Sketch computation (device side)
+# ---------------------------------------------------------------------------
+
+
+def _bloom_bits(col: Column, num_bits: int, num_hashes: int) -> np.ndarray:
+    """Bloom bitset of a column's values: double hashing h1 + i*h2, one vectorized
+    scatter for all rows × hash lanes."""
+    arr = jnp.asarray(col.data)
+    h1 = column_hash_u32(col, arr, _SEED1).astype(jnp.uint64)
+    h2 = column_hash_u32(col, arr, _SEED2).astype(jnp.uint64)
+    i = jnp.arange(num_hashes, dtype=jnp.uint64)[:, None]
+    idx = ((h1[None, :] + i * h2[None, :]) % jnp.uint64(num_bits)).astype(jnp.int32)
+    bits = jnp.zeros((num_bits,), dtype=jnp.uint8).at[idx.reshape(-1)].max(1)
+    return np.asarray(bits)
+
+
+def bloom_probe(bits: np.ndarray, value, column_dtype: str, num_hashes: int) -> bool:
+    """Membership probe for one literal (host side; bits already tiny).
+
+    The probe must hash the literal the way the COLUMN's values were hashed: numeric
+    literals are cast to the column's dtype first (int 5 vs float 5.0 canonicalize
+    differently), and any cast that changes the value or fails means the column can
+    never equal the literal exactly as hashed — we conservatively keep the file."""
+    expect_string = column_dtype == "string"
+    if expect_string:
+        probe_col = Column.from_values(np.asarray([value]))
+        if not probe_col.is_string:
+            return True  # type mismatch: cannot prune safely
+    else:
+        try:
+            cast = np.asarray([value], dtype=np.dtype(column_dtype))
+            if cast[0] != value:
+                return True  # value not representable in the column dtype
+        except (ValueError, OverflowError, TypeError):
+            return True
+        probe_col = Column.from_values(cast)
+    arr = jnp.asarray(probe_col.data)
+    h1 = int(np.asarray(column_hash_u32(probe_col, arr, _SEED1))[0])
+    h2 = int(np.asarray(column_hash_u32(probe_col, arr, _SEED2))[0])
+    num_bits = len(bits)
+    for i in range(num_hashes):
+        if not bits[(h1 + i * h2) % num_bits]:
+            return False
+    return True
+
+
+def _bits_to_hex(bits: np.ndarray) -> str:
+    return np.packbits(bits.astype(np.uint8)).tobytes().hex()
+
+
+def hex_to_bits(s: str, num_bits: int) -> np.ndarray:
+    return np.unpackbits(np.frombuffer(bytes.fromhex(s), dtype=np.uint8))[:num_bits]
+
+
+# ---------------------------------------------------------------------------
+# Builder (plugs into the same CreateAction FSM as covering indexes)
+# ---------------------------------------------------------------------------
+
+
+class DataSkippingIndexBuilder(IndexerBuilder):
+    def __init__(self, session):
+        self._session = session
+
+    def validate_source(self, df, index_config: DataSkippingIndexConfig) -> None:
+        if not isinstance(df.plan, ScanNode):
+            raise HyperspaceException(
+                "Only creating index over a plain relation scan is supported."
+            )
+        names = df.plan.output_schema.names
+        if resolve_all(index_config.indexed_columns, names) is None:
+            raise HyperspaceException(
+                f"Sketch columns {index_config.indexed_columns} could not be resolved "
+                f"against dataframe columns {names}."
+            )
+
+    def write(self, df, index_config: DataSkippingIndexConfig, index_data_path: str) -> None:
+        rel = df.plan.relation
+        cols = list(dict.fromkeys(s.column for s in index_config.sketches))
+        rows: Dict[str, list] = {_FILE_COL: []}
+        for f in rel.files:
+            t = engine_io.read_files([f.path], rel.file_format, cols)
+            rows[_FILE_COL].append(f.path)
+            for s in index_config.sketches:
+                c = t.column(s.column)
+                if isinstance(s, MinMaxSketch):
+                    if c.is_string:
+                        decoded = c.dictionary
+                        mn, mx = str(decoded.min()), str(decoded.max())
+                    else:
+                        arr = jnp.asarray(c.data)
+                        mn = np.asarray(jnp.min(arr)).item()
+                        mx = np.asarray(jnp.max(arr)).item()
+                    rows.setdefault(f"min_{s.column}", []).append(mn)
+                    rows.setdefault(f"max_{s.column}", []).append(mx)
+                elif isinstance(s, BloomFilterSketch):
+                    bits = _bloom_bits(c, s.num_bits, s.num_hashes)
+                    rows.setdefault(f"bloom_{s.column}", []).append(_bits_to_hex(bits))
+        engine_io.write_parquet(
+            Table.from_pydict(rows), os.path.join(index_data_path, "part-00000.parquet")
+        )
+
+    def derive_log_entry(
+        self, df, index_config: DataSkippingIndexConfig, index_path: str, index_data_path: str
+    ) -> IndexLogEntry:
+        rel = df.plan.relation
+        provider = create_provider()
+        sig = provider.signature(df.plan)
+        if sig is None:
+            raise HyperspaceException("Signature provider does not support this plan.")
+        relation = Relation(
+            root_paths=list(rel.root_paths),
+            data=Content(Directory.from_leaf_files("/", rel.files)),
+            data_schema_json=rel.schema.to_json_string(),
+            file_format=rel.file_format,
+            options=dict(rel.options),
+        )
+        entry = IndexLogEntry(
+            name=index_config.index_name,
+            derived_dataset=CoveringIndexProperties(
+                indexed_columns=index_config.indexed_columns,
+                included_columns=[],
+                schema_json=Schema([]).to_json_string(),
+                num_buckets=1,
+                properties={
+                    "sketches": json.dumps([s.to_json() for s in index_config.sketches])
+                },
+            ),
+            content=Content.from_directory(index_data_path, self._session.fs),
+            source=Source(
+                SourcePlanProperties(
+                    relations=[relation],
+                    fingerprint=LogicalPlanFingerprint(
+                        signatures=[Signature(provider.name, sig)]
+                    ),
+                )
+            ),
+            kind=DATA_SKIPPING_KIND,
+        )
+        return entry
+
+    def reconstruct_df(self, relation: Relation):
+        from .builder import CoveringIndexBuilder
+
+        return CoveringIndexBuilder(self._session).reconstruct_df(relation)
+
+    def restrict_df_to_files(self, df, file_paths):
+        from .builder import CoveringIndexBuilder
+
+        return CoveringIndexBuilder(self._session).restrict_df_to_files(df, file_paths)
+
+    def config_from_entry(self, entry: IndexLogEntry) -> DataSkippingIndexConfig:
+        return DataSkippingIndexConfig(entry.name, sketches_of(entry))
+
+
+def sketches_of(entry: IndexLogEntry) -> List[Sketch]:
+    raw = entry.derived_dataset.properties.get("sketches", "[]")
+    return [Sketch.from_json(d) for d in json.loads(raw)]
+
+
+register_entry_kind(DATA_SKIPPING_KIND, IndexLogEntry.from_json)

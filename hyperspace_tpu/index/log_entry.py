@@ -132,6 +132,20 @@ class Content:
     def from_directory(path: str, fs: FileSystem) -> "Content":
         return Content(Directory.from_directory(path, fs))
 
+    @staticmethod
+    def from_file_infos(infos: List["FileInfo"]) -> "Content":
+        """Build from absolute-path FileInfos (used to merge multi-version index data
+        after incremental refresh / optimize)."""
+        leaves = [FileStatus(f.name, f.size, f.modified_time, False) for f in infos]
+        return Content(Directory.from_leaf_files("/", leaves))
+
+    @staticmethod
+    def merge(contents: List["Content"]) -> "Content":
+        all_infos: List[FileInfo] = []
+        for c in contents:
+            all_infos.extend(c.file_infos())
+        return Content.from_file_infos(all_infos)
+
 
 # ---------------------------------------------------------------------------
 # Source lineage: relations + plan fingerprint (reference IndexLogEntry.scala:242-282)
@@ -386,8 +400,14 @@ class IndexLogEntry(LogEntry):
         return sigs[0]
 
     def index_location(self) -> str:
-        """Root directory of the latest index data (common prefix of content files)."""
-        return self.content.root.name
+        """Root directory of the index data (common prefix of content files — may
+        span multiple version dirs after incremental refresh)."""
+        files = self.content.files()
+        if not files:
+            return self.content.root.name
+        if len(files) == 1:
+            return os.path.dirname(files[0])
+        return os.path.commonpath(files)
 
     # -- serde --------------------------------------------------------------
 

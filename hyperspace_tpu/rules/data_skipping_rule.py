@@ -1,0 +1,180 @@
+"""DataSkippingFilterRule: prune source files from scans using per-file sketches.
+
+Extension rule (BASELINE.md config 4). Unlike the covering-index rules (which REPLACE
+the relation), this rule keeps the source relation and shrinks its file list: for each
+filter conjunct on a sketched column, files whose MinMax range excludes the literal or
+whose BloomFilter rejects it are dropped. Runs after the covering rules, so it applies
+to scans they left in place.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+from ..engine import io as engine_io
+from ..engine.expr import BinaryOp, Col, Expr, IsIn, Lit, split_conjuncts
+from ..engine.logical import FilterNode, LogicalPlan, ScanNode, SourceRelation
+from ..index.dataskipping import (
+    DATA_SKIPPING_KIND,
+    BloomFilterSketch,
+    MinMaxSketch,
+    bloom_probe,
+    hex_to_bits,
+    sketches_of,
+)
+from ..telemetry.event_logging import EventLoggerFactory
+from ..telemetry.events import HyperspaceIndexUsageEvent
+from .rule_utils import get_candidate_indexes
+
+
+def _normalize_conjunct(e: Expr):
+    """Return (op, column_name, literal(s)) for prunable shapes, else None."""
+    if isinstance(e, IsIn) and isinstance(e.child, Col):
+        return ("in", e.child.name, e.values)
+    if not isinstance(e, BinaryOp) or e.op not in BinaryOp.COMPARISONS:
+        return None
+    l, r = e.left, e.right
+    if isinstance(l, Col) and isinstance(r, Lit):
+        return (e.op, l.name, r.value)
+    if isinstance(l, Lit) and isinstance(r, Col):
+        flipped = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "==": "==", "!=": "!="}
+        return (flipped[e.op], r.name, l.value)
+    return None
+
+
+def _minmax_keeps(op: str, value, mn, mx) -> bool:
+    """Can a file with [mn, mx] on the column contain a row satisfying `col op value`?"""
+    try:
+        if op == "==":
+            return mn <= value <= mx
+        if op == "<":
+            return mn < value
+        if op == "<=":
+            return mn <= value
+        if op == ">":
+            return mx > value
+        if op == ">=":
+            return mx >= value
+    except TypeError:
+        return True  # incomparable types: never prune
+    return True  # "!=" and anything else: cannot prune
+
+
+class DataSkippingFilterRule:
+    """Rule protocol: apply(plan, session) -> plan."""
+
+    def __init__(self):
+        # Sketch tables cached across queries, keyed by the entry's content file
+        # list — a refresh/optimize writes new files, so the key changes and stale
+        # sketches age out naturally.
+        self._sketch_cache: Dict[tuple, dict] = {}
+
+    def apply(self, plan: LogicalPlan, session) -> LogicalPlan:
+        from ..hyperspace import _index_manager_for
+
+        try:
+            index_manager = _index_manager_for(session)
+
+            def sketch_data(entry):
+                key = (entry.name, tuple(entry.content.files()))
+                if key not in self._sketch_cache:
+                    t = engine_io.read_files(entry.content.files(), "parquet")
+                    self._sketch_cache = {
+                        k: v for k, v in self._sketch_cache.items() if k[0] != entry.name
+                    }
+                    self._sketch_cache[key] = t.to_pydict()
+                return self._sketch_cache[key]
+
+            def rewrite(node: LogicalPlan) -> LogicalPlan:
+                if not (isinstance(node, FilterNode) and isinstance(node.child, ScanNode)):
+                    return node
+                scan = node.child
+                if scan.relation.index_name is not None:
+                    return node  # covering-index scans have no per-file sketches
+                # Hybrid semantics are safe here: with appended-only changes the
+                # recorded files are unchanged (sketches still valid) and appended
+                # files are absent from the sketch, so they are always kept.
+                candidates = get_candidate_indexes(
+                    index_manager,
+                    scan,
+                    hybrid_scan=session.hs_conf.hybrid_scan_enabled,
+                    kind=DATA_SKIPPING_KIND,
+                )
+                if not candidates:
+                    return node
+
+                conjuncts = [_normalize_conjunct(c) for c in split_conjuncts(node.condition)]
+                conjuncts = [c for c in conjuncts if c is not None]
+                if not conjuncts:
+                    return node
+
+                keep = {f.path: True for f in scan.relation.files}
+                used_indexes: List[str] = []
+                for cand in candidates:
+                    entry = cand.entry
+                    data = sketch_data(entry)
+                    files_in_sketch = data.get("_file", [])
+                    row_of = {p: i for i, p in enumerate(files_in_sketch)}
+                    applied = False
+                    for s in sketches_of(entry):
+                        for op, col_name, value in conjuncts:
+                            if col_name.lower() != s.column.lower():
+                                continue
+                            column_dtype = scan.relation.schema.field(col_name).dtype
+                            for path in list(keep):
+                                if not keep[path] or path not in row_of:
+                                    continue  # unknown file (e.g. appended): keep
+                                i = row_of[path]
+                                if isinstance(s, MinMaxSketch) and op in (
+                                    "==", "<", "<=", ">", ">=",
+                                ):
+                                    mn = data[f"min_{s.column}"][i]
+                                    mx = data[f"max_{s.column}"][i]
+                                    if not _minmax_keeps(op, value, mn, mx):
+                                        keep[path] = False
+                                        applied = True
+                                elif isinstance(s, BloomFilterSketch) and op in ("==", "in"):
+                                    bits = hex_to_bits(
+                                        data[f"bloom_{s.column}"][i], s.num_bits
+                                    )
+                                    values = value if op == "in" else [value]
+                                    if not any(
+                                        bloom_probe(bits, v, column_dtype, s.num_hashes)
+                                        for v in values
+                                    ):
+                                        keep[path] = False
+                                        applied = True
+                    if applied:
+                        used_indexes.append(entry.name)
+
+                kept_files = [f for f in scan.relation.files if keep[f.path]]
+                if len(kept_files) == len(scan.relation.files):
+                    return node
+
+                rel = scan.relation
+                pruned = SourceRelation(
+                    root_paths=list(rel.root_paths),
+                    file_format=rel.file_format,
+                    schema=rel.schema,
+                    files=kept_files,
+                    options=dict(rel.options),
+                    pruned_by=sorted(set(used_indexes)),
+                )
+                new_node = FilterNode(node.condition, ScanNode(pruned))
+                EventLoggerFactory.get_logger(
+                    session.hs_conf.event_logger_class
+                ).log_event(
+                    HyperspaceIndexUsageEvent(
+                        index_names=sorted(set(used_indexes)),
+                        plan_before=node.tree_string(),
+                        plan_after=new_node.tree_string(),
+                        message="Data skipping index applied "
+                        f"({len(rel.files) - len(kept_files)} of {len(rel.files)} files pruned).",
+                    )
+                )
+                return new_node
+
+            return plan.transform_up(rewrite)
+        except Exception:
+            return plan

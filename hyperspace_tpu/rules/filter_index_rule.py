@@ -22,6 +22,7 @@ from ..engine.logical import (
     ProjectNode,
     ScanNode,
     SourceRelation,
+    UnionNode,
 )
 from ..index.log_entry import IndexLogEntry
 from ..telemetry.event_logging import EventLoggerFactory
@@ -76,17 +77,38 @@ class FilterIndexRule:
                     project.column_names if project is not None else scan.output_schema.names
                 )
                 filter_columns = sorted(filt.condition.references())
-                candidates = get_candidate_indexes(index_manager, scan)
+                candidates = get_candidate_indexes(
+                    index_manager, scan, hybrid_scan=session.hs_conf.hybrid_scan_enabled
+                )
                 usable = [
-                    e
-                    for e in candidates
-                    if index_covers_plan(list(output_columns), filter_columns, e)
+                    c
+                    for c in candidates
+                    if index_covers_plan(list(output_columns), filter_columns, c.entry)
                 ]
                 if not usable:
                     return node
-                best = rank(usable)
-                new_scan = ScanNode(_index_relation(best))
-                new_filter = FilterNode(filt.condition, new_scan)
+                chosen = rank(usable)
+                best = chosen.entry
+                index_child: LogicalPlan = ScanNode(_index_relation(best))
+                if chosen.appended:
+                    # Hybrid Scan (extension): union the index data with the source
+                    # files appended since the build, both projected to the needed
+                    # columns so the union schemas line up.
+                    needed = list(dict.fromkeys(list(output_columns) + filter_columns))
+                    appended_rel = SourceRelation(
+                        root_paths=list(scan.relation.root_paths),
+                        file_format=scan.relation.file_format,
+                        schema=scan.relation.schema,
+                        files=chosen.appended,
+                        options=dict(scan.relation.options),
+                    )
+                    index_child = UnionNode(
+                        [
+                            ProjectNode(needed, index_child),
+                            ProjectNode(needed, ScanNode(appended_rel)),
+                        ]
+                    )
+                new_filter = FilterNode(filt.condition, index_child)
                 # Always project: preserves the original output column order (the
                 # index stores columns in indexed+included order, not source order).
                 new_plan: LogicalPlan = ProjectNode(list(output_columns), new_filter)
@@ -108,9 +130,10 @@ class FilterIndexRule:
             return plan
 
 
-def rank(candidates: List[IndexLogEntry]) -> IndexLogEntry:
-    """FilterIndexRanker: first candidate (reference TODO at :202-208)."""
-    return candidates[0]
+def rank(candidates):
+    """FilterIndexRanker: exact-match candidates beat hybrid-scan ones, then first
+    (reference ranking TODO at :202-208)."""
+    return sorted(candidates, key=lambda c: len(c.appended))[0]
 
 
 def _index_relation(entry: IndexLogEntry, with_bucket_spec: bool = False) -> SourceRelation:

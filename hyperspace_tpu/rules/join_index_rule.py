@@ -79,48 +79,47 @@ def _one_to_one(oriented: List[Tuple[str, str]]) -> Optional[Dict[str, str]]:
     return fwd
 
 
-def _usable_indexes(
-    candidates: List[IndexLogEntry], join_cols: List[str], required_cols: List[str]
-) -> List[IndexLogEntry]:
+def _usable_indexes(candidates, join_cols: List[str], required_cols: List[str]):
     """indexedCols set-equal to join cols AND all required ⊆ index cols
-    (reference :481-493)."""
+    (reference :481-493). Operates on CandidateIndex objects."""
     out = []
     jset = set(_lower(join_cols))
     rset = set(_lower(required_cols))
-    for e in candidates:
+    for c in candidates:
+        e = c.entry
         indexed = set(_lower(e.indexed_columns))
         all_cols = set(_lower(e.indexed_columns + e.included_columns))
         if indexed == jset and rset <= all_cols:
-            out.append(e)
+            out.append(c)
     return out
 
 
-def _compatible_pairs(
-    l_indexes: List[IndexLogEntry],
-    r_indexes: List[IndexLogEntry],
-    l_to_r: Dict[str, str],
-) -> List[Tuple[IndexLogEntry, IndexLogEntry]]:
+def _compatible_pairs(l_candidates, r_candidates, l_to_r: Dict[str, str]):
     """Pairs listing indexed columns in the same order under the mapping
     (reference :516-563)."""
     out = []
-    for li in l_indexes:
-        mapped = [l_to_r[c] for c in _lower(li.indexed_columns)]
-        for ri in r_indexes:
-            if _lower(ri.indexed_columns) == mapped:
-                out.append((li, ri))
+    for lc in l_candidates:
+        mapped = [l_to_r[c] for c in _lower(lc.entry.indexed_columns)]
+        for rc in r_candidates:
+            if _lower(rc.entry.indexed_columns) == mapped:
+                out.append((lc, rc))
     return out
 
 
-def rank_join_pairs(
-    pairs: List[Tuple[IndexLogEntry, IndexLogEntry]]
-) -> List[Tuple[IndexLogEntry, IndexLogEntry]]:
-    """JoinIndexRanker: equal-bucket pairs first (zero shuffle), then higher bucket
-    counts (more parallelism) (reference `rankers/JoinIndexRanker.scala:40-55`)."""
+def rank_join_pairs(pairs):
+    """JoinIndexRanker: exact-match pairs beat hybrid ones, equal-bucket pairs first
+    (zero shuffle), then higher bucket counts (more parallelism)
+    (reference `rankers/JoinIndexRanker.scala:40-55`)."""
 
     def key(p):
-        li, ri = p
+        lc, rc = p
+        li, ri = lc.entry, rc.entry
         equal = li.num_buckets == ri.num_buckets
-        return (0 if equal else 1, -(li.num_buckets + ri.num_buckets))
+        return (
+            len(lc.appended) + len(rc.appended),
+            0 if equal else 1,
+            -(li.num_buckets + ri.num_buckets),
+        )
 
     return sorted(pairs, key=key)
 
@@ -174,17 +173,29 @@ class JoinIndexRule:
                     )
                 )
 
-                l_candidates = get_candidate_indexes(index_manager, l_scan)
-                r_candidates = get_candidate_indexes(index_manager, r_scan)
+                hybrid = session.hs_conf.hybrid_scan_enabled
+                l_candidates = get_candidate_indexes(index_manager, l_scan, hybrid)
+                r_candidates = get_candidate_indexes(index_manager, r_scan, hybrid)
                 l_usable = _usable_indexes(l_candidates, lkeys, l_required)
                 r_usable = _usable_indexes(r_candidates, rkeys, r_required)
                 compatible = _compatible_pairs(l_usable, r_usable, l_to_r)
                 if not compatible:
                     return node
-                li, ri = rank_join_pairs(compatible)[0]
+                lc, rc = rank_join_pairs(compatible)[0]
+                li, ri = lc.entry, rc.entry
 
-                def substitute(side: LogicalPlan, scan: ScanNode, entry: IndexLogEntry):
-                    new_rel = _index_relation(entry, with_bucket_spec=True)
+                def substitute(side: LogicalPlan, scan: ScanNode, cand):
+                    from ..engine.logical import HybridAppend
+
+                    new_rel = _index_relation(cand.entry, with_bucket_spec=True)
+                    if cand.appended:
+                        # Hybrid Scan: appended source rows are shuffle-unioned into
+                        # the index's buckets at execution time.
+                        new_rel.hybrid_append = HybridAppend(
+                            files=cand.appended,
+                            file_format=scan.relation.file_format,
+                            schema=scan.relation.schema,
+                        )
 
                     def replace(n: LogicalPlan) -> LogicalPlan:
                         if n is scan or (
@@ -195,8 +206,8 @@ class JoinIndexRule:
 
                     return side.transform_up(replace)
 
-                new_left = substitute(node.left, l_scan, li)
-                new_right = substitute(node.right, r_scan, ri)
+                new_left = substitute(node.left, l_scan, lc)
+                new_right = substitute(node.right, r_scan, rc)
                 new_plan = JoinNode(new_left, new_right, node.condition, node.how)
                 EventLoggerFactory.get_logger(
                     session.hs_conf.event_logger_class

@@ -17,8 +17,15 @@ from ..index.signatures import create_provider
 from ..storage.filesystem import FileStatus
 
 
-def get_candidate_indexes(index_manager, plan: LogicalPlan) -> List[IndexLogEntry]:
-    """ACTIVE indexes whose signature matches `plan` (normally a relation node)."""
+def get_candidate_indexes(
+    index_manager, plan: LogicalPlan, hybrid_scan: bool = False, kind: str = "CoveringIndex"
+) -> List["CandidateIndex"]:
+    """ACTIVE indexes applicable to `plan` (normally a relation node).
+
+    Exact applicability = the recorded signature provider recomputes the same
+    signature. With `hybrid_scan` (extension, BASELINE config 3), an index whose
+    recorded source files are a strict SUBSET of the current files is also a
+    candidate, carrying the appended files to merge at execution time."""
     signature_map: Dict[str, Optional[str]] = {}
 
     def signature_valid(entry: IndexLogEntry) -> bool:
@@ -29,8 +36,45 @@ def get_candidate_indexes(index_manager, plan: LogicalPlan) -> List[IndexLogEntr
         computed = signature_map[source_sig.provider]
         return computed is not None and computed == source_sig.value
 
-    all_indexes = index_manager.get_indexes([states.ACTIVE])
-    return [e for e in all_indexes if e.created and signature_valid(e)]
+    def appended_files(entry: IndexLogEntry) -> Optional[List[FileStatus]]:
+        """Current-files minus recorded; None unless recorded ⊊ current with no
+        recorded file missing/changed."""
+        if not isinstance(plan, ScanNode):
+            return None
+        recorded = {
+            (f.name, f.size, f.modified_time)
+            for r in entry.relations
+            for f in r.data.file_infos()
+        }
+        current = plan.relation.files
+        current_keys = {(f.path, f.size, f.modified_time) for f in current}
+        if not recorded <= current_keys:
+            return None  # a recorded file vanished or changed: not hybrid-scannable
+        appended = [
+            f for f in current if (f.path, f.size, f.modified_time) not in recorded
+        ]
+        return appended if appended else None
+
+    out: List[CandidateIndex] = []
+    for e in index_manager.get_indexes([states.ACTIVE]):
+        if e.kind != kind or not e.created:
+            continue
+        if signature_valid(e):
+            out.append(CandidateIndex(e, []))
+        elif hybrid_scan:
+            appended = appended_files(e)
+            if appended is not None:
+                out.append(CandidateIndex(e, appended))
+    return out
+
+
+class CandidateIndex:
+    """An applicable index + the source files appended since it was built
+    (empty for an exact signature match)."""
+
+    def __init__(self, entry: IndexLogEntry, appended: List[FileStatus]):
+        self.entry = entry
+        self.appended = appended
 
 
 def get_scan_node(plan: LogicalPlan) -> Optional[ScanNode]:

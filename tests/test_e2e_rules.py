@@ -166,7 +166,7 @@ class TestFilterIndexE2E:
         assert scanned_index_names(q()) == set()
         enable_hyperspace(session)
         enable_hyperspace(session)  # idempotent
-        assert len(session.extra_optimizations) == 2
+        assert len(session.extra_optimizations) == 3  # join, filter, data-skipping
 
 
 class TestJoinIndexE2E:

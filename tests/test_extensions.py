@@ -1,0 +1,364 @@
+"""Extension tests: Hybrid Scan, DataSkippingIndex, incremental refresh,
+optimizeIndex, delta-style source (BASELINE.md configs 3-5 — north-star features
+absent from the v0 reference snapshot)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import IndexConfig, IndexConstants
+from hyperspace_tpu.engine import HyperspaceSession, col
+from hyperspace_tpu.engine.table import Table
+from hyperspace_tpu.hyperspace import Hyperspace, disable_hyperspace, enable_hyperspace
+from hyperspace_tpu.index.dataskipping import (
+    BloomFilterSketch,
+    DataSkippingIndexConfig,
+    MinMaxSketch,
+)
+
+import hyperspace_tpu.engine.io as eio
+
+
+@pytest.fixture()
+def session(tmp_path):
+    s = HyperspaceSession(warehouse=str(tmp_path))
+    s.conf.set(IndexConstants.INDEX_SYSTEM_PATH, str(tmp_path / "indexes"))
+    s.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 8)
+    return s
+
+
+def scanned_index_names(df):
+    out = set()
+    for n in df.physical_plan().collect_nodes():
+        rel = getattr(n, "relation", None)
+        if rel is not None and rel.index_name:
+            out.add(rel.index_name)
+    return out
+
+
+def plan_op_names(df):
+    return [n.name for n in df.physical_plan().collect_nodes()]
+
+
+class TestHybridScan:
+    def test_filter_union_with_appended_files(self, session, tmp_path):
+        """BASELINE config 3: index ∪ appended source files."""
+        session.write_parquet({"k": [1, 2, 3], "v": ["a", "b", "c"]}, str(tmp_path / "t"))
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(str(tmp_path / "t")), IndexConfig("h1", ["k"], ["v"]))
+        eio.write_parquet(Table.from_pydict({"k": [1, 9], "v": ["x", "y"]}),
+                          str(tmp_path / "t" / "appended.parquet"))
+
+        q = lambda: session.read.parquet(str(tmp_path / "t")).filter(col("k") == 1).select("v")
+        # Without hybrid scan: stale index unused.
+        enable_hyperspace(session)
+        assert scanned_index_names(q()) == set()
+        # With hybrid scan: index + appended union, correct results.
+        session.conf.set(IndexConstants.INDEX_HYBRID_SCAN_ENABLED, "true")
+        assert scanned_index_names(q()) == {"h1"}
+        assert "Union" in plan_op_names(q())
+        assert sorted(q().to_pydict()["v"]) == ["a", "x"]
+        # Oracle: identical to non-indexed.
+        disable_hyperspace(session)
+        assert sorted(q().to_pydict()["v"]) == ["a", "x"]
+
+    def test_join_shuffle_union_with_appended_files(self, session, tmp_path):
+        session.write_parquet(
+            {"k": [1, 2, 3, 4], "v": [10, 20, 30, 40]}, str(tmp_path / "l")
+        )
+        session.write_parquet(
+            {"k2": [1, 2, 3, 4, 5], "w": [100, 200, 300, 400, 500]}, str(tmp_path / "r")
+        )
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(str(tmp_path / "l")), IndexConfig("hl", ["k"], ["v"]))
+        hs.create_index(session.read.parquet(str(tmp_path / "r")), IndexConfig("hr", ["k2"], ["w"]))
+        # Append to the LEFT side only.
+        eio.write_parquet(Table.from_pydict({"k": [5, 5], "v": [55, 56]}),
+                          str(tmp_path / "l" / "appended.parquet"))
+
+        def q():
+            l = session.read.parquet(str(tmp_path / "l"))
+            r = session.read.parquet(str(tmp_path / "r"))
+            return l.join(r, col("k") == col("k2")).select("v", "w")
+
+        disable_hyperspace(session)
+        expected = q().sorted_rows()
+        assert (55, 500) in expected  # appended rows join
+
+        enable_hyperspace(session)
+        session.conf.set(IndexConstants.INDEX_HYBRID_SCAN_ENABLED, "true")
+        assert scanned_index_names(q()) == {"hl", "hr"}
+        names = plan_op_names(q())
+        assert names.count("ShuffleExchange") == 0  # still no exchange of index data
+        assert q().sorted_rows() == expected
+
+    def test_hybrid_not_used_when_recorded_file_changed(self, session, tmp_path):
+        session.write_parquet({"k": [1], "v": ["a"]}, str(tmp_path / "t"))
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(str(tmp_path / "t")), IndexConfig("h2", ["k"], ["v"]))
+        # Overwrite the recorded file (size/mtime change) -> not hybrid-scannable.
+        eio.write_parquet(Table.from_pydict({"k": [1, 2], "v": ["zz", "ww"]}),
+                          str(tmp_path / "t" / "part-00000.parquet"))
+        enable_hyperspace(session)
+        session.conf.set(IndexConstants.INDEX_HYBRID_SCAN_ENABLED, "true")
+        q = session.read.parquet(str(tmp_path / "t")).filter(col("k") == 1).select("v")
+        assert scanned_index_names(q) == set()
+        assert q.to_pydict()["v"] == ["zz"]  # correct from source
+
+
+class TestDataSkipping:
+    def _setup(self, session, tmp_path):
+        """Three files with disjoint k ranges and known c3 values."""
+        p = str(tmp_path / "ds")
+        eio.write_parquet(Table.from_pydict(
+            {"k": list(range(0, 100)), "c3": ["alpha"] * 100}), p + "/f0.parquet")
+        eio.write_parquet(Table.from_pydict(
+            {"k": list(range(100, 200)), "c3": ["beta"] * 100}), p + "/f1.parquet")
+        eio.write_parquet(Table.from_pydict(
+            {"k": list(range(200, 300)), "c3": ["gamma"] * 100}), p + "/f2.parquet")
+        return p
+
+    def test_minmax_prunes_files(self, session, tmp_path):
+        p = self._setup(session, tmp_path)
+        hs = Hyperspace(session)
+        hs.create_index(
+            session.read.parquet(p),
+            DataSkippingIndexConfig("mmIdx", [MinMaxSketch("k")]),
+        )
+        enable_hyperspace(session)
+        q = session.read.parquet(p).filter(col("k") == 150).select("k", "c3")
+        scans = [n for n in q.physical_plan().collect_nodes() if n.name == "Scan"]
+        assert len(scans[0].relation.files) == 1  # two of three files pruned
+        assert scans[0].relation.pruned_by == ["mmIdx"]
+        assert q.to_pydict() == {"k": [150], "c3": ["beta"]}
+        # range filter
+        q2 = session.read.parquet(p).filter(col("k") >= 250).select("c3")
+        scans2 = [n for n in q2.physical_plan().collect_nodes() if n.name == "Scan"]
+        assert len(scans2[0].relation.files) == 1
+        assert set(q2.to_pydict()["c3"]) == {"gamma"}
+
+    def test_bloom_prunes_files(self, session, tmp_path):
+        p = self._setup(session, tmp_path)
+        hs = Hyperspace(session)
+        hs.create_index(
+            session.read.parquet(p),
+            DataSkippingIndexConfig("bfIdx", [BloomFilterSketch("c3", 256, 4)]),
+        )
+        enable_hyperspace(session)
+        q = session.read.parquet(p).filter(col("c3") == "beta").select("k")
+        scans = [n for n in q.physical_plan().collect_nodes() if n.name == "Scan"]
+        assert len(scans[0].relation.files) == 1
+        assert len(q.to_pydict()["k"]) == 100
+        # absent value prunes everything
+        q2 = session.read.parquet(p).filter(col("c3") == "nope").select("k")
+        scans2 = [n for n in q2.physical_plan().collect_nodes() if n.name == "Scan"]
+        assert len(scans2[0].relation.files) == 0
+        assert q2.count() == 0
+
+    def test_bloom_probe_int_float_literals(self, session, tmp_path):
+        """A float literal equal in value to an int column entry must not cause a
+        false-negative prune (and vice versa)."""
+        p = str(tmp_path / "bf2")
+        eio.write_parquet(Table.from_pydict({"k": [5, 6]}), p + "/f0.parquet")
+        hs = Hyperspace(session)
+        hs.create_index(
+            session.read.parquet(p),
+            DataSkippingIndexConfig("bfT", [BloomFilterSketch("k", 128, 4)]),
+        )
+        enable_hyperspace(session)
+        q = session.read.parquet(p).filter(col("k") == 5.0).select("k")
+        scans = [n for n in q.physical_plan().collect_nodes() if n.name == "Scan"]
+        assert len(scans[0].relation.files) == 1  # NOT pruned
+        assert q.to_pydict()["k"] == [5]
+        # a non-representable literal may prune everything — and that is correct
+        q2 = session.read.parquet(p).filter(col("k") == 5.5).select("k")
+        assert q2.count() == 0
+
+    def test_skipping_index_stale_after_change(self, session, tmp_path):
+        p = self._setup(session, tmp_path)
+        hs = Hyperspace(session)
+        hs.create_index(
+            session.read.parquet(p), DataSkippingIndexConfig("stIdx", [MinMaxSketch("k")])
+        )
+        eio.write_parquet(Table.from_pydict({"k": [5000], "c3": ["delta"]}), p + "/f3.parquet")
+        enable_hyperspace(session)
+        q = session.read.parquet(p).filter(col("k") == 5000).select("c3")
+        scans = [n for n in q.physical_plan().collect_nodes() if n.name == "Scan"]
+        assert len(scans[0].relation.files) == 4  # stale: no pruning
+        assert q.to_pydict()["c3"] == ["delta"]
+        # hybrid semantics: appended file kept, old files still prunable
+        session.conf.set(IndexConstants.INDEX_HYBRID_SCAN_ENABLED, "true")
+        scans = [
+            n for n in q.physical_plan().collect_nodes() if n.name == "Scan"
+        ]
+        assert len(scans[0].relation.files) == 1  # three pruned, appended kept
+        assert q.to_pydict()["c3"] == ["delta"]
+
+    def test_refresh_data_skipping_index(self, session, tmp_path):
+        p = self._setup(session, tmp_path)
+        hs = Hyperspace(session)
+        hs.create_index(
+            session.read.parquet(p), DataSkippingIndexConfig("rfIdx", [MinMaxSketch("k")])
+        )
+        eio.write_parquet(Table.from_pydict({"k": [400], "c3": ["delta"]}), p + "/f3.parquet")
+        hs.refresh_index("rfIdx")
+        enable_hyperspace(session)
+        q = session.read.parquet(p).filter(col("k") == 400).select("c3")
+        scans = [n for n in q.physical_plan().collect_nodes() if n.name == "Scan"]
+        assert len(scans[0].relation.files) == 1
+        assert q.to_pydict()["c3"] == ["delta"]
+
+
+class TestIncrementalRefresh:
+    def test_incremental_appends_new_version(self, session, tmp_path):
+        session.write_parquet({"k": [1, 2], "v": ["a", "b"]}, str(tmp_path / "t"))
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(str(tmp_path / "t")), IndexConfig("inc", ["k"], ["v"]))
+        eio.write_parquet(Table.from_pydict({"k": [3], "v": ["c"]}),
+                          str(tmp_path / "t" / "new.parquet"))
+        hs.refresh_index("inc", mode="incremental")
+
+        entry = [e for e in hs._manager.get_indexes() if e.name == "inc"][0]
+        files = entry.content.files()
+        assert any("v__=0" in f for f in files) and any("v__=1" in f for f in files)
+
+        enable_hyperspace(session)
+        q = session.read.parquet(str(tmp_path / "t")).filter(col("k") == 3).select("v")
+        assert scanned_index_names(q) == {"inc"}
+        assert q.to_pydict()["v"] == ["c"]
+        # the whole index remains queryable
+        q2 = session.read.parquet(str(tmp_path / "t")).filter(col("k") == 1).select("v")
+        assert q2.to_pydict()["v"] == ["a"]
+
+    def test_incremental_rejects_deletes_and_noop(self, session, tmp_path):
+        from hyperspace_tpu import HyperspaceException
+
+        session.write_parquet({"k": [1], "v": ["a"]}, str(tmp_path / "t"))
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(str(tmp_path / "t")), IndexConfig("inc2", ["k"], ["v"]))
+        with pytest.raises(HyperspaceException, match="no appended"):
+            hs.refresh_index("inc2", mode="incremental")
+        # validate() fails before begin(): the index stays ACTIVE, no rollback needed
+        entry = [e for e in hs._manager.get_indexes() if e.name == "inc2"][0]
+        assert entry.state == "ACTIVE"
+        os.remove(str(tmp_path / "t" / "part-00000.parquet"))
+        eio.write_parquet(Table.from_pydict({"k": [9], "v": ["z"]}),
+                          str(tmp_path / "t" / "other.parquet"))
+        with pytest.raises(HyperspaceException, match="deleted"):
+            hs.refresh_index("inc2", mode="incremental")
+
+    def test_incremental_rejects_modified_in_place_file(self, session, tmp_path):
+        """A source file overwritten at the same path invalidates its indexed rows —
+        incremental must refuse (full rebuild required)."""
+        from hyperspace_tpu import HyperspaceException
+
+        session.write_parquet({"k": [1], "v": ["a"]}, str(tmp_path / "t"))
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(str(tmp_path / "t")), IndexConfig("mod", ["k"], ["v"]))
+        eio.write_parquet(Table.from_pydict({"k": [1, 2], "v": ["x", "y"]}),
+                          str(tmp_path / "t" / "part-00000.parquet"))  # same path, new content
+        with pytest.raises(HyperspaceException, match="modified"):
+            hs.refresh_index("mod", mode="incremental")
+        hs.refresh_index("mod", mode="full")  # full works
+        enable_hyperspace(session)
+        q = session.read.parquet(str(tmp_path / "t")).filter(col("k") == 1).select("v")
+        assert q.to_pydict()["v"] == ["x"]
+
+    def test_incremental_join_still_bucketed(self, session, tmp_path):
+        session.write_parquet({"k": [1, 2], "v": [10, 20]}, str(tmp_path / "l"))
+        session.write_parquet({"k2": [1, 2, 3], "w": [7, 8, 9]}, str(tmp_path / "r"))
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(str(tmp_path / "l")), IndexConfig("jl", ["k"], ["v"]))
+        hs.create_index(session.read.parquet(str(tmp_path / "r")), IndexConfig("jr", ["k2"], ["w"]))
+        eio.write_parquet(Table.from_pydict({"k": [3], "v": [30]}),
+                          str(tmp_path / "l" / "new.parquet"))
+        hs.refresh_index("jl", mode="incremental")
+        enable_hyperspace(session)
+        l = session.read.parquet(str(tmp_path / "l"))
+        r = session.read.parquet(str(tmp_path / "r"))
+        q = l.join(r, col("k") == col("k2")).select("v", "w")
+        assert scanned_index_names(q) == {"jl", "jr"}
+        assert plan_op_names(q).count("ShuffleExchange") == 0
+        assert q.sorted_rows() == [(10, 7), (20, 8), (30, 9)]
+
+
+class TestOptimize:
+    def test_optimize_compacts_bucket_files(self, session, tmp_path):
+        from hyperspace_tpu import HyperspaceException
+
+        session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 2)
+        session.write_parquet({"k": [1, 2, 3, 4], "v": ["a", "b", "c", "d"]}, str(tmp_path / "t"))
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(str(tmp_path / "t")), IndexConfig("opt", ["k"], ["v"]))
+        for i in range(2):
+            eio.write_parquet(
+                Table.from_pydict({"k": [10 + i], "v": [f"x{i}"]}),
+                str(tmp_path / "t" / f"new{i}.parquet"),
+            )
+            hs.refresh_index("opt", mode="incremental")
+        entry = [e for e in hs._manager.get_indexes() if e.name == "opt"][0]
+        files_before = entry.content.files()
+        assert len(files_before) > 2  # one+ file per version per bucket
+
+        hs.optimize_index("opt")  # quick mode, tiny files all below threshold
+        entry = [e for e in hs._manager.get_indexes() if e.name == "opt"][0]
+        files_after = entry.content.files()
+        buckets = {os.path.basename(f).split(".")[0] for f in files_after}
+        assert len(files_after) == len(buckets)  # one file per bucket now
+
+        enable_hyperspace(session)
+        q = session.read.parquet(str(tmp_path / "t")).filter(col("k") == 11).select("v")
+        assert scanned_index_names(q) == {"opt"}
+        assert q.to_pydict()["v"] == ["x1"]
+
+        with pytest.raises(HyperspaceException, match="no optimizable"):
+            hs.optimize_index("opt")  # nothing left to merge
+
+    def test_optimize_unknown_mode_rejected(self, session, tmp_path):
+        from hyperspace_tpu import HyperspaceException
+
+        session.write_parquet({"k": [1], "v": ["a"]}, str(tmp_path / "t"))
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(str(tmp_path / "t")), IndexConfig("m", ["k"], ["v"]))
+        with pytest.raises(HyperspaceException, match="mode"):
+            hs.optimize_index("m", mode="turbo")
+
+
+class TestDeltaSource:
+    def test_snapshot_read_and_overwrite(self, session, tmp_path):
+        p = str(tmp_path / "dtable")
+        session.write_delta({"k": [1, 2], "v": ["a", "b"]}, p)
+        session.write_delta({"k": [3], "v": ["c"]}, p, mode="append")
+        df = session.read.delta(p)
+        assert df.sorted_rows() == [(1, "a"), (2, "b"), (3, "c")]
+        session.write_delta({"k": [9], "v": ["z"]}, p, mode="overwrite")
+        assert session.read.delta(p).sorted_rows() == [(9, "z")]
+
+    def test_index_over_delta_source(self, session, tmp_path):
+        p = str(tmp_path / "dtable")
+        session.write_delta({"k": [1, 2, 3], "v": ["a", "b", "c"]}, p)
+        hs = Hyperspace(session)
+        hs.create_index(session.read.delta(p), IndexConfig("dIdx", ["k"], ["v"]))
+        enable_hyperspace(session)
+        q = lambda: session.read.delta(p).filter(col("k") == 2).select("v")
+        assert scanned_index_names(q()) == {"dIdx"}
+        assert q().to_pydict()["v"] == ["b"]
+        # append a new commit -> snapshot changes -> index stale -> refresh incremental
+        session.write_delta({"k": [4], "v": ["d"]}, p, mode="append")
+        assert scanned_index_names(q()) == set()
+        hs.refresh_index("dIdx", mode="incremental")
+        assert scanned_index_names(q()) == {"dIdx"}
+        q4 = session.read.delta(p).filter(col("k") == 4).select("v")
+        assert q4.to_pydict()["v"] == ["d"]
+
+    def test_remove_commits_respected(self, session, tmp_path):
+        from hyperspace_tpu.storage import delta as dlog
+
+        p = str(tmp_path / "dtable")
+        session.write_delta({"k": [1], "v": ["a"]}, p)
+        session.write_delta({"k": [2], "v": ["b"]}, p, mode="append")
+        files = dlog.active_files(p)
+        assert len(files) == 2
+        dlog.remove_file(p, os.path.relpath(files[0].path, p))
+        assert session.read.delta(p).sorted_rows() == [(2, "b")]
