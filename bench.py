@@ -24,8 +24,35 @@ import time
 import numpy as np
 
 
+def _ensure_live_backend(timeout_s: int = 90) -> None:
+    """Probe the default jax backend in a SUBPROCESS; if it cannot initialize within
+    the timeout (e.g. a wedged TPU tunnel), fall back to CPU in this process so the
+    bench always reports a number. The probe must be out-of-process: a hung backend
+    init inside this process would hold jax's init lock forever."""
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s,
+            capture_output=True,
+        )
+        ok = r.returncode == 0
+    except subprocess.TimeoutExpired:
+        ok = False
+    if not ok:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        print(
+            json.dumps({"warning": "default backend unreachable; benching on cpu"}),
+            file=sys.stderr,
+        )
+
+
 def main():
     t_setup0 = time.time()
+    _ensure_live_backend()
     from hyperspace_tpu import IndexConfig, IndexConstants
     from hyperspace_tpu.engine import HyperspaceSession, col
     from hyperspace_tpu.hyperspace import Hyperspace, disable_hyperspace, enable_hyperspace

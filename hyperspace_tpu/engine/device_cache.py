@@ -1,0 +1,37 @@
+"""Host→device transfer cache.
+
+A query executes many device ops over the same cached host columns; re-uploading
+them per query would dominate on a real TPU (HBM transfers over PCIe/tunnel). Device
+arrays are cached per host-array identity (weakref-keyed, so entries die with their
+host arrays — which are themselves owned by the scan cache)."""
+
+from __future__ import annotations
+
+import weakref
+
+import jax.numpy as jnp
+import numpy as np
+
+_cache: dict = {}
+
+
+def device_array(host: np.ndarray):
+    """jnp view of a host numpy array, cached by identity."""
+    if not isinstance(host, np.ndarray):
+        return jnp.asarray(host)
+    key = id(host)
+    hit = _cache.get(key)
+    if hit is not None and hit[0]() is host:
+        return hit[1]
+
+    dev = jnp.asarray(host)
+
+    def _evict(_, key=key):
+        _cache.pop(key, None)
+
+    try:
+        ref = weakref.ref(host, _evict)
+    except TypeError:
+        return dev  # non-weakref-able subclass: skip caching
+    _cache[key] = (ref, dev)
+    return dev

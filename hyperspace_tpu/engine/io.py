@@ -108,7 +108,16 @@ def read_files(
 ) -> Table:
     if not files:
         raise HyperspaceException("No data files to read.")
-    tables = [_read_one(f, file_format, columns) for f in sorted(files)]
+    from .scan_cache import global_scan_cache
+
+    cache = global_scan_cache()
+    tables = []
+    for f in sorted(files):
+        t = cache.get(f, columns)
+        if t is None:
+            t = _read_one(f, file_format, columns)
+            cache.put(f, columns, t)
+        tables.append(t)
     return tables[0] if len(tables) == 1 else Table.concat(tables)
 
 

@@ -76,6 +76,67 @@ def _expand(lo, counts, l_order, r_order, l_starts, r_starts, total: int):
     return l_global, r_global
 
 
+@partial(jax.jit, static_argnums=(2, 3))
+def _pad_only(vals, starts, num_buckets: int, cap: int, pad_value):
+    """Scatter per-row values (concatenated in bucket order) into a padded [B, cap]
+    matrix WITHOUT sorting, plus a per-bucket sortedness check."""
+    n = vals.shape[0]
+    pos = jnp.arange(n)
+    b_of_row = jnp.searchsorted(starts, pos, side="right") - 1
+    slot = pos - starts[b_of_row]
+    padded = jnp.full((num_buckets, cap), pad_value, dtype=vals.dtype)
+    padded = padded.at[b_of_row, slot].set(vals)
+    lengths = starts[1:] - starts[:-1]
+    valid = jnp.arange(cap)[None, :] < (lengths - 1)[:, None]
+    non_decreasing = jnp.where(valid, padded[:, 1:] >= padded[:, :-1], True).all()
+    return padded, lengths, non_decreasing
+
+
+def bucketed_sorted_value_join_pairs(
+    l_vals, l_starts_np: np.ndarray, r_vals, r_starts_np: np.ndarray
+):
+    """Value-direct co-bucketed join for a single numeric key when both sides'
+    buckets are ALREADY sorted by the key — the covering-index fast path: the sort
+    happened once at build time (`ops.partition.bucketize_table` orders each bucket
+    by the indexed columns), so the query needs no hashing, no argsort, and no
+    collision verification. Returns None if either side's buckets turn out unsorted
+    (multi-file buckets from incremental refresh); caller falls back to the hash path.
+    """
+    B = len(l_starts_np) - 1
+    l_lens = np.diff(l_starts_np)
+    r_lens = np.diff(r_starts_np)
+    cap_l = int(l_lens.max()) if B else 0
+    cap_r = int(r_lens.max()) if B else 0
+    if cap_l == 0 or cap_r == 0:
+        return np.empty(0, np.int64), np.empty(0, np.int64)
+
+    l_vals = jnp.asarray(l_vals)
+    r_vals = jnp.asarray(r_vals)
+    if l_vals.dtype != r_vals.dtype:
+        common = jnp.promote_types(l_vals.dtype, r_vals.dtype)
+        l_vals = l_vals.astype(common)
+        r_vals = r_vals.astype(common)
+    if jnp.issubdtype(l_vals.dtype, jnp.floating):
+        pad = jnp.asarray(jnp.finfo(l_vals.dtype).max, dtype=l_vals.dtype)
+    else:
+        pad = jnp.asarray(jnp.iinfo(l_vals.dtype).max, dtype=l_vals.dtype)
+
+    l_starts = jnp.asarray(l_starts_np)
+    r_starts = jnp.asarray(r_starts_np)
+    ls, l_len, l_sorted = _pad_only(l_vals, l_starts, B, cap_l, pad)
+    rs, r_len, r_sorted = _pad_only(r_vals, r_starts, B, cap_r, pad)
+    if not (bool(l_sorted) and bool(r_sorted)):
+        return None  # fall back to the hash path
+    lo, counts = _probe(ls, rs, l_len, r_len)
+    total = int(counts.sum())
+    if total == 0:
+        return np.empty(0, np.int64), np.empty(0, np.int64)
+    iota_l = jnp.broadcast_to(jnp.arange(cap_l)[None, :], (B, cap_l))
+    iota_r = jnp.broadcast_to(jnp.arange(cap_r)[None, :], (B, cap_r))
+    l_global, r_global = _expand(lo, counts, iota_l, iota_r, l_starts, r_starts, total)
+    return np.asarray(l_global), np.asarray(r_global)
+
+
 def bucketed_merge_join_pairs(
     l_keys, l_starts_np: np.ndarray, r_keys, r_starts_np: np.ndarray
 ) -> Tuple[np.ndarray, np.ndarray]:
