@@ -24,35 +24,60 @@ import time
 import numpy as np
 
 
-def _ensure_live_backend(timeout_s: int = 90) -> None:
+def _ensure_live_backend(timeouts_s=(60, 180)) -> dict:
     """Probe the default jax backend in a SUBPROCESS; if it cannot initialize within
     the timeout (e.g. a wedged TPU tunnel), fall back to CPU in this process so the
     bench always reports a number. The probe must be out-of-process: a hung backend
-    init inside this process would hold jax's init lock forever."""
+    init inside this process would hold jax's init lock forever.
+
+    Returns a diagnosis dict recorded in the bench JSON so a failed probe is
+    debuggable from the artifact alone (platform seen, stderr tail, per-attempt rc).
+    """
     import subprocess
 
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout_s,
-            capture_output=True,
-        )
-        ok = r.returncode == 0
-    except subprocess.TimeoutExpired:
-        ok = False
-    if not ok:
-        import jax
+    diag = {"attempts": []}
+    for timeout_s in timeouts_s:
+        try:
+            r = subprocess.run(
+                [
+                    sys.executable,
+                    "-c",
+                    "import jax; d=jax.devices(); print(d[0].platform, len(d))",
+                ],
+                timeout=timeout_s,
+                capture_output=True,
+                text=True,
+            )
+            diag["attempts"].append(
+                {
+                    "rc": r.returncode,
+                    "stdout": r.stdout.strip()[-200:],
+                    "stderr": r.stderr.strip()[-500:],
+                }
+            )
+            if r.returncode == 0:
+                diag["probe"] = "ok"
+                diag["platform"] = r.stdout.split()[0] if r.stdout.split() else "?"
+                return diag
+        except subprocess.TimeoutExpired as e:
+            diag["attempts"].append(
+                {
+                    "rc": "timeout",
+                    "timeout_s": timeout_s,
+                    "stderr": ((e.stderr or b"").decode(errors="replace")).strip()[-500:],
+                }
+            )
+    import jax
 
-        jax.config.update("jax_platforms", "cpu")
-        print(
-            json.dumps({"warning": "default backend unreachable; benching on cpu"}),
-            file=sys.stderr,
-        )
+    jax.config.update("jax_platforms", "cpu")
+    diag["probe"] = "failed; benching on cpu"
+    print(json.dumps({"warning": diag["probe"], "diag": diag}), file=sys.stderr)
+    return diag
 
 
 def main():
     t_setup0 = time.time()
-    _ensure_live_backend()
+    backend_diag = _ensure_live_backend()
     from hyperspace_tpu import IndexConfig, IndexConstants
     from hyperspace_tpu.engine import HyperspaceSession, col
     from hyperspace_tpu.hyperspace import Hyperspace, disable_hyperspace, enable_hyperspace
@@ -141,6 +166,7 @@ def main():
                         "scan_join_p50_s": round(scan_p50, 3),
                         "rows": rows_indexed,
                         "backend": __import__("jax").devices()[0].platform,
+                        "backend_probe": backend_diag,
                         "setup_s": round(time.time() - t_setup0, 1),
                     },
                 }

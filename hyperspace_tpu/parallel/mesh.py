@@ -18,6 +18,49 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 BUCKET_AXIS = "buckets"
 
 
+def force_virtual_cpu(n_devices: int = 8) -> None:
+    """Force jax onto a virtual n-device CPU platform BEFORE first backend init.
+
+    The image preloads jax at interpreter start with JAX_PLATFORMS=axon (TPU
+    tunnel), so env-var defaults alone are ignored — the already-created jax
+    config must be overridden too. Used by both the test harness (conftest) and
+    the driver's `dryrun_multichip` entry point.
+
+    The process stays CPU-pinned afterwards (a jax backend cannot be re-selected
+    once initialized); the env mutations are reverted after init so child
+    processes are unaffected.
+    """
+    import os
+    import re
+
+    old_flags = os.environ.get("XLA_FLAGS")
+    old_platforms = os.environ.get("JAX_PLATFORMS")
+    stripped = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "", old_flags or ""
+    ).strip()
+    os.environ["XLA_FLAGS"] = (
+        stripped + f" --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    avail = len(jax.devices())  # initializes the backend under our flags
+
+    for key, old in (("XLA_FLAGS", old_flags), ("JAX_PLATFORMS", old_platforms)):
+        if old is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = old
+
+    if avail < n_devices:
+        raise RuntimeError(
+            f"virtual CPU platform has {avail} devices (need {n_devices}): the jax "
+            "backend was already initialized before force_virtual_cpu ran"
+        )
+
+
 def make_mesh(num_devices: Optional[int] = None) -> Mesh:
     devices = jax.devices()
     n = num_devices or len(devices)

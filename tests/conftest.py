@@ -10,15 +10,13 @@ config before the first backend initialization.
 """
 
 import os
+import sys
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax  # noqa: E402
+from hyperspace_tpu.parallel.mesh import force_virtual_cpu  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+force_virtual_cpu(8)
 
 import pytest  # noqa: E402
 
