@@ -49,6 +49,17 @@ class IndexConstants:
     # reference analogue — Spark's parallelism came from its cluster manager).
     BUILD_MESH_DEVICES = "hyperspace.build.mesh.devices"
 
+    # Distributed execution over the ambient device mesh (TPU-native knobs; the
+    # reference's analogue is Spark's cluster, which is ambient the same way).
+    # When enabled and >1 jax device is visible, index builds exchange rows over
+    # the mesh (all_to_all) and joins execute as sharded per-bucket kernels.
+    DISTRIBUTED_ENABLED = "hyperspace.distributed.enabled"
+    DISTRIBUTED_ENABLED_DEFAULT = True
+    # Below this row count single-device execution wins (exchange + shard_map
+    # compile overhead dwarfs the work); tests set 0 to force the mesh path.
+    DISTRIBUTED_MIN_ROWS = "hyperspace.distributed.minRows"
+    DISTRIBUTED_MIN_ROWS_DEFAULT = 65536
+
 
 class SessionConf:
     """Flat string-keyed conf map with defaults (the SQLConf analogue)."""
@@ -127,3 +138,15 @@ class HyperspaceConf:
     @property
     def build_mesh_devices(self) -> int:
         return self._c.get_int(IndexConstants.BUILD_MESH_DEVICES, 1)
+
+    @property
+    def distributed_enabled(self) -> bool:
+        return self._c.get_bool(
+            IndexConstants.DISTRIBUTED_ENABLED, IndexConstants.DISTRIBUTED_ENABLED_DEFAULT
+        )
+
+    @property
+    def distributed_min_rows(self) -> int:
+        return self._c.get_int(
+            IndexConstants.DISTRIBUTED_MIN_ROWS, IndexConstants.DISTRIBUTED_MIN_ROWS_DEFAULT
+        )

@@ -111,13 +111,27 @@ def read_files(
     from .scan_cache import global_scan_cache
 
     cache = global_scan_cache()
-    tables = []
-    for f in sorted(files):
-        t = cache.get(f, columns)
-        if t is None:
-            t = _read_one(f, file_format, columns)
-            cache.put(f, columns, t)
-        tables.append(t)
+    ordered = sorted(files)
+    tables: List[Optional[Table]] = [cache.get(f, columns) for f in ordered]
+    missing = [i for i, t in enumerate(tables) if t is None]
+    if len(missing) > 1:
+        # Decode cache misses concurrently: parquet/csv decode is pyarrow C++ work
+        # that releases the GIL, so a thread pool gives real parallelism (SURVEY §7
+        # "overlap decode; don't let the device idle on file I/O").
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=min(16, len(missing))) as pool:
+            decoded = list(
+                pool.map(lambda i: _read_one(ordered[i], file_format, columns), missing)
+            )
+        for i, t in zip(missing, decoded):
+            cache.put(ordered[i], columns, t)
+            tables[i] = t
+    elif missing:
+        i = missing[0]
+        t = _read_one(ordered[i], file_format, columns)
+        cache.put(ordered[i], columns, t)
+        tables[i] = t
     return tables[0] if len(tables) == 1 else Table.concat(tables)
 
 

@@ -180,11 +180,39 @@ class BucketedIndexScanExec(PhysicalNode):
             {n: _empty_column(self.relation.schema.field(n).dtype) for n in names}
         )
 
+    def _concat_cache_key(self):
+        """Steady-state cache key: the file inventory + pruned columns. Hybrid
+        appends are merged per query (their bucketization depends on query-time
+        source state), so those scans are uncacheable."""
+        if self.relation.hybrid_append is not None:
+            return None
+        return (
+            tuple((f.path, f.size, f.modified_time) for f in self.relation.files),
+            tuple(self.columns or ()),
+        )
+
+    def execute_concat(self, ctx) -> Tuple[Table, np.ndarray]:
+        """The scan as one contiguous table + bucket start offsets (bucket b =
+        rows[starts[b]:starts[b+1]]), cached across queries."""
+        from .scan_cache import global_bucketed_cache
+
+        key = self._concat_cache_key()
+        if key is not None:
+            hit = global_bucketed_cache().get(key)
+            if hit is not None:
+                return hit
+        buckets = self.execute_buckets(ctx)
+        sizes = [0 if t is None else t.num_rows for t in buckets]
+        starts = np.zeros(len(buckets) + 1, dtype=np.int64)
+        np.cumsum(sizes, out=starts[1:])
+        tables = [t for t in buckets if t is not None and t.num_rows > 0]
+        table = Table.concat(tables) if tables else self.empty_table()
+        if key is not None:
+            global_bucketed_cache().put(key, table, starts)
+        return table, starts
+
     def execute(self, ctx) -> Table:
-        tables = [t for t in self.execute_buckets(ctx) if t is not None]
-        if not tables:
-            return self.empty_table()
-        return Table.concat(tables)
+        return self.execute_concat(ctx)[0]
 
     def simple_string(self):
         spec = self.relation.bucket_spec
@@ -258,12 +286,28 @@ class UnionExec(PhysicalNode):
         return f"Union ({len(self._children)})"
 
 
-class ShuffleExchangeExec(PhysicalNode):
-    """Hash-repartition marker — the operator the bucketed index path eliminates.
+class ExchangeInfo:
+    """Partition layout a ShuffleExchange attaches to its output table: rows are
+    grouped into `len(starts)-1` hash partitions (sorted by key64 within each), so
+    a downstream merge join of two tables exchanged on compatible keys over the
+    same mesh runs co-partitioned with no further communication."""
 
-    Single-process execution is a pass-through (all data shares one memory space); the
-    distributed executor replaces it with an all-to-all over the device mesh. Its
-    presence/absence in the plan is what explain's operator diff reports."""
+    def __init__(self, mesh, keys: List[str], starts: np.ndarray, key64_sorted: np.ndarray):
+        self.mesh = mesh
+        self.keys = keys
+        self.starts = starts
+        self.key64_sorted = key64_sorted
+
+
+class ShuffleExchangeExec(PhysicalNode):
+    """Hash-repartition — the operator the bucketed index path eliminates.
+
+    In distributed mode (ambient device mesh) this is a REAL exchange: rows ride a
+    two-pass `lax.all_to_all` to their hash partition's device and come back
+    partition-grouped, with the layout attached for the downstream merge join
+    (the engine analogue of Spark's ShuffleExchangeExec). On a single device it is
+    a pass-through — one memory space needs no data movement; the node still
+    matters there as the operator explain's diff reports as eliminated."""
 
     name = "ShuffleExchange"
 
@@ -274,8 +318,26 @@ class ShuffleExchangeExec(PhysicalNode):
     def children(self):
         return (self.child,)
 
+    def exchange_table(self, mesh, t: Table) -> Table:
+        """The real exchange: rows ride the all_to_all to their partition's device;
+        the partition layout is attached for the downstream co-partitioned join."""
+        from ..parallel.table_ops import distributed_exchange_table
+
+        exchanged, starts, k64 = distributed_exchange_table(mesh, t, self.keys)
+        exchanged.exchange_info = ExchangeInfo(
+            mesh, [k.lower() for k in self.keys], starts, k64
+        )
+        return exchanged
+
     def execute(self, ctx) -> Table:
-        return self.child.execute(ctx)
+        # Standalone execution. Under a SortMergeJoin the parent orchestrates the
+        # exchange instead (the enable decision must be made per-join: a one-sided
+        # exchange would pay the all_to_all and never use the layout).
+        t = self.child.execute(ctx)
+        mesh = ctx.session.mesh_for(t.num_rows) if ctx.session is not None else None
+        if mesh is None or t.num_rows == 0:
+            return t
+        return self.exchange_table(mesh, t)
 
     def simple_string(self):
         return f"ShuffleExchange hashpartitioning({', '.join(self.keys)})"
@@ -284,10 +346,12 @@ class ShuffleExchangeExec(PhysicalNode):
 class SortExec(PhysicalNode):
     """Sort marker (the SMJ's required child ordering).
 
-    Pass-through at execution time: the merge join sorts by key hash internally
-    (`merge_join_pairs`), so physically reordering here would double the work. The
-    node exists for plan-shape honesty — it is one of the operators the bucketed
-    index path eliminates, which explain's operator diff reports."""
+    Pass-through at execution time: in distributed mode the upstream exchange
+    already returns rows key64-sorted within each partition, and the single-device
+    merge join sorts by key hash internally (`merge_join_pairs`) — physically
+    reordering here would double the work either way. The node exists for
+    plan-shape honesty — it is one of the operators the bucketed index path
+    eliminates, which explain's operator diff reports."""
 
     name = "Sort"
 
@@ -334,9 +398,74 @@ def _gather_verified(
     return Table(out)
 
 
+_key64_cache: Dict[int, tuple] = {}
+_padded_cache: Dict[int, tuple] = {}
+
+
+def _cached_by_table(cache: Dict[int, tuple], table: Table, subkey, compute):
+    """Per-table-identity memo (weakref-keyed so entries die with their tables —
+    which are themselves owned by the scan caches)."""
+    import weakref
+
+    ent = cache.get(id(table))
+    if ent is not None and ent[0]() is table:
+        hit = ent[1].get(subkey)
+        if hit is not None:
+            return hit
+    val = compute()
+    if ent is None or ent[0]() is not table:
+        key = id(table)
+
+        def _evict(_, key=key):
+            cache.pop(key, None)
+
+        cache[key] = (weakref.ref(table, _evict), {subkey: val})
+    else:
+        ent[1][subkey] = val
+    return val
+
+
+def _padded_rep(table: Table, starts: np.ndarray, keys: List[str], force_hash: bool = False):
+    """Device-resident padded-bucket representation of one join side, cached per
+    table identity. Single numeric null-free keys go value-direct (the index build
+    already sorted each bucket by the key, so the query needs no hash and no
+    argsort — just the probe); everything else pads by key64 hash. `force_hash`
+    re-derives the hash rep when the OTHER side can't go value-direct — the probe
+    requires both sides in the same key space."""
+    from ..ops.bucket_join import pad_buckets_by_hash, pad_buckets_by_value
+
+    kt = (tuple(k.lower() for k in keys), force_hash)
+
+    def compute():
+        if not force_hash and len(keys) == 1:
+            c = table.column(keys[0])
+            if (
+                not c.is_string
+                and c.data.dtype != np.bool_
+                and getattr(c, "validity", None) is None
+            ):
+                rep = pad_buckets_by_value(jnp.asarray(c.data), starts)
+                if rep is not None:
+                    return rep
+        return pad_buckets_by_hash(_table_key64(table, list(keys)), starts)
+
+    return _cached_by_table(_padded_cache, table, kt, compute)
+
+
 def _table_key64(table: Table, keys: List[str]):
-    cols = [table.column(k) for k in keys]
-    return key64(cols, [jnp.asarray(c.data) for c in cols])
+    """Join key64 of a table, cached per table identity.
+
+    Bucketed scans return the SAME Table object across queries (BucketedConcatCache),
+    so the hashed key column stays device-resident between queries instead of being
+    re-uploaded and re-hashed — the steady-state indexed join starts at the probe."""
+
+    def compute():
+        cols = [table.column(k) for k in keys]
+        return key64(cols, [jnp.asarray(c.data) for c in cols])
+
+    return _cached_by_table(
+        _key64_cache, table, tuple(k.lower() for k in keys), compute
+    )
 
 
 def _join_tables(
@@ -372,12 +501,55 @@ class SortMergeJoinExec(PhysicalNode):
     def children(self):
         return (self.left, self.right)
 
+    @staticmethod
+    def _unwrap_exchange(node: PhysicalNode) -> Optional[ShuffleExchangeExec]:
+        if isinstance(node, SortExec):
+            node = node.child
+        return node if isinstance(node, ShuffleExchangeExec) else None
+
     def execute(self, ctx) -> Table:
         if self.bucketed:
             return self._execute_bucketed(ctx)
-        lt = self.left.execute(ctx)
-        rt = self.right.execute(ctx)
+        lex = self._unwrap_exchange(self.left)
+        rex = self._unwrap_exchange(self.right)
+        if lex is not None and rex is not None and ctx.session is not None:
+            # Joint exchange decision: both sides exchange over the mesh, or
+            # neither — a one-sided exchange would pay a full all_to_all whose
+            # co-partition layout the join could never use.
+            lt = lex.child.execute(ctx)
+            rt = rex.child.execute(ctx)
+            mesh = ctx.session.mesh_for(lt.num_rows + rt.num_rows)
+            if mesh is not None and lt.num_rows > 0 and rt.num_rows > 0:
+                lt = lex.exchange_table(mesh, lt)
+                rt = rex.exchange_table(mesh, rt)
+        else:
+            lt = self.left.execute(ctx)
+            rt = self.right.execute(ctx)
+        pairs = self._copartitioned_pairs(lt, rt)
+        if pairs is not None:
+            li, ri = pairs
+            return _gather_verified(lt, rt, self.left_keys, self.right_keys, li, ri)
         return _join_tables(lt, rt, self.left_keys, self.right_keys)
+
+    def _copartitioned_pairs(self, lt: Table, rt: Table):
+        """Distributed general join: when both children came through a real
+        ShuffleExchange on this join's keys over the same mesh, partition p of both
+        sides lives on the same device — probe them there with zero collectives."""
+        li = getattr(lt, "exchange_info", None)
+        ri = getattr(rt, "exchange_info", None)
+        if li is None or ri is None or li.mesh is not ri.mesh:
+            return None
+        if len(li.starts) != len(ri.starts):
+            return None
+        if li.keys != [k.lower() for k in self.left_keys]:
+            return None
+        if ri.keys != [k.lower() for k in self.right_keys]:
+            return None
+        from ..parallel.table_ops import distributed_bucketed_join_pairs
+
+        return distributed_bucketed_join_pairs(
+            li.mesh, li.key64_sorted, li.starts, ri.key64_sorted, ri.starts
+        )
 
     def _execute_bucketed(self, ctx) -> Table:
         """Batched co-bucketed merge join: equal keys are co-located by construction
@@ -386,31 +558,47 @@ class SortMergeJoinExec(PhysicalNode):
         [num_buckets, cap] matrices (`ops.bucket_join`), with no data exchange."""
         assert isinstance(self.left, BucketedIndexScanExec)
         assert isinstance(self.right, BucketedIndexScanExec)
-        from ..ops.bucket_join import bucketed_merge_join_pairs
+        from ..ops.bucket_join import probe_padded
 
-        def concat_with_starts(scan: BucketedIndexScanExec):
-            buckets = scan.execute_buckets(ctx)
-            sizes = [0 if t is None else t.num_rows for t in buckets]
-            starts = np.zeros(len(buckets) + 1, dtype=np.int64)
-            np.cumsum(sizes, out=starts[1:])
-            tables = [t for t in buckets if t is not None and t.num_rows > 0]
-            if not tables:
-                return scan.empty_table(), starts
-            return Table.concat(tables), starts
-
-        left, l_starts = concat_with_starts(self.left)
-        right, r_starts = concat_with_starts(self.right)
+        left, l_starts = self.left.execute_concat(ctx)
+        right, r_starts = self.right.execute_concat(ctx)
         if left.num_rows == 0 or right.num_rows == 0:
             return _gather_verified(
                 left, right, self.left_keys, self.right_keys,
                 np.empty(0, np.int64), np.empty(0, np.int64),
             )
-        li, ri = bucketed_merge_join_pairs(
-            _table_key64(left, self.left_keys),
-            l_starts,
-            _table_key64(right, self.right_keys),
-            r_starts,
+        pairs = None
+        mesh = (
+            ctx.session.mesh_for(left.num_rows + right.num_rows)
+            if ctx.session is not None
+            else None
         )
+        if mesh is not None:
+            # Sharded probe: each device joins its own bucket range with zero
+            # collectives (None when the bucket count doesn't divide the mesh).
+            from ..parallel.table_ops import distributed_bucketed_join_pairs
+
+            pairs = distributed_bucketed_join_pairs(
+                mesh,
+                _table_key64(left, self.left_keys),
+                l_starts,
+                _table_key64(right, self.right_keys),
+                r_starts,
+            )
+        if pairs is None:
+            # Single-device: cached device-resident padded matrices (value-direct
+            # when possible), so the steady-state query starts at the probe. The
+            # mode decision is JOINT: if one side can't go value-direct (e.g.
+            # multi-file buckets after incremental refresh), both probe by hash.
+            l_rep = _padded_rep(left, l_starts, self.left_keys)
+            r_rep = _padded_rep(right, r_starts, self.right_keys)
+            if l_rep.mode != r_rep.mode:
+                if l_rep.mode == "value":
+                    l_rep = _padded_rep(left, l_starts, self.left_keys, force_hash=True)
+                else:
+                    r_rep = _padded_rep(right, r_starts, self.right_keys, force_hash=True)
+            pairs = probe_padded(l_rep, r_rep)
+        li, ri = pairs
         return _gather_verified(left, right, self.left_keys, self.right_keys, li, ri)
 
     def simple_string(self):

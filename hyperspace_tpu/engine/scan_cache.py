@@ -86,3 +86,48 @@ _GLOBAL = ScanCache()
 
 def global_scan_cache() -> ScanCache:
     return _GLOBAL
+
+
+class BucketedConcatCache:
+    """Concatenated bucketed-index scan results (table + bucket start offsets),
+    keyed by the scan's file inventory (path/size/mtime per file) + pruned columns.
+
+    A bucketed index join re-assembles up to `num_buckets` per-bucket tables into
+    one contiguous table every query; with the per-file cache alone that concat
+    (plus dictionary re-unioning for strings) still runs per query. Steady-state
+    indexed queries hit here instead. Freshness rides on the same contract as the
+    scan cache: any rewrite of an index file changes its size/mtime and the key."""
+
+    def __init__(self, capacity_bytes: int = 1 << 30):
+        self._capacity = capacity_bytes
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple, Tuple[Table, object, int]]" = OrderedDict()
+        self._bytes = 0
+
+    def get(self, key) -> Optional[Tuple[Table, object]]:
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is None:
+                return None
+            self._entries.move_to_end(key)
+            return hit[0], hit[1]
+
+    def put(self, key, table: Table, starts) -> None:
+        size = _table_nbytes(table)
+        if size > self._capacity:
+            return
+        with self._lock:
+            if key in self._entries:
+                return
+            self._entries[key] = (table, starts, size)
+            self._bytes += size
+            while self._bytes > self._capacity and self._entries:
+                _, (_, _, evicted) = self._entries.popitem(last=False)
+                self._bytes -= evicted
+
+
+_BUCKETED = BucketedConcatCache()
+
+
+def global_bucketed_cache() -> BucketedConcatCache:
+    return _BUCKETED

@@ -138,6 +138,7 @@ class HyperspaceSession:
         self.fs = fs or LocalFileSystem()
         # Rule protocol: rule.apply(plan, session) -> plan.
         self.extra_optimizations: List = []
+        self._mesh = None
         HyperspaceSession._active = self
 
     @classmethod
@@ -145,6 +146,25 @@ class HyperspaceSession:
         if cls._active is None:
             raise HyperspaceException("No active HyperspaceSession.")
         return cls._active
+
+    def mesh_for(self, num_rows: int):
+        """The ambient device mesh, when distributed execution should handle this
+        many rows — the engine analogue of Spark's ambient cluster. Returns None
+        when disabled, below the row threshold, or on a single-device backend
+        (where the exchange would be pure overhead)."""
+        if not self.hs_conf.distributed_enabled:
+            return None
+        if num_rows < self.hs_conf.distributed_min_rows:
+            return None
+        import jax
+
+        if len(jax.devices()) < 2:
+            return None
+        if self._mesh is None:
+            from ..parallel.mesh import make_mesh
+
+            self._mesh = make_mesh()
+        return self._mesh
 
     @property
     def read(self) -> DataFrameReader:

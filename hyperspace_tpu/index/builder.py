@@ -90,18 +90,37 @@ class CoveringIndexBuilder(IndexerBuilder):
         indexed, _ = self._resolved_columns(df, index_config)
         table = self._prepare_index_table(df, index_config)
         num_buckets = self._session.hs_conf.num_buckets
-        sorted_table, starts = bucketize_table(table, indexed, num_buckets)
+        mesh = self._session.mesh_for(table.num_rows)
+        if mesh is not None:
+            # Cluster-wide build (the reference's repartition+bucketed-write runs on
+            # the whole Spark cluster, `CreateActionBase.scala:119-140`): rows ride
+            # an all_to_all over the mesh; identical hash → identical index files.
+            from ..parallel.table_ops import distributed_bucketize_table
+
+            sorted_table, starts = distributed_bucketize_table(
+                mesh, table, indexed, num_buckets
+            )
+        else:
+            sorted_table, starts = bucketize_table(table, indexed, num_buckets)
         os.makedirs(index_data_path, exist_ok=True)
         import numpy as np
+        from concurrent.futures import ThreadPoolExecutor
 
-        for b in range(num_buckets):
+        def write_bucket(b: int) -> None:
             lo, hi = int(starts[b]), int(starts[b + 1])
             if hi <= lo:
-                continue  # empty bucket: no file
+                return  # empty bucket: no file
             bucket_table = sorted_table.take(np.arange(lo, hi))
             engine_io.write_parquet(
                 bucket_table, os.path.join(index_data_path, f"part-{b:05d}.parquet")
             )
+
+        # Parquet encode is pyarrow C++ work that releases the GIL: writing the
+        # bucket files concurrently keeps the build from serializing on host I/O
+        # (SURVEY §7 — the executors of the reference's bucketed write ran
+        # cluster-wide for the same reason).
+        with ThreadPoolExecutor(max_workers=8) as pool:
+            list(pool.map(write_bucket, range(num_buckets)))
 
     # -- metadata derivation (reference CreateActionBase.scala:41-117) ------
 

@@ -1,7 +1,12 @@
-from .mesh import BUCKET_AXIS, make_mesh, replicated, row_sharding  # noqa: F401
+from .mesh import BUCKET_AXIS, force_virtual_cpu, make_mesh, replicated, row_sharding  # noqa: F401
 from .distributed import (  # noqa: F401
     distributed_bucketed_join_counts,
     distributed_bucketize,
     exchange_counts,
     exchange_rows,
+)
+from .table_ops import (  # noqa: F401
+    distributed_bucketed_join_pairs,
+    distributed_bucketize_table,
+    distributed_exchange_table,
 )

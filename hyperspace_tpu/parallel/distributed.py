@@ -19,7 +19,7 @@ the bucketed writer persists and the co-bucketed join consumes.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import List, Sequence, Tuple
 
 import jax
@@ -37,8 +37,13 @@ def _dest_of(h1, num_buckets: int, n_dev: int):
     return bucket * n_dev // num_buckets, bucket
 
 
-def exchange_counts(mesh: Mesh, h1, num_buckets: int) -> np.ndarray:
-    """Pass 1: [n_dev, n_dev] matrix of rows device i sends to device j."""
+# Program factories are lru_cached so repeated exchanges (every distributed build
+# and every exchanged join in a process) hit jax's compiled-computation cache
+# instead of re-tracing a fresh shard_map closure per call.
+
+
+@lru_cache(maxsize=128)
+def _counts_program(mesh: Mesh, num_buckets: int):
     n_dev = mesh.devices.size
 
     def count_fn(h1_local):
@@ -46,28 +51,21 @@ def exchange_counts(mesh: Mesh, h1, num_buckets: int) -> np.ndarray:
         one_hot = jax.nn.one_hot(dest, n_dev, dtype=jnp.int32)
         return jnp.sum(one_hot, axis=0, keepdims=True)  # [1, n_dev]
 
-    counts = jax.shard_map(
-        count_fn, mesh=mesh, in_specs=P(BUCKET_AXIS), out_specs=P(BUCKET_AXIS)
-    )(h1)
-    return np.asarray(counts)
+    return jax.jit(
+        jax.shard_map(count_fn, mesh=mesh, in_specs=P(BUCKET_AXIS), out_specs=P(BUCKET_AXIS))
+    )
 
 
-def exchange_rows(
-    mesh: Mesh,
-    h1,
-    payload: Sequence[jnp.ndarray],
-    sort_keys: Sequence[jnp.ndarray],
-    num_buckets: int,
-    cap: int,
-) -> Tuple[jnp.ndarray, jnp.ndarray, List[jnp.ndarray]]:
-    """Pass 2: all-to-all exchange + local in-bucket sort.
+def exchange_counts(mesh: Mesh, h1, num_buckets: int) -> np.ndarray:
+    """Pass 1: [n_dev, n_dev] matrix of rows device i sends to device j."""
+    return np.asarray(_counts_program(mesh, num_buckets)(h1))
 
-    Returns (bucket_ids [n_dev*cap], valid mask, payload arrays), each sharded over
-    the mesh: device d's block holds its bucket range, valid rows sorted by
-    (bucket, sort_keys...) and grouped before padding."""
+
+@lru_cache(maxsize=128)
+def _exchange_program(mesh: Mesh, num_buckets: int, cap: int):
     n_dev = mesh.devices.size
 
-    def fn(h1_local, payload_local, keys_local):
+    def fn(h1_local, valid_local, payload_local, keys_local):
         n_local = h1_local.shape[0]
         dest, _ = _dest_of(h1_local, num_buckets, n_dev)
         order = jnp.argsort(dest)
@@ -83,11 +81,7 @@ def exchange_rows(
             )
 
         # Validity travels as its own lane.
-        valid_send = jnp.zeros((n_dev, cap), dtype=jnp.int32)
-        valid_send = valid_send.at[dest_s, slot].set(1)
-        valid_recv = jax.lax.all_to_all(
-            valid_send, BUCKET_AXIS, split_axis=0, concat_axis=0, tiled=False
-        )
+        valid_recv = scatter(valid_local)
 
         h1_recv = scatter(h1_local)
         payload_recv = [scatter(c) for c in payload_local]
@@ -109,24 +103,59 @@ def exchange_rows(
         out_payload = [c.reshape(-1)[perm][None] for c in payload_recv]
         return out_bucket, out_valid, out_payload
 
-    out_bucket, out_valid, out_payload = jax.shard_map(
-        fn,
-        mesh=mesh,
-        in_specs=(P(BUCKET_AXIS), P(BUCKET_AXIS), P(BUCKET_AXIS)),
-        out_specs=(P(BUCKET_AXIS), P(BUCKET_AXIS), P(BUCKET_AXIS)),
-    )(h1, list(payload), list(sort_keys))
-    return out_bucket, out_valid, out_payload
+    return jax.jit(
+        jax.shard_map(
+            fn,
+            mesh=mesh,
+            in_specs=(P(BUCKET_AXIS), P(BUCKET_AXIS), P(BUCKET_AXIS), P(BUCKET_AXIS)),
+            out_specs=(P(BUCKET_AXIS), P(BUCKET_AXIS), P(BUCKET_AXIS)),
+        )
+    )
+
+
+def exchange_rows(
+    mesh: Mesh,
+    h1,
+    payload: Sequence[jnp.ndarray],
+    sort_keys: Sequence[jnp.ndarray],
+    num_buckets: int,
+    cap: int,
+    in_valid=None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, List[jnp.ndarray]]:
+    """Pass 2: all-to-all exchange + local in-bucket sort.
+
+    `in_valid` (optional, int32 0/1 per row, sharded like `h1`) marks padding rows
+    added by the caller to make the global row count divisible by the mesh size;
+    they travel through the exchange but come out with valid=0 (sorted last).
+
+    Returns (bucket_ids [n_dev*cap], valid mask, payload arrays), each sharded over
+    the mesh: device d's block holds its bucket range, valid rows sorted by
+    (bucket, sort_keys...) and grouped before padding."""
+    if in_valid is None:
+        in_valid = jnp.ones(h1.shape, dtype=jnp.int32)
+    return _exchange_program(mesh, num_buckets, cap)(
+        h1, in_valid, list(payload), list(sort_keys)
+    )
 
 
 def distributed_bucketize(
-    mesh: Mesh, h1, payload: Sequence[jnp.ndarray], sort_keys: Sequence[jnp.ndarray], num_buckets: int
+    mesh: Mesh,
+    h1,
+    payload: Sequence[jnp.ndarray],
+    sort_keys: Sequence[jnp.ndarray],
+    num_buckets: int,
+    in_valid=None,
 ):
     """Full two-pass distributed bucketize. Rows arrive sharded over the mesh; the
     result is (bucket_ids, valid, payload) blocks, one bucket range per device."""
+    from ..ops.bucket_join import _cap_pow2
+
     counts = exchange_counts(mesh, h1, num_buckets)
     cap = int(counts.max()) if counts.size else 0
-    cap = max(cap, 1)
-    return exchange_rows(mesh, h1, payload, sort_keys, num_buckets, cap)
+    # Quantize to the next power of two so repeated builds of growing data reuse
+    # the compiled exchange instead of recompiling per exact capacity.
+    cap = _cap_pow2(cap)
+    return exchange_rows(mesh, h1, payload, sort_keys, num_buckets, cap, in_valid)
 
 
 # ---------------------------------------------------------------------------

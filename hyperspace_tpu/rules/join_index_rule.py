@@ -41,6 +41,8 @@ def _collect_expr_refs(plan: LogicalPlan) -> List[str]:
             refs.extend(node.condition.references())
         elif isinstance(node, ProjectNode):
             refs.extend(node.column_names)
+        elif isinstance(node, JoinNode):
+            refs.extend(node.condition.references())
     return refs
 
 
@@ -159,17 +161,24 @@ class JoinIndexRule:
                 lkeys = list(dict.fromkeys(l for l, _ in oriented))
                 rkeys = [l_to_r[k.lower()] for k in lkeys]
 
-                # Required = the side plan's OUTPUT (post-projection) + every column
-                # referenced inside the side (filters/projects) + its join keys — not
-                # the base relation's full schema (reference :407-418).
+                # Required = every column of this side referenced anywhere in the
+                # WHOLE query (expressions, other joins, the top-level output) +
+                # this join's keys. The reference computes this against the
+                # column-pruned plan Spark hands it (:407-418); this engine prunes
+                # at physical planning, so the rule intersects full-plan references
+                # with each side's schema instead — an unreferenced source column
+                # must not disqualify an otherwise-covering index.
+                root_refs = set(
+                    _lower(plan.output_schema.names) + _lower(_collect_expr_refs(plan))
+                )
                 l_required = list(
                     dict.fromkeys(
-                        node.left.output_schema.names + _collect_expr_refs(node.left) + lkeys
+                        [n for n in lnames if n.lower() in root_refs] + lkeys
                     )
                 )
                 r_required = list(
                     dict.fromkeys(
-                        node.right.output_schema.names + _collect_expr_refs(node.right) + rkeys
+                        [n for n in rnames if n.lower() in root_refs] + rkeys
                     )
                 )
 
