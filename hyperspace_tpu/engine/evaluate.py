@@ -4,36 +4,63 @@ Filters and join-key computations run as jnp vector ops (VPU work under XLA). St
 semantics ride the sorted-dictionary encoding: literal comparisons are translated to
 code-space integer comparisons on the host (one dictionary binary-search per literal),
 then evaluated on device — no string processing ever reaches the TPU.
+
+Null semantics (SQL/Spark parity) ride a VALIDITY LANE: every evaluation result
+carries an optional device bool array marking which slots are non-null. Comparisons
+and arithmetic propagate invalidity; AND/OR use Kleene logic (FALSE dominates AND,
+TRUE dominates OR); `evaluate_predicate` finally keeps a row only if the value is
+true AND valid — a comparison with null is "unknown", and WHERE drops unknowns.
+`valid=None` means all-valid, keeping the null-free fast path branch-free.
 """
 
 from __future__ import annotations
 
-from typing import Dict
+from typing import Dict, Optional
 
 import jax.numpy as jnp
 import numpy as np
 
 from ..exceptions import HyperspaceException
-from .expr import BinaryOp, Col, Expr, IsIn, Lit, Not
+from .expr import BinaryOp, Col, Expr, IsIn, IsNull, Lit, Not
 from .table import Column, Table, align_dictionaries
 
 
 class _Val:
-    """Evaluation result: numeric device array, string codes + dictionary, or literal."""
+    """Evaluation result: numeric device array, string codes + dictionary, or
+    literal — plus the validity lane (None = all valid)."""
 
-    __slots__ = ("kind", "arr", "dictionary", "value")
+    __slots__ = ("kind", "arr", "dictionary", "value", "valid")
 
-    def __init__(self, kind, arr=None, dictionary=None, value=None):
+    def __init__(self, kind, arr=None, dictionary=None, value=None, valid=None):
         self.kind = kind  # "num" | "str" | "lit"
         self.arr = arr
         self.dictionary = dictionary
         self.value = value
+        self.valid = valid  # device bool array, or None
+
+
+def _and_valid(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return jnp.logical_and(a, b)
 
 
 def _device(table: Table, devcols: Dict[str, jnp.ndarray], name: str):
     if name not in devcols:
         devcols[name] = jnp.asarray(table.column(name).data)
     return devcols[name]
+
+
+def _col_valid(table: Table, devcols: Dict[str, jnp.ndarray], name: str):
+    col = table.column(name)
+    if col.validity is None:
+        return None
+    key = f"__valid__{name}"
+    if key not in devcols:
+        devcols[key] = jnp.asarray(col.validity)
+    return devcols[key]
 
 
 def _str_lit_compare(op: str, codes, dictionary: np.ndarray, lit: str):
@@ -65,33 +92,55 @@ def evaluate(expr: Expr, table: Table, devcols: Dict[str, jnp.ndarray]) -> _Val:
     if isinstance(expr, Col):
         col = table.column(expr.name)
         arr = _device(table, devcols, expr.name)
+        valid = _col_valid(table, devcols, expr.name)
         if col.is_string:
-            return _Val("str", arr, col.dictionary)
-        return _Val("num", arr)
+            return _Val("str", arr, col.dictionary, valid=valid)
+        return _Val("num", arr, valid=valid)
 
     if isinstance(expr, Lit):
         return _Val("lit", value=expr.value)
+
+    if isinstance(expr, IsNull):
+        v = evaluate(expr.child, table, devcols)
+        if v.kind == "lit":
+            is_null = v.value is None
+            n = table.num_rows
+            base = jnp.full((n,), is_null, dtype=bool)
+        elif v.valid is None:
+            base = jnp.zeros(v.arr.shape, dtype=bool)
+        else:
+            base = jnp.logical_not(v.valid)
+        if expr.negated:
+            base = jnp.logical_not(base)
+        return _Val("num", base)  # IS [NOT] NULL is never itself null
 
     if isinstance(expr, Not):
         v = evaluate(expr.child, table, devcols)
         if v.kind != "num":
             raise HyperspaceException("NOT requires a boolean operand")
-        return _Val("num", jnp.logical_not(v.arr))
+        return _Val("num", jnp.logical_not(v.arr), valid=v.valid)
 
     if isinstance(expr, IsIn):
         v = evaluate(expr.child, table, devcols)
+        values = [x for x in expr.values if x is not None]  # null ∈ list is unknown
         if v.kind == "str":
-            wanted = [str(x) for x in expr.values]
+            wanted = [str(x) for x in values]
             positions = np.searchsorted(v.dictionary, wanted)
-            valid = [
+            hits = [
                 int(c)
                 for c, x in zip(positions, wanted)
                 if c < len(v.dictionary) and v.dictionary[c] == x
             ]
-            if not valid:
-                return _Val("num", jnp.zeros(v.arr.shape, dtype=bool))
-            return _Val("num", jnp.isin(v.arr, jnp.asarray(np.asarray(valid, np.int32))))
-        return _Val("num", jnp.isin(v.arr, jnp.asarray(np.asarray(expr.values))))
+            if not hits:
+                return _Val("num", jnp.zeros(v.arr.shape, dtype=bool), valid=v.valid)
+            return _Val(
+                "num",
+                jnp.isin(v.arr, jnp.asarray(np.asarray(hits, np.int32))),
+                valid=v.valid,
+            )
+        return _Val(
+            "num", jnp.isin(v.arr, jnp.asarray(np.asarray(values))), valid=v.valid
+        )
 
     if isinstance(expr, BinaryOp):
         l = evaluate(expr.left, table, devcols)
@@ -101,19 +150,50 @@ def evaluate(expr: Expr, table: Table, devcols: Dict[str, jnp.ndarray]) -> _Val:
         if op in BinaryOp.BOOLEAN:
             if l.kind != "num" or r.kind != "num":
                 raise HyperspaceException(f"'{op}' requires boolean operands")
-            f = jnp.logical_and if op == "and" else jnp.logical_or
-            return _Val("num", f(l.arr, r.arr))
+            lv, rv = l.arr, r.arr
+            if op == "and":
+                value = jnp.logical_and(lv, rv)
+                if l.valid is None and r.valid is None:
+                    valid = None
+                else:
+                    # Kleene: known iff both known, or either side is a known FALSE.
+                    lk = l.valid if l.valid is not None else jnp.ones(lv.shape, bool)
+                    rk = r.valid if r.valid is not None else jnp.ones(rv.shape, bool)
+                    valid = (lk & rk) | (lk & ~lv) | (rk & ~rv)
+            else:
+                value = jnp.logical_or(lv, rv)
+                if l.valid is None and r.valid is None:
+                    valid = None
+                else:
+                    # Kleene: known iff both known, or either side is a known TRUE.
+                    lk = l.valid if l.valid is not None else jnp.ones(lv.shape, bool)
+                    rk = r.valid if r.valid is not None else jnp.ones(rv.shape, bool)
+                    valid = (lk & rk) | (lk & lv) | (rk & rv)
+            return _Val("num", value, valid=valid)
+
+        # A null literal compares unknown against everything.
+        if (l.kind == "lit" and l.value is None) or (r.kind == "lit" and r.value is None):
+            n = table.num_rows
+            return _Val(
+                "num", jnp.zeros((n,), dtype=bool), valid=jnp.zeros((n,), dtype=bool)
+            )
+
+        valid = _and_valid(l.valid, r.valid)
 
         # String comparisons.
         if l.kind == "str" or r.kind == "str":
             if op not in BinaryOp.COMPARISONS:
                 raise HyperspaceException(f"Arithmetic on strings is not supported: {op}")
             if l.kind == "str" and r.kind == "lit":
-                return _Val("num", _str_lit_compare(op, l.arr, l.dictionary, str(r.value)))
+                return _Val(
+                    "num", _str_lit_compare(op, l.arr, l.dictionary, str(r.value)), valid=valid
+                )
             if r.kind == "str" and l.kind == "lit":
                 flipped = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "==": "==", "!=": "!="}
                 return _Val(
-                    "num", _str_lit_compare(flipped[op], r.arr, r.dictionary, str(l.value))
+                    "num",
+                    _str_lit_compare(flipped[op], r.arr, r.dictionary, str(l.value)),
+                    valid=valid,
                 )
             if l.kind == "str" and r.kind == "str":
                 # Cross-column compare: align over the union dictionary (host), then
@@ -124,21 +204,22 @@ def evaluate(expr: Expr, table: Table, devcols: Dict[str, jnp.ndarray]) -> _Val:
                 return _Val(
                     "num",
                     _compare(op, jnp.asarray(la.data), jnp.asarray(ra.data)),
+                    valid=valid,
                 )
             raise HyperspaceException("Cannot compare string with non-string")
 
         lv = l.arr if l.kind == "num" else jnp.asarray(l.value)
         rv = r.arr if r.kind == "num" else jnp.asarray(r.value)
         if op in BinaryOp.COMPARISONS:
-            return _Val("num", _compare(op, lv, rv))
+            return _Val("num", _compare(op, lv, rv), valid=valid)
         if op == "+":
-            return _Val("num", lv + rv)
+            return _Val("num", lv + rv, valid=valid)
         if op == "-":
-            return _Val("num", lv - rv)
+            return _Val("num", lv - rv, valid=valid)
         if op == "*":
-            return _Val("num", lv * rv)
+            return _Val("num", lv * rv, valid=valid)
         if op == "/":
-            return _Val("num", lv / rv)
+            return _Val("num", lv / rv, valid=valid)
 
     raise HyperspaceException(f"Cannot evaluate expression: {expr!r}")
 
@@ -160,8 +241,11 @@ def _compare(op: str, a, b):
 
 
 def evaluate_predicate(expr: Expr, table: Table) -> jnp.ndarray:
-    """Evaluate a boolean expression over a table → device mask."""
+    """Evaluate a boolean expression over a table → device mask. A row survives
+    only when the predicate is TRUE and KNOWN (SQL WHERE drops unknowns)."""
     v = evaluate(expr, table, {})
     if v.kind != "num" or v.arr.dtype != jnp.bool_:
         raise HyperspaceException(f"Not a boolean predicate: {expr!r}")
-    return v.arr
+    if v.valid is None:
+        return v.arr
+    return jnp.logical_and(v.arr, v.valid)

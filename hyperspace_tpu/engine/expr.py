@@ -63,6 +63,16 @@ class Expr:
     def __mul__(self, other):
         return BinaryOp("*", self, _lit(other))
 
+    def is_null(self) -> "IsNull":
+        return IsNull(self, negated=False)
+
+    def is_not_null(self) -> "IsNull":
+        return IsNull(self, negated=True)
+
+    def isin(self, *values) -> "IsIn":
+        vals = values[0] if len(values) == 1 and isinstance(values[0], (list, tuple)) else values
+        return IsIn(self, list(vals))
+
     def __hash__(self):
         return hash(repr(self))
 
@@ -116,6 +126,21 @@ class Not(Expr):
 
     def __repr__(self):
         return f"(not {self.child!r})"
+
+
+class IsNull(Expr):
+    """IS NULL / IS NOT NULL — the only expressions that observe the validity lane
+    directly (and whose result is itself never null)."""
+
+    def __init__(self, child: Expr, negated: bool = False):
+        self.child = child
+        self.negated = negated
+
+    def children(self) -> Sequence[Expr]:
+        return (self.child,)
+
+    def __repr__(self):
+        return f"({self.child!r} is {'not ' if self.negated else ''}null)"
 
 
 class IsIn(Expr):

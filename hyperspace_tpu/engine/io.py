@@ -55,14 +55,30 @@ def _arrow_to_table(at: pa.Table) -> Table:
             # Dates/timestamps ride as strings (CSV/JSON readers infer them; the
             # engine's type system keeps them lexicographically ordered strings).
             arr = arr.cast(pa.string())
+        validity = None
         if arr.null_count > 0:
-            raise HyperspaceException(
-                f"Null values are not supported (column '{name}')."
-            )
+            # Nulls → validity mask over dense filled storage (numeric fill 0,
+            # string fill ""): keeps device kernels static-shape; semantics are
+            # applied at evaluation/join/display boundaries.
+            validity = ~np.asarray(arr.is_null().combine_chunks().to_numpy(zero_copy_only=False))
+            if pa.types.is_string(arr.type) or pa.types.is_large_string(arr.type) or pa.types.is_dictionary(arr.type):
+                arr = arr.fill_null("")
+            elif pa.types.is_boolean(arr.type):
+                arr = arr.fill_null(False)
+            elif pa.types.is_floating(arr.type):
+                arr = arr.fill_null(0.0)
+            else:
+                arr = arr.fill_null(0)
         np_arr = arr.to_numpy(zero_copy_only=False)
         if np_arr.dtype.kind == "O":
             np_arr = np.asarray([str(x) for x in np_arr])
-        cols[name] = Column.from_values(np_arr)
+        c = Column.from_values(np_arr)
+        if validity is not None:
+            # Re-apply canonical fills in code/data space (from_values saw fills).
+            data = c.data.copy()
+            data[~validity] = 0
+            c = Column(c.dtype, data, c.dictionary, validity)
+        cols[name] = c
     return Table(cols)
 
 
@@ -74,8 +90,12 @@ def _read_one(path: str, file_format: str, columns: Optional[List[str]] = None) 
     if file_format == "csv":
         # Keep date-like strings as strings (no timestamp inference) — the engine's
         # type system treats temporal values as lexicographically ordered strings.
+        # Empty string cells read as null (Spark CSV default), not "".
         at = pa_csv.read_csv(
-            path, convert_options=pa_csv.ConvertOptions(timestamp_parsers=[])
+            path,
+            convert_options=pa_csv.ConvertOptions(
+                timestamp_parsers=[], strings_can_be_null=True
+            ),
         )
     elif file_format == "json":
         at = _read_json_lines(path)
@@ -177,7 +197,8 @@ def table_to_arrow(table: Table) -> pa.Table:
     names = []
     for name, col in table.columns.items():
         names.append(name)
-        arrays.append(pa.array(col.decode()))
+        mask = None if col.validity is None else ~col.validity
+        arrays.append(pa.array(col.decode(), mask=mask))
     return pa.table(dict(zip(names, arrays)))
 
 
@@ -196,7 +217,7 @@ def write_json(table: Table, path: str) -> None:
     import json as _json
 
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    cols = {n: c.decode() for n, c in table.columns.items()}
+    cols = {n: c.decode_objects() for n, c in table.columns.items()}
     with open(path, "w") as f:
         for i in range(table.num_rows):
             row = {n: v[i].item() if hasattr(v[i], "item") else v[i] for n, v in cols.items()}

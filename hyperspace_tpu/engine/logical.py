@@ -190,18 +190,53 @@ class UnionNode(LogicalPlan):
         return f"Union ({len(self._children)} children)"
 
 
+_JOIN_TYPES = {
+    "inner": "inner",
+    "cross": "inner",
+    "left": "left",
+    "leftouter": "left",
+    "left_outer": "left",
+    "right": "right",
+    "rightouter": "right",
+    "right_outer": "right",
+    "full": "full",
+    "outer": "full",
+    "fullouter": "full",
+    "full_outer": "full",
+    "semi": "left_semi",
+    "leftsemi": "left_semi",
+    "left_semi": "left_semi",
+    "anti": "left_anti",
+    "leftanti": "left_anti",
+    "left_anti": "left_anti",
+}
+
+
+def normalize_join_type(how: str) -> str:
+    """Spark-compatible join-type spellings → canonical
+    {inner, left, right, full, left_semi, left_anti}."""
+    key = how.strip().lower().replace(" ", "")
+    if key not in _JOIN_TYPES:
+        from ..exceptions import HyperspaceException
+
+        raise HyperspaceException(f"Unsupported join type: {how}")
+    return _JOIN_TYPES[key]
+
+
 class JoinNode(LogicalPlan):
     def __init__(self, left: LogicalPlan, right: LogicalPlan, condition: Expr, how: str = "inner"):
         self.left = left
         self.right = right
         self.condition = condition
-        self.how = how
+        self.how = normalize_join_type(how)
 
     def children(self):
         return (self.left, self.right)
 
     @property
     def output_schema(self) -> Schema:
+        if self.how in ("left_semi", "left_anti"):
+            return self.left.output_schema
         fields = list(self.left.output_schema.fields) + list(self.right.output_schema.fields)
         return Schema(fields)
 

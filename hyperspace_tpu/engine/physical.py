@@ -369,6 +369,57 @@ class SortExec(PhysicalNode):
         return f"Sort [{', '.join(self.keys)}]"
 
 
+def _null_table_like(table: Table, n: int) -> Table:
+    """n rows of all-null columns with `table`'s schema (outer-join fill side)."""
+    out: Dict[str, Column] = {}
+    invalid = np.zeros(n, dtype=bool)
+    for name, c in table.columns.items():
+        if c.is_string:
+            d = c.dictionary if len(c.dictionary) else np.array([""], dtype="<U1")
+            out[name] = Column(c.dtype, np.zeros(n, np.int32), d, invalid.copy())
+        else:
+            out[name] = Column(c.dtype, np.zeros(n, c.data.dtype), None, invalid.copy())
+    return Table(out)
+
+
+def _assemble_join(
+    left: Table, right: Table, li: np.ndarray, ri: np.ndarray, how: str
+) -> Table:
+    """Assemble the join output from VERIFIED inner pairs. Outer variants append
+    the unmatched rows of a side paired with all-null columns of the other; semi/
+    anti project the left side only. Null-key and hash-collision pairs were
+    already dropped, so their rows land in the unmatched set — exactly SQL's
+    outer-join semantics for null keys."""
+    if how == "left_semi":
+        return left.take(np.unique(li))
+    if how == "left_anti":
+        mask = np.ones(left.num_rows, dtype=bool)
+        mask[li] = False
+        return left.take(np.nonzero(mask)[0])
+    lt_parts = [left.take(li)]
+    rt_parts = [right.take(ri)]
+    if how in ("left", "full"):
+        mask = np.ones(left.num_rows, dtype=bool)
+        mask[li] = False
+        idx = np.nonzero(mask)[0]
+        if len(idx):
+            lt_parts.append(left.take(idx))
+            rt_parts.append(_null_table_like(right, len(idx)))
+    if how in ("right", "full"):
+        mask = np.ones(right.num_rows, dtype=bool)
+        mask[ri] = False
+        idx = np.nonzero(mask)[0]
+        if len(idx):
+            lt_parts.append(_null_table_like(left, len(idx)))
+            rt_parts.append(right.take(idx))
+    lt = Table.concat(lt_parts) if len(lt_parts) > 1 else lt_parts[0]
+    rt = Table.concat(rt_parts) if len(rt_parts) > 1 else rt_parts[0]
+    out: Dict[str, Column] = dict(lt.columns)
+    for n, c in rt.columns.items():
+        out[n if n not in out else f"{n}_r"] = c
+    return Table(out)
+
+
 def _gather_verified(
     left: Table,
     right: Table,
@@ -376,8 +427,12 @@ def _gather_verified(
     right_keys: List[str],
     li: np.ndarray,
     ri: np.ndarray,
+    how: str = "inner",
 ) -> Table:
-    """Gather matched rows, dropping 64-bit hash collisions via exact key equality."""
+    """Verify candidate pairs (drop 64-bit hash collisions via exact key equality,
+    and pairs involving null keys — SQL: null never equals anything, itself
+    included; null slots share a fill value, so the equality check alone can't see
+    them), then assemble the output for the join type."""
     lcols = [left.column(k) for k in left_keys]
     rcols = [right.column(k) for k in right_keys]
     if len(li):
@@ -388,14 +443,13 @@ def _gather_verified(
             lv = lc.decode()[li]
             rv = rc.decode()[ri]
             keep &= lv == rv
+            if lc.validity is not None:
+                keep &= lc.validity[li]
+            if rc.validity is not None:
+                keep &= rc.validity[ri]
         if not keep.all():
             li, ri = li[keep], ri[keep]
-    lt = left.take(li)
-    rt = right.take(ri)
-    out: Dict[str, Column] = dict(lt.columns)
-    for n, c in rt.columns.items():
-        out[n if n not in out else f"{n}_r"] = c
-    return Table(out)
+    return _assemble_join(left, right, li, ri, how)
 
 
 _key64_cache: Dict[int, tuple] = {}
@@ -473,12 +527,13 @@ def _join_tables(
     right: Table,
     left_keys: List[str],
     right_keys: List[str],
+    how: str = "inner",
 ) -> Table:
     """Hash-key merge join of two tables with exact verification."""
     li, ri = merge_join_pairs(
         _table_key64(left, left_keys), _table_key64(right, right_keys)
     )
-    return _gather_verified(left, right, left_keys, right_keys, li, ri)
+    return _gather_verified(left, right, left_keys, right_keys, li, ri, how)
 
 
 class SortMergeJoinExec(PhysicalNode):
@@ -491,12 +546,14 @@ class SortMergeJoinExec(PhysicalNode):
         left_keys: List[str],
         right_keys: List[str],
         bucketed: bool = False,
+        how: str = "inner",
     ):
         self.left = left
         self.right = right
         self.left_keys = left_keys
         self.right_keys = right_keys
         self.bucketed = bucketed
+        self.how = how
 
     def children(self):
         return (self.left, self.right)
@@ -528,8 +585,10 @@ class SortMergeJoinExec(PhysicalNode):
         pairs = self._copartitioned_pairs(lt, rt)
         if pairs is not None:
             li, ri = pairs
-            return _gather_verified(lt, rt, self.left_keys, self.right_keys, li, ri)
-        return _join_tables(lt, rt, self.left_keys, self.right_keys)
+            return _gather_verified(
+                lt, rt, self.left_keys, self.right_keys, li, ri, self.how
+            )
+        return _join_tables(lt, rt, self.left_keys, self.right_keys, self.how)
 
     def _copartitioned_pairs(self, lt: Table, rt: Table):
         """Distributed general join: when both children came through a real
@@ -565,7 +624,7 @@ class SortMergeJoinExec(PhysicalNode):
         if left.num_rows == 0 or right.num_rows == 0:
             return _gather_verified(
                 left, right, self.left_keys, self.right_keys,
-                np.empty(0, np.int64), np.empty(0, np.int64),
+                np.empty(0, np.int64), np.empty(0, np.int64), self.how,
             )
         pairs = None
         mesh = (
@@ -599,12 +658,15 @@ class SortMergeJoinExec(PhysicalNode):
                     r_rep = _padded_rep(right, r_starts, self.right_keys, force_hash=True)
             pairs = probe_padded(l_rep, r_rep)
         li, ri = pairs
-        return _gather_verified(left, right, self.left_keys, self.right_keys, li, ri)
+        return _gather_verified(
+            left, right, self.left_keys, self.right_keys, li, ri, self.how
+        )
 
     def simple_string(self):
         mode = " (bucketed, no exchange)" if self.bucketed else ""
+        how = f" {self.how}" if self.how != "inner" else ""
         pairs = ", ".join(f"{l}={r}" for l, r in zip(self.left_keys, self.right_keys))
-        return f"SortMergeJoin [{pairs}]{mode}"
+        return f"SortMergeJoin{how} [{pairs}]{mode}"
 
 
 # ---------------------------------------------------------------------------
@@ -663,13 +725,12 @@ def plan_physical(logical: LogicalPlan, required: Optional[List[str]] = None) ->
         return UnionExec([plan_physical(c, required) for c in logical.children()])
 
     if isinstance(logical, JoinNode):
-        if logical.how != "inner":
-            raise HyperspaceException(f"Unsupported join type: {logical.how}")
         pairs = extract_equi_join_keys(logical.condition)
         if pairs is None:
             raise HyperspaceException(
                 f"Only equi-joins are supported: {logical.condition!r}"
             )
+        how = logical.how
         lschema, rschema = logical.left.output_schema, logical.right.output_schema
         lkeys, rkeys = _orient_join_keys(pairs, lschema, rschema)
 
@@ -680,6 +741,9 @@ def plan_physical(logical: LogicalPlan, required: Optional[List[str]] = None) ->
             rreq = [n for n in rschema.names if n.lower() in req] + rkeys
             lreq = list(dict.fromkeys(lreq))
             rreq = list(dict.fromkeys(rreq))
+        if how in ("left_semi", "left_anti"):
+            # Semi/anti output only the left side; the right scan needs its keys.
+            rreq = list(dict.fromkeys(rkeys))
 
         lphys = plan_physical(logical.left, lreq)
         rphys = plan_physical(logical.right, rreq)
@@ -687,9 +751,12 @@ def plan_physical(logical: LogicalPlan, required: Optional[List[str]] = None) ->
         # Bucketed fast path: both sides are bucketed index scans, partitioned on
         # exactly the join keys, listing bucket columns in the same order under the
         # L→R key mapping, with equal bucket counts → no exchange needed. (This is
-        # the planner-side re-check of the join rule's compatibility condition.)
-        if isinstance(lphys, BucketedIndexScanExec) and isinstance(
-            rphys, BucketedIndexScanExec
+        # the planner-side re-check of the join rule's compatibility condition;
+        # the rule only rewrites inner joins, but guard anyway.)
+        if (
+            how == "inner"
+            and isinstance(lphys, BucketedIndexScanExec)
+            and isinstance(rphys, BucketedIndexScanExec)
         ):
             lspec = lphys.relation.bucket_spec
             rspec = rphys.relation.bucket_spec
@@ -724,6 +791,6 @@ def plan_physical(logical: LogicalPlan, required: Optional[List[str]] = None) ->
             rphys = ScanExec(rphys.relation, rphys.columns)
         lside = SortExec(lkeys, ShuffleExchangeExec(lkeys, lphys))
         rside = SortExec(rkeys, ShuffleExchangeExec(rkeys, rphys))
-        return SortMergeJoinExec(lside, rside, lkeys, rkeys, bucketed=False)
+        return SortMergeJoinExec(lside, rside, lkeys, rkeys, bucketed=False, how=how)
 
     raise HyperspaceException(f"Cannot plan logical node: {logical.simple_string()}")

@@ -8,8 +8,12 @@ The engine analogue of a materialized Spark DataFrame partition. Design points:
   numeric; SURVEY §7 "hard parts").
 - Host representation is authoritative; `device_columns()` materializes jnp arrays for
   the jitted compute path.
-- No null support in v1: ingestion raises on nulls (honest failure, not silent wrong
-  answers).
+- **Nulls ride as validity masks** over dense filled storage (numeric fill 0,
+  string fill code 0), so device kernels stay static-shape and branch-free; null
+  SEMANTICS live at the boundaries — predicate evaluation carries a validity lane
+  (SQL: a comparison with null is not true), join verification drops pairs with
+  null keys (null never equals null), and display/IO decode back to None.
+  `validity=None` means all-valid and keeps the null-free fast paths untouched.
 """
 
 from __future__ import annotations
@@ -25,11 +29,13 @@ from .schema import BOOL, STRING, Field, Schema, dtype_from_numpy
 
 @dataclass
 class Column:
-    """One column: numeric data, or dictionary-encoded strings (codes + dictionary)."""
+    """One column: numeric data, or dictionary-encoded strings (codes + dictionary),
+    plus an optional validity mask (True = valid; None = no nulls)."""
 
     dtype: str
     data: np.ndarray  # numeric values, or int32 codes into `dictionary`
     dictionary: Optional[np.ndarray] = None  # sorted unique strings (dtype '<U*')
+    validity: Optional[np.ndarray] = None  # bool mask, True = valid
 
     def __post_init__(self):
         if self.dtype == STRING:
@@ -37,6 +43,10 @@ class Column:
             assert self.data.dtype == np.int32
         else:
             assert self.dictionary is None
+        if self.validity is not None:
+            assert self.validity.dtype == np.bool_
+            if self.validity.all():
+                self.validity = None  # normalize: all-valid keeps fast paths
 
     def __len__(self) -> int:
         return len(self.data)
@@ -45,26 +55,58 @@ class Column:
     def is_string(self) -> bool:
         return self.dtype == STRING
 
+    @property
+    def has_nulls(self) -> bool:
+        return self.validity is not None
+
     def decode(self) -> np.ndarray:
-        """Materialize values (strings decoded through the dictionary)."""
+        """Materialize RAW values (strings decoded through the dictionary). Null
+        slots hold the fill value — compute-path only; pair with `validity` or use
+        `decode_objects` for user-facing values."""
         if self.is_string:
             return self.dictionary[self.data]
         return self.data
 
+    def decode_objects(self) -> np.ndarray:
+        """User-facing values: object array with None at null slots (no-copy pass
+        through to `decode()` when the column has no nulls)."""
+        raw = self.decode()
+        if self.validity is None:
+            return raw
+        out = raw.astype(object)
+        out[~self.validity] = None
+        return out
+
     def take(self, indices: np.ndarray) -> "Column":
-        return Column(self.dtype, self.data[indices], self.dictionary)
+        v = self.validity[indices] if self.validity is not None else None
+        return Column(self.dtype, self.data[indices], self.dictionary, v)
 
     @staticmethod
     def from_values(values: np.ndarray) -> "Column":
-        """Ingest a numpy array; strings get dictionary-encoded with a sorted dict."""
-        if values.dtype.kind in ("U", "O", "S"):
+        """Ingest a numpy array; strings get dictionary-encoded with a sorted dict;
+        None entries in object arrays become nulls (validity mask + fill)."""
+        validity = None
+        if values.dtype.kind == "O":
+            null_mask = np.asarray([v is None for v in values], dtype=bool)
+            if null_mask.any():
+                validity = ~null_mask
+                fill = next((v for v in values if v is not None), "")
+                values = np.asarray([fill if v is None else v for v in values])
+            else:
+                values = np.asarray(values.tolist())
             if values.dtype.kind == "O":
-                if any(v is None for v in values):
-                    raise HyperspaceException("Null values are not supported.")
                 values = values.astype(str)
+        if values.dtype.kind in ("U", "S"):
             dictionary, codes = np.unique(values, return_inverse=True)
-            return Column(STRING, codes.astype(np.int32), dictionary)
-        return Column(dtype_from_numpy(values.dtype), values)
+            codes = codes.astype(np.int32)
+            if validity is not None:
+                codes = np.where(validity, codes, np.int32(0))
+            return Column(STRING, codes, dictionary, validity)
+        col_vals = values
+        if validity is not None:
+            fill0 = np.zeros((), dtype=col_vals.dtype)
+            col_vals = np.where(validity, col_vals, fill0)
+        return Column(dtype_from_numpy(col_vals.dtype), col_vals, None, validity)
 
 
 def _remap_codes(col: Column, new_dictionary: np.ndarray) -> np.ndarray:
@@ -80,8 +122,8 @@ def align_dictionaries(a: Column, b: Column):
         raise ValueError("align_dictionaries requires string columns")
     union = np.union1d(a.dictionary, b.dictionary)
     return (
-        Column(STRING, _remap_codes(a, union), union),
-        Column(STRING, _remap_codes(b, union), union),
+        Column(STRING, _remap_codes(a, union), union, a.validity),
+        Column(STRING, _remap_codes(b, union), union, b.validity),
     )
 
 
@@ -128,10 +170,10 @@ class Table:
         return Table({mapping.get(n, n): c for n, c in self.columns.items()})
 
     def to_pydict(self) -> Dict[str, list]:
-        return {n: c.decode().tolist() for n, c in self.columns.items()}
+        return {n: c.decode_objects().tolist() for n, c in self.columns.items()}
 
     def rows(self) -> List[tuple]:
-        decoded = [c.decode() for c in self.columns.values()]
+        decoded = [c.decode_objects() for c in self.columns.values()]
         return [tuple(col[i] for col in decoded) for i in range(self.num_rows)]
 
     def sorted_rows(self) -> List[tuple]:
@@ -153,14 +195,27 @@ class Table:
         out: Dict[str, Column] = {}
         for n in names:
             cols = [t.columns[n] for t in tables]
+            if any(c.validity is not None for c in cols):
+                validity = np.concatenate(
+                    [
+                        c.validity
+                        if c.validity is not None
+                        else np.ones(len(c), dtype=bool)
+                        for c in cols
+                    ]
+                )
+            else:
+                validity = None
             if cols[0].is_string:
                 union = cols[0].dictionary
                 for c in cols[1:]:
                     union = np.union1d(union, c.dictionary)
                 codes = np.concatenate([_remap_codes(c, union) for c in cols])
-                out[n] = Column(STRING, codes, union)
+                out[n] = Column(STRING, codes, union, validity)
             else:
-                out[n] = Column(cols[0].dtype, np.concatenate([c.data for c in cols]))
+                out[n] = Column(
+                    cols[0].dtype, np.concatenate([c.data for c in cols]), None, validity
+                )
         return Table(out)
 
     def __repr__(self):

@@ -52,6 +52,8 @@ def expr_to_dict(e: Expr) -> Dict[str, Any]:
         return {"t": "not", "child": expr_to_dict(e.child)}
     if isinstance(e, IsIn):
         return {"t": "isin", "child": expr_to_dict(e.child), "values": list(e.values)}
+    if isinstance(e, IsNull):
+        return {"t": "isnull", "child": expr_to_dict(e.child), "negated": e.negated}
     raise HyperspaceException(f"Cannot serialize expression: {e!r}")
 
 
@@ -67,6 +69,8 @@ def expr_from_dict(d: Dict[str, Any]) -> Expr:
         return Not(expr_from_dict(d["child"]))
     if t == "isin":
         return IsIn(expr_from_dict(d["child"]), d["values"])
+    if t == "isnull":
+        return IsNull(expr_from_dict(d["child"]), d.get("negated", False))
     raise HyperspaceException(f"Cannot deserialize expression tag: {t}")
 
 

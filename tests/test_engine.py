@@ -54,9 +54,10 @@ class TestTable:
         t = Table.concat([t1, t2])
         assert t.to_pydict() == {"s": ["a", "c", "b"], "n": [1, 2, 3]}
 
-    def test_nulls_rejected(self):
-        with pytest.raises(HyperspaceException, match="Null"):
-            Table.from_pydict({"s": ["a", None]})
+    def test_nulls_ride_validity_masks(self):
+        t = Table.from_pydict({"s": ["a", None], "n": [1, None]})
+        assert t.column("s").has_nulls and t.column("n").has_nulls
+        assert t.to_pydict() == {"s": ["a", None], "n": [1, None]}
 
 
 class TestIO:

@@ -1,0 +1,117 @@
+"""Non-inner join execution: left/right/full outer, semi, anti.
+
+The reference's rules skip ineligible joins but Spark still executes them; the
+engine must do the same — an outer-join query with hyperspace enabled runs
+unindexed instead of erroring (r1 VERDICT item 7). Null join keys follow SQL outer
+semantics: they never match, so their rows surface as unmatched."""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import IndexConfig, IndexConstants
+from hyperspace_tpu.engine import HyperspaceSession, col
+from hyperspace_tpu.hyperspace import Hyperspace, enable_hyperspace
+
+
+@pytest.fixture()
+def jsession(tmp_path):
+    s = HyperspaceSession(warehouse=str(tmp_path))
+    s.conf.set(IndexConstants.INDEX_SYSTEM_PATH, str(tmp_path / "indexes"))
+    s.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
+    os.makedirs(tmp_path / "l")
+    pq.write_table(
+        pa.table(
+            {
+                "k": pa.array([1, 2, 3, None], type=pa.int64()),
+                "lv": pa.array(["a", "b", "c", "d"]),
+            }
+        ),
+        str(tmp_path / "l" / "part-00000.parquet"),
+    )
+    os.makedirs(tmp_path / "r")
+    pq.write_table(
+        pa.table(
+            {
+                "k2": pa.array([2, 3, 3, 5, None], type=pa.int64()),
+                "rv": pa.array([20, 30, 31, 50, 99], type=pa.int64()),
+            }
+        ),
+        str(tmp_path / "r" / "part-00000.parquet"),
+    )
+    return s, str(tmp_path)
+
+
+def _dfs(s, base):
+    return (
+        s.read.parquet(os.path.join(base, "l")),
+        s.read.parquet(os.path.join(base, "r")),
+    )
+
+
+def test_left_outer(jsession):
+    s, base = jsession
+    l, r = _dfs(s, base)
+    got = l.join(r, col("k") == col("k2"), how="left").select("lv", "rv").sorted_rows()
+    assert got == sorted(
+        [("a", None), ("b", 20), ("c", 30), ("c", 31), ("d", None)],
+        key=lambda t: tuple(str(x) for x in t),
+    )
+
+
+def test_right_outer(jsession):
+    s, base = jsession
+    l, r = _dfs(s, base)
+    got = l.join(r, col("k") == col("k2"), how="right").select("lv", "rv").sorted_rows()
+    assert sorted(x for _, x in got) == sorted([20, 30, 31, 50, 99])
+    assert (None, 50) in got and (None, 99) in got
+
+
+def test_full_outer(jsession):
+    s, base = jsession
+    l, r = _dfs(s, base)
+    got = l.join(r, col("k") == col("k2"), how="full").select("lv", "rv").sorted_rows()
+    assert len(got) == 7  # 3 matches + 2 left-unmatched + 2 right-unmatched
+    assert ("a", None) in got and ("d", None) in got
+    assert (None, 50) in got and (None, 99) in got
+
+
+def test_semi_and_anti(jsession):
+    s, base = jsession
+    l, r = _dfs(s, base)
+    semi = l.join(r, col("k") == col("k2"), how="left_semi").select("lv").sorted_rows()
+    assert semi == [("b",), ("c",)]
+    anti = l.join(r, col("k") == col("k2"), how="left_anti").select("lv").sorted_rows()
+    assert anti == [("a",), ("d",)]
+
+
+def test_join_type_spellings(jsession):
+    s, base = jsession
+    l, r = _dfs(s, base)
+    a = l.join(r, col("k") == col("k2"), how="leftouter").select("lv", "rv").sorted_rows()
+    b = l.join(r, col("k") == col("k2"), how="LEFT_OUTER").select("lv", "rv").sorted_rows()
+    assert a == b
+
+
+def test_outer_join_runs_with_hyperspace_enabled(jsession):
+    """The covering-index rules must skip the outer join, not break it
+    (reference FilterIndexRule.scala:74-78 'never break the user's query')."""
+    s, base = jsession
+    hs = Hyperspace(s)
+    l, r = _dfs(s, base)
+    hs.create_index(l, IndexConfig("lIdx", ["k"], ["lv"]))
+    hs.create_index(r, IndexConfig("rIdx", ["k2"], ["rv"]))
+    enable_hyperspace(s)
+    l, r = _dfs(s, base)
+    q = l.join(r, col("k") == col("k2"), how="left").select("lv", "rv")
+    plan = q.explain_string()
+    assert "bucketed, no exchange" not in plan  # rule correctly skipped
+    got = q.sorted_rows()
+    assert len(got) == 5
+
+    # The inner join over the same data still uses both indexes.
+    qi = l.join(r, col("k") == col("k2"), how="inner").select("lv", "rv")
+    assert "bucketed, no exchange" in qi.explain_string()
