@@ -122,7 +122,10 @@ def evaluate(expr: Expr, table: Table, devcols: Dict[str, jnp.ndarray]) -> _Val:
 
     if isinstance(expr, IsIn):
         v = evaluate(expr.child, table, devcols)
-        values = [x for x in expr.values if x is not None]  # null ∈ list is unknown
+        # Kleene: `x IN (v1, NULL)` is TRUE on match, else UNKNOWN (never FALSE) —
+        # so NOT(... IN (.., NULL)) must drop non-matching rows, like SQL/Spark.
+        had_null = any(x is None for x in expr.values)
+        values = [x for x in expr.values if x is not None]
         if v.kind == "str":
             wanted = [str(x) for x in values]
             positions = np.searchsorted(v.dictionary, wanted)
@@ -132,15 +135,18 @@ def evaluate(expr: Expr, table: Table, devcols: Dict[str, jnp.ndarray]) -> _Val:
                 if c < len(v.dictionary) and v.dictionary[c] == x
             ]
             if not hits:
-                return _Val("num", jnp.zeros(v.arr.shape, dtype=bool), valid=v.valid)
-            return _Val(
-                "num",
-                jnp.isin(v.arr, jnp.asarray(np.asarray(hits, np.int32))),
-                valid=v.valid,
-            )
-        return _Val(
-            "num", jnp.isin(v.arr, jnp.asarray(np.asarray(values))), valid=v.valid
-        )
+                match = jnp.zeros(v.arr.shape, dtype=bool)
+            else:
+                match = jnp.isin(v.arr, jnp.asarray(np.asarray(hits, np.int32)))
+        else:
+            if not values:
+                match = jnp.zeros(v.arr.shape, dtype=bool)
+            else:
+                match = jnp.isin(v.arr, jnp.asarray(np.asarray(values)))
+        valid = v.valid
+        if had_null:
+            valid = _and_valid(valid, match)
+        return _Val("num", match, valid=valid)
 
     if isinstance(expr, BinaryOp):
         l = evaluate(expr.left, table, devcols)

@@ -14,7 +14,7 @@ from typing import Dict, List, Optional, Sequence
 
 from ..storage.filesystem import FileStatus
 from .expr import Expr
-from .schema import Schema
+from .schema import Field, Schema
 
 
 @dataclass
@@ -245,6 +245,104 @@ class JoinNode(LogicalPlan):
 
     def simple_string(self):
         return f"Join {self.how} on {self.condition!r}"
+
+
+class AggregateNode(LogicalPlan):
+    """GROUP BY + aggregates (sum/count/min/max/avg). The reference gets this from
+    Spark SQL for free (`docs/_docs/13-toh-overview.md:33-36` — index scans
+    accelerate whatever query encloses them); here it is an IR node so rewrite
+    rules fire underneath aggregation-bearing queries (the TPC-H/DS shapes in
+    BASELINE.md). `aggs` = [(out_name, fn, column|None)]; column None = count(*)."""
+
+    def __init__(self, group_keys: Sequence[str], aggs: Sequence[tuple], child: LogicalPlan):
+        from ..ops.aggregate import result_dtype  # validates fn names/dtypes
+
+        self.group_keys = list(group_keys)
+        self.aggs = [tuple(a) for a in aggs]
+        self.child = child
+        schema = child.output_schema
+        fields = [schema.field(k) for k in self.group_keys]
+        seen = {f.name.lower() for f in fields}
+        for out_name, fn, col in self.aggs:
+            if out_name.lower() in seen:
+                from ..exceptions import HyperspaceException
+
+                raise HyperspaceException(
+                    f"Duplicate aggregate output name: {out_name!r}"
+                )
+            seen.add(out_name.lower())
+            in_dtype = schema.field(col).dtype if col is not None else None
+            fields.append(Field(out_name, result_dtype(fn, in_dtype)))
+        self._schema = Schema(fields)
+
+    def children(self):
+        return (self.child,)
+
+    @property
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def with_children(self, children):
+        return AggregateNode(self.group_keys, self.aggs, children[0])
+
+    def references(self) -> List[str]:
+        return self.group_keys + [c for _, _, c in self.aggs if c is not None]
+
+    def simple_string(self):
+        aggs = ", ".join(
+            f"{o}={fn}({c if c is not None else '*'})" for o, fn, c in self.aggs
+        )
+        keys = ", ".join(self.group_keys)
+        return f"Aggregate [{keys}] [{aggs}]"
+
+
+class OrderByNode(LogicalPlan):
+    """ORDER BY: `keys` = [(column, ascending)]. Null ordering follows Spark's
+    default (nulls first ascending, last descending)."""
+
+    def __init__(self, keys: Sequence[tuple], child: LogicalPlan):
+        self.keys = [(k, bool(asc)) for k, asc in keys]
+        self.child = child
+        for k, _ in self.keys:
+            child.output_schema.field(k)  # resolve-or-raise
+
+    def children(self):
+        return (self.child,)
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.child.output_schema
+
+    def with_children(self, children):
+        return OrderByNode(self.keys, children[0])
+
+    def references(self) -> List[str]:
+        return [k for k, _ in self.keys]
+
+    def simple_string(self):
+        keys = ", ".join(f"{k} {'ASC' if a else 'DESC'}" for k, a in self.keys)
+        return f"OrderBy [{keys}]"
+
+
+class LimitNode(LogicalPlan):
+    def __init__(self, n: int, child: LogicalPlan):
+        if n < 0:
+            raise ValueError(f"limit must be non-negative: {n}")
+        self.n = int(n)
+        self.child = child
+
+    def children(self):
+        return (self.child,)
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.child.output_schema
+
+    def with_children(self, children):
+        return LimitNode(self.n, children[0])
+
+    def simple_string(self):
+        return f"Limit {self.n}"
 
 
 def find_single_relation(plan: LogicalPlan) -> Optional[ScanNode]:

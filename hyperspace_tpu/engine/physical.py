@@ -32,10 +32,13 @@ from . import io as engine_io
 from .evaluate import evaluate_predicate
 from .expr import Col, Expr, extract_equi_join_keys
 from .logical import (
+    AggregateNode,
     BucketSpec,
     FilterNode,
     JoinNode,
+    LimitNode,
     LogicalPlan,
+    OrderByNode,
     ProjectNode,
     ScanNode,
     SourceRelation,
@@ -369,6 +372,96 @@ class SortExec(PhysicalNode):
         return f"Sort [{', '.join(self.keys)}]"
 
 
+class HashAggregateExec(PhysicalNode):
+    """Grouped aggregation via device hash-sort + segment reductions
+    (`ops.aggregate.hash_aggregate`)."""
+
+    name = "HashAggregate"
+
+    def __init__(self, group_keys: Sequence[str], aggs: Sequence[tuple], child: PhysicalNode):
+        self.group_keys = list(group_keys)
+        self.aggs = [tuple(a) for a in aggs]
+        self.child = child
+
+    def children(self):
+        return (self.child,)
+
+    def execute(self, ctx) -> Table:
+        from ..ops.aggregate import hash_aggregate
+
+        return hash_aggregate(self.child.execute(ctx), self.group_keys, self.aggs)
+
+    def simple_string(self):
+        aggs = ", ".join(
+            f"{o}={fn}({c if c is not None else '*'})" for o, fn, c in self.aggs
+        )
+        return f"HashAggregate [{', '.join(self.group_keys)}] [{aggs}]"
+
+
+class OrderByExec(PhysicalNode):
+    """Total ORDER BY — a presentation operator whose output returns to the host
+    anyway, so the sort runs as one host lexsort over the (validity, value) lanes.
+    String columns sort by dictionary code (dictionaries are sorted, so code order
+    IS value order). Nulls: Spark default — first ascending, last descending."""
+
+    name = "OrderBy"
+
+    def __init__(self, keys: Sequence[tuple], child: PhysicalNode):
+        self.keys = [(k, bool(asc)) for k, asc in keys]
+        self.child = child
+
+    def children(self):
+        return (self.child,)
+
+    def execute(self, ctx) -> Table:
+        t = self.child.execute(ctx)
+        if t.num_rows <= 1:
+            return t
+        lanes = []
+        # np.lexsort sorts by the LAST key first → feed (value, validity) pairs in
+        # reverse key order, validity after value so it is the more-significant lane.
+        for name, asc in reversed(self.keys):
+            c = t.column(name)
+            data = c.data.astype(np.int64) if c.is_string else c.data
+            valid = c.validity if c.validity is not None else np.ones(t.num_rows, bool)
+            if asc:
+                lanes.append(data)
+                lanes.append(valid)  # False (nulls) sorts first
+            else:
+                # Descending via negated DENSE RANK (negating raw int64 would
+                # overflow at INT64_MIN; equal values must share a rank so
+                # less-significant lanes still break ties).
+                _, inv = np.unique(data, return_inverse=True)
+                lanes.append(-inv.astype(np.int64))
+                lanes.append(~valid)  # nulls last
+        order = np.lexsort(tuple(lanes))
+        return t.take(order)
+
+    def simple_string(self):
+        keys = ", ".join(f"{k} {'ASC' if a else 'DESC'}" for k, a in self.keys)
+        return f"OrderBy [{keys}]"
+
+
+class LimitExec(PhysicalNode):
+    name = "Limit"
+
+    def __init__(self, n: int, child: PhysicalNode):
+        self.n = int(n)
+        self.child = child
+
+    def children(self):
+        return (self.child,)
+
+    def execute(self, ctx) -> Table:
+        t = self.child.execute(ctx)
+        if t.num_rows <= self.n:
+            return t
+        return t.take(np.arange(self.n))
+
+    def simple_string(self):
+        return f"Limit {self.n}"
+
+
 def _null_table_like(table: Table, n: int) -> Table:
     """n rows of all-null columns with `table`'s schema (outer-join fill side)."""
     out: Dict[str, Column] = {}
@@ -437,12 +530,18 @@ def _gather_verified(
     rcols = [right.column(k) for k in right_keys]
     if len(li):
         keep = np.ones(len(li), dtype=bool)
-        for lc, rc in zip(lcols, rcols):
+        for lk, rk, lc, rc in zip(left_keys, right_keys, lcols, rcols):
             if lc.is_string != rc.is_string:
                 raise HyperspaceException("Join key type mismatch (string vs numeric)")
-            lv = lc.decode()[li]
-            rv = rc.decode()[ri]
-            keep &= lv == rv
+            if lc.is_string:
+                # Compare codes through the cached union-dictionary alignment:
+                # aligned codes are equal iff the strings are (dictionaries are
+                # sorted-unique), and the alignment is computed once per table
+                # pair, not per query — no full-column decode on the hot path.
+                la, ra = _aligned_key_codes(left, right, lk, rk)
+                keep &= la[li] == ra[ri]
+            else:
+                keep &= lc.data[li] == rc.data[ri]
             if lc.validity is not None:
                 keep &= lc.validity[li]
             if rc.validity is not None:
@@ -454,29 +553,96 @@ def _gather_verified(
 
 _key64_cache: Dict[int, tuple] = {}
 _padded_cache: Dict[int, tuple] = {}
+_verify_cache: Dict[tuple, tuple] = {}
+
+# Device-resident memo budget. The padded/key64 reps pin device memory (~2x key
+# bytes per join-key set) independent of the host-table scan caches, so they get
+# their own byte bound: least-recently-used TABLE entries are dropped when the
+# total crosses the budget (re-derivable at the cost of one re-pad).
+_DEVICE_CACHE_BUDGET_BYTES = 2 << 30
+_device_cache_bytes = 0
+
+
+def _val_nbytes(val) -> int:
+    total = 0
+    stack = [val]
+    while stack:
+        x = stack.pop()
+        if isinstance(x, (tuple, list)):
+            stack.extend(x)
+        else:
+            total += int(getattr(x, "nbytes", 0) or 0)
+    return total
 
 
 def _cached_by_table(cache: Dict[int, tuple], table: Table, subkey, compute):
     """Per-table-identity memo (weakref-keyed so entries die with their tables —
-    which are themselves owned by the scan caches)."""
+    which are themselves owned by the scan caches). Byte-bounded: when the total
+    device bytes held across the key64/padded caches exceed the budget, other
+    tables' entries are evicted oldest-first."""
     import weakref
 
+    global _device_cache_bytes
     ent = cache.get(id(table))
     if ent is not None and ent[0]() is table:
         hit = ent[1].get(subkey)
         if hit is not None:
+            # Refresh recency (dicts iterate in insertion order; eviction below
+            # walks from the front, so re-inserting on hit makes it a real LRU).
+            cache[id(table)] = cache.pop(id(table))
             return hit
     val = compute()
+    nbytes = _val_nbytes(val)
     if ent is None or ent[0]() is not table:
         key = id(table)
 
-        def _evict(_, key=key):
-            cache.pop(key, None)
+        def _evict(_, key=key, cache=cache):
+            global _device_cache_bytes
+            dropped = cache.pop(key, None)
+            if dropped is not None:
+                _device_cache_bytes -= sum(_val_nbytes(v) for v in dropped[1].values())
 
         cache[key] = (weakref.ref(table, _evict), {subkey: val})
     else:
         ent[1][subkey] = val
+    _device_cache_bytes += nbytes
+    # Evict least-recently-inserted OTHER tables while over budget.
+    while _device_cache_bytes > _DEVICE_CACHE_BUDGET_BYTES:
+        victim = None
+        for c in (_key64_cache, _padded_cache):
+            for k in c:
+                if k != id(table):
+                    victim = (c, k)
+                    break
+            if victim:
+                break
+        if victim is None:
+            break
+        dropped = victim[0].pop(victim[1], None)
+        if dropped is not None:
+            _device_cache_bytes -= sum(_val_nbytes(v) for v in dropped[1].values())
     return val
+
+
+def _aligned_key_codes(left: Table, right: Table, lkey: str, rkey: str):
+    """Union-dictionary-aligned code arrays for one string join-key pair, cached
+    per (left, right) table identity so steady-state verification never decodes
+    the raw strings (`_gather_verified` previously decoded both full columns per
+    query)."""
+    import weakref
+
+    key = (id(left), id(right), lkey.lower(), rkey.lower())
+    ent = _verify_cache.get(key)
+    if ent is not None and ent[0]() is left and ent[1]() is right:
+        return ent[2]
+    lc, rc = align_dictionaries(left.column(lkey), right.column(rkey))
+    la, ra = lc.data, rc.data
+
+    def _evict(_, key=key):
+        _verify_cache.pop(key, None)
+
+    _verify_cache[key] = (weakref.ref(left, _evict), weakref.ref(right, _evict), (la, ra))
+    return la, ra
 
 
 def _padded_rep(table: Table, starts: np.ndarray, keys: List[str], force_hash: bool = False):
@@ -634,7 +800,8 @@ class SortMergeJoinExec(PhysicalNode):
         )
         if mesh is not None:
             # Sharded probe: each device joins its own bucket range with zero
-            # collectives (None when the bucket count doesn't divide the mesh).
+            # collectives (non-divisible bucket counts are padded with empty
+            # virtual buckets inside).
             from ..parallel.table_ops import distributed_bucketed_join_pairs
 
             pairs = distributed_bucketed_join_pairs(
@@ -723,6 +890,29 @@ def plan_physical(logical: LogicalPlan, required: Optional[List[str]] = None) ->
 
     if isinstance(logical, UnionNode):
         return UnionExec([plan_physical(c, required) for c in logical.children()])
+
+    if isinstance(logical, AggregateNode):
+        # The aggregate consumes only its group keys + agg inputs; push that set
+        # down as the pruning frontier (outer `required` cannot reach past an
+        # aggregate — its outputs are new names).
+        child_required = list(dict.fromkeys(logical.references()))
+        if not child_required:
+            # Pure count(*): keep one column so the scan still yields row counts.
+            child_required = logical.child.output_schema.names[:1] or None
+        return HashAggregateExec(
+            logical.group_keys, logical.aggs, plan_physical(logical.child, child_required)
+        )
+
+    if isinstance(logical, OrderByNode):
+        child_required = None
+        if required is not None:
+            child_required = list(
+                dict.fromkeys(list(required) + [k for k, _ in logical.keys])
+            )
+        return OrderByExec(logical.keys, plan_physical(logical.child, child_required))
+
+    if isinstance(logical, LimitNode):
+        return LimitExec(logical.n, plan_physical(logical.child, required))
 
     if isinstance(logical, JoinNode):
         pairs = extract_equi_join_keys(logical.condition)

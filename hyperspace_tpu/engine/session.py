@@ -18,7 +18,17 @@ from ..exceptions import HyperspaceException
 from ..storage.filesystem import FileSystem, LocalFileSystem
 from . import io as engine_io
 from .expr import Expr
-from .logical import FilterNode, JoinNode, LogicalPlan, ProjectNode, ScanNode, SourceRelation
+from .logical import (
+    AggregateNode,
+    FilterNode,
+    JoinNode,
+    LimitNode,
+    LogicalPlan,
+    OrderByNode,
+    ProjectNode,
+    ScanNode,
+    SourceRelation,
+)
 from .physical import ExecContext, PhysicalNode, plan_physical
 from .table import Table
 
@@ -44,6 +54,35 @@ class DataFrame:
 
     def join(self, other: "DataFrame", on: Expr, how: str = "inner") -> "DataFrame":
         return DataFrame(self.session, JoinNode(self.plan, other.plan, on, how))
+
+    def group_by(self, *keys: str) -> "GroupedDataFrame":
+        names = list(keys[0]) if len(keys) == 1 and isinstance(keys[0], (list, tuple)) else list(keys)
+        for n in names:
+            self.plan.output_schema.field(n)  # resolve-or-raise
+        return GroupedDataFrame(self, names)
+
+    groupBy = group_by
+
+    def agg(self, **aggs) -> "DataFrame":
+        """Global aggregation (no grouping): `df.agg(total=("qty", "sum"))`."""
+        return GroupedDataFrame(self, []).agg(**aggs)
+
+    def order_by(self, *keys, ascending: bool = True) -> "DataFrame":
+        """ORDER BY. Keys are column names or (name, ascending) pairs; the
+        `ascending` kwarg is the default for bare names."""
+        parsed = []
+        for k in keys:
+            if isinstance(k, tuple):
+                parsed.append((k[0], bool(k[1])))
+            else:
+                parsed.append((k, ascending))
+        return DataFrame(self.session, OrderByNode(parsed, self.plan))
+
+    orderBy = order_by
+    sort = order_by
+
+    def limit(self, n: int) -> "DataFrame":
+        return DataFrame(self.session, LimitNode(n, self.plan))
 
     # -- actions ------------------------------------------------------------
 
@@ -72,6 +111,49 @@ class DataFrame:
 
     def explain_string(self) -> str:
         return self.physical_plan().tree_string()
+
+
+class GroupedDataFrame:
+    """`df.group_by(keys)` → aggregation builder (the Spark RelationalGroupedDataset
+    analogue, sized to the five SQL aggregates the engine executes on device)."""
+
+    def __init__(self, df: DataFrame, keys: List[str]):
+        self._df = df
+        self._keys = keys
+
+    def agg(self, **aggs) -> DataFrame:
+        """`.agg(out_name=("column", "fn"), ...)` with fn ∈ sum|count|min|max|avg;
+        `.agg(n=("*", "count"))` is count(*)."""
+        if not aggs:
+            raise HyperspaceException("agg() requires at least one aggregate")
+        triples = []
+        for out_name, spec in aggs.items():
+            if not (isinstance(spec, tuple) and len(spec) == 2):
+                raise HyperspaceException(
+                    f"agg spec must be (column, fn): {out_name}={spec!r}"
+                )
+            col, fn = spec
+            col = None if col in ("*", None) else col
+            triples.append((out_name, fn.lower(), col))
+        return DataFrame(
+            self._df.session, AggregateNode(self._keys, triples, self._df.plan)
+        )
+
+    def count(self) -> DataFrame:
+        """Spark-style `groupBy(...).count()` → a `count` column of group sizes."""
+        return self.agg(count=("*", "count"))
+
+    def sum(self, *cols: str) -> DataFrame:
+        return self.agg(**{f"sum({c})": (c, "sum") for c in cols})
+
+    def min(self, *cols: str) -> DataFrame:
+        return self.agg(**{f"min({c})": (c, "min") for c in cols})
+
+    def max(self, *cols: str) -> DataFrame:
+        return self.agg(**{f"max({c})": (c, "max") for c in cols})
+
+    def avg(self, *cols: str) -> DataFrame:
+        return self.agg(**{f"avg({c})": (c, "avg") for c in cols})
 
 
 class DataFrameReader:

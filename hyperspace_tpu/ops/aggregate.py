@@ -1,0 +1,283 @@
+"""Grouped aggregation on device: hash-sort + segment reductions.
+
+The reference's real workloads are aggregation-bearing SQL (every BASELINE TPC-H/DS
+config groups the indexed join's output; the reference gets GROUP BY for free from
+Spark — `docs/_docs/13-toh-overview.md:33-36`). The engine analogue, TPU-first:
+
+1. key64-hash the group columns on device (`ops.hashing`), argsort once — equal key
+   tuples are guaranteed adjacent because equal tuples hash equal (null slots hold
+   the canonical fill, so they hash equal too and form one cluster).
+2. Detect group boundaries by comparing ADJACENT ACTUAL values (+ validity lanes),
+   not hashes — so a 64-bit hash collision between different tuples can only SPLIT
+   a group (the colliding tuples interleave within one sorted run), never merge two.
+3. Segment-reduce every aggregate in one device pass (`jax.ops.segment_sum/min/max`
+   with a static segment count → compiled once per shape class).
+4. A host pass dedups representative key tuples; the astronomically-rare split from
+   step 2 is repaired by recomputing on host — the exactness contract matches the
+   join path's verify step (hash suggests, values decide).
+
+SQL semantics: group-key nulls form one group (GROUP BY groups nulls); sum/min/max/
+avg ignore null inputs and are NULL for all-null groups; count(col) counts non-null,
+count(*) counts rows.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..engine.schema import BOOL, FLOAT32, FLOAT64, INT32, INT64, STRING
+from ..engine.table import Column, Table
+from ..exceptions import HyperspaceException
+from .hashing import key64
+
+#: (out_name, fn, column|None) — column is None only for count(*).
+AggTriple = Tuple[str, str, Optional[str]]
+
+_NUMERIC = (INT32, INT64, FLOAT32, FLOAT64, BOOL)
+
+
+def result_dtype(fn: str, in_dtype: Optional[str]) -> str:
+    """Aggregate result type: count→int64; avg→float64; sum widens to int64/float64;
+    min/max preserve the input type (strings included — dictionary order is value
+    order because dictionaries are sorted)."""
+    if fn == "count":
+        return INT64
+    if in_dtype is None:
+        raise HyperspaceException(f"{fn}() requires a column")
+    if fn == "avg":
+        if in_dtype not in _NUMERIC:
+            raise HyperspaceException(f"avg() unsupported for {in_dtype}")
+        return FLOAT64
+    if fn == "sum":
+        if in_dtype in (FLOAT32, FLOAT64):
+            return FLOAT64
+        if in_dtype in (INT32, INT64, BOOL):
+            return INT64
+        raise HyperspaceException(f"sum() unsupported for {in_dtype}")
+    if fn in ("min", "max"):
+        return in_dtype
+    raise HyperspaceException(f"Unknown aggregate function: {fn}")
+
+
+def _out_column(
+    fn: str, col: Optional[Column], dtype: str, vals: np.ndarray, validity
+) -> Column:
+    """Package reduced values (+ all-null-group validity) as an output column."""
+    v = None if validity is None or bool(np.all(validity)) else np.asarray(validity, bool)
+    if dtype == STRING:
+        d = col.dictionary if col is not None and len(col.dictionary) else np.array([""], "<U1")
+        codes = np.asarray(vals, np.int64)
+        if v is not None:
+            # All-null groups hold the min/max fill sentinel — not a valid code.
+            codes = np.where(v, codes, 0)
+        return Column(STRING, codes.astype(np.int32), d, v)
+    return Column(dtype, np.asarray(vals).astype(np.dtype(dtype)), None, v)
+
+
+def _empty_result(table: Table, group_keys, aggs: Sequence[AggTriple]) -> Table:
+    out = {}
+    for k in group_keys:
+        out[k] = table.column(k)
+    for out_name, fn, col_name in aggs:
+        col = table.column(col_name) if col_name is not None else None
+        dtype = result_dtype(fn, None if col is None else col.dtype)
+        out[out_name] = _out_column(fn, col, dtype, np.empty(0, np.int64), None)
+    return Table(out)
+
+
+def _global_aggregate(table: Table, aggs: Sequence[AggTriple]) -> Table:
+    """No group keys: one output row (SQL global aggregate; empty input gives
+    count=0 and NULL sum/min/max/avg)."""
+    out = {}
+    n = table.num_rows
+    for out_name, fn, col_name in aggs:
+        col = table.column(col_name) if col_name is not None else None
+        dtype = result_dtype(fn, None if col is None else col.dtype)
+        if fn == "count" and col is None:
+            out[out_name] = _out_column(fn, col, dtype, np.array([n]), None)
+            continue
+        valid = col.validity if col.validity is not None else np.ones(n, bool)
+        nv = int(valid.sum())
+        if fn == "count":
+            out[out_name] = _out_column(fn, col, dtype, np.array([nv]), None)
+            continue
+        if nv == 0:
+            out[out_name] = _out_column(
+                fn, col, dtype, np.zeros(1, np.int64), np.zeros(1, bool)
+            )
+            continue
+        data = col.data[valid]  # codes for strings: min code == min value
+        if fn == "min":
+            r = data.min()
+        elif fn == "max":
+            r = data.max()
+        else:
+            acc = data.astype(np.float64 if dtype == FLOAT64 else np.int64)
+            r = acc.sum()
+            if fn == "avg":
+                r = float(r) / nv
+        out[out_name] = _out_column(fn, col, dtype, np.array([r]), None)
+    return Table(out)
+
+
+def _segment_reduce(
+    fn: str,
+    col: Optional[Column],
+    gid: jnp.ndarray,
+    perm: jnp.ndarray,
+    n_groups: int,
+    seg_rows: jnp.ndarray,
+):
+    """One aggregate over the hash-sorted rows → (values[n_groups], validity|None)."""
+    if fn == "count" and col is None:
+        return np.asarray(seg_rows), None
+    assert col is not None
+    n = len(col.data)
+    valid = (
+        jnp.asarray(col.validity)[perm] if col.validity is not None else jnp.ones(n, bool)
+    )
+    n_valid = jax.ops.segment_sum(valid.astype(jnp.int64), gid, num_segments=n_groups)
+    if fn == "count":
+        return np.asarray(n_valid), None
+    any_valid = np.asarray(n_valid) > 0
+    x = jnp.asarray(col.data)[perm]
+    if fn in ("sum", "avg"):
+        acc = x.astype(jnp.float64 if jnp.issubdtype(x.dtype, jnp.floating) else jnp.int64)
+        s = jax.ops.segment_sum(jnp.where(valid, acc, 0), gid, num_segments=n_groups)
+        if fn == "sum":
+            return np.asarray(s), any_valid
+        c = jnp.maximum(n_valid, 1)
+        return np.asarray(s.astype(jnp.float64) / c.astype(jnp.float64)), any_valid
+    # min/max: mask nulls to the opposite extreme; all-null groups are invalid.
+    if x.dtype == jnp.bool_:
+        x = x.astype(jnp.int32)  # segment_min/iinfo don't take bools
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        fill = jnp.array(np.inf if fn == "min" else -np.inf, dtype=x.dtype)
+    else:
+        info = np.iinfo(np.dtype(x.dtype))
+        fill = jnp.array(info.max if fn == "min" else info.min, dtype=x.dtype)
+    masked = jnp.where(valid, x, fill)
+    reduce = jax.ops.segment_min if fn == "min" else jax.ops.segment_max
+    return np.asarray(reduce(masked, gid, num_segments=n_groups)), any_valid
+
+
+def _key_records(table: Table, group_keys) -> np.ndarray:
+    """Key tuples as one comparable structured array: per column (data, valid) with
+    invalid slots' data masked to the canonical fill, so null == null and
+    null != everything-else exactly."""
+    fields = []
+    for k in group_keys:
+        c = table.column(k)
+        valid = c.validity if c.validity is not None else np.ones(len(c.data), bool)
+        data = np.where(valid, c.data, np.zeros((), dtype=c.data.dtype))
+        fields.append(data)
+        fields.append(valid)
+    return np.rec.fromarrays(fields)
+
+
+def _host_aggregate(table: Table, group_keys, aggs: Sequence[AggTriple]) -> Table:
+    """Exact host groupby — the collision-repair path (and the oracle the tests
+    compare the device path against). np.unique group ids + ufunc.at reductions."""
+    recs = _key_records(table, group_keys)
+    uniq, first_idx, inverse = np.unique(recs, return_index=True, return_inverse=True)
+    n_groups = len(uniq)
+    out = {}
+    rep_rows = table.take(np.sort(first_idx))
+    # np.unique sorts groups; keep FIRST-OCCURRENCE order stable instead so the
+    # device and host paths are comparable after row sorting.
+    order = np.argsort(first_idx, kind="stable")
+    remap = np.empty(n_groups, np.int64)
+    remap[order] = np.arange(n_groups)
+    inverse = remap[inverse]
+    for k in group_keys:
+        out[k] = rep_rows.column(k)
+    for out_name, fn, col_name in aggs:
+        col = table.column(col_name) if col_name is not None else None
+        dtype = result_dtype(fn, None if col is None else col.dtype)
+        if fn == "count" and col is None:
+            vals = np.zeros(n_groups, np.int64)
+            np.add.at(vals, inverse, 1)
+            out[out_name] = _out_column(fn, col, dtype, vals, None)
+            continue
+        valid = col.validity if col.validity is not None else np.ones(len(col.data), bool)
+        nv = np.zeros(n_groups, np.int64)
+        np.add.at(nv, inverse[valid], 1)
+        if fn == "count":
+            out[out_name] = _out_column(fn, col, dtype, nv, None)
+            continue
+        any_valid = nv > 0
+        data = col.data
+        if fn in ("sum", "avg"):
+            acc = data.astype(np.float64 if dtype == FLOAT64 else np.int64)
+            s = np.zeros(n_groups, acc.dtype)
+            np.add.at(s, inverse[valid], acc[valid])
+            vals = s if fn == "sum" else s.astype(np.float64) / np.maximum(nv, 1)
+        else:
+            if data.dtype == np.bool_:
+                data = data.astype(np.int32)
+            if np.issubdtype(data.dtype, np.floating):
+                fill = np.inf if fn == "min" else -np.inf
+            else:
+                info = np.iinfo(data.dtype)
+                fill = info.max if fn == "min" else info.min
+            vals = np.full(n_groups, fill, data.dtype)
+            op = np.minimum if fn == "min" else np.maximum
+            op.at(vals, inverse[valid], data[valid])
+        out[out_name] = _out_column(fn, col, dtype, vals, any_valid)
+    return Table(out)
+
+
+def hash_aggregate(table: Table, group_keys, aggs: Sequence[AggTriple]) -> Table:
+    """GROUP BY `group_keys` computing `aggs` = [(out_name, fn, column|None)]."""
+    group_keys = list(group_keys)
+    if not group_keys:
+        return _global_aggregate(table, aggs)
+    key_cols = [table.column(k) for k in group_keys]
+    if table.num_rows == 0:
+        return _empty_result(table, group_keys, aggs)
+
+    n = table.num_rows
+    arrs = [jnp.asarray(c.data) for c in key_cols]
+    k64 = key64(key_cols, arrs)
+    perm = jnp.argsort(k64, stable=True)
+
+    # Group boundaries from ADJACENT ACTUAL VALUES (+ validity), never the hash.
+    eq = jnp.ones(n - 1, bool) if n > 1 else jnp.zeros(0, bool)
+    for c, a in zip(key_cols, arrs):
+        sa = a[perm]
+        col_eq = sa[1:] == sa[:-1]
+        if c.validity is not None:
+            sv = jnp.asarray(c.validity)[perm]
+            both_null = (~sv[1:]) & (~sv[:-1])
+            col_eq = (col_eq & (sv[1:] == sv[:-1])) | both_null
+        eq = eq & col_eq
+    boundary = jnp.concatenate([jnp.ones(1, bool), ~eq])
+    gid = jnp.cumsum(boundary.astype(jnp.int64)) - 1
+    n_groups = int(gid[-1]) + 1
+
+    seg_rows = jax.ops.segment_sum(jnp.ones(n, jnp.int64), gid, num_segments=n_groups)
+    reduced = []
+    for out_name, fn, col_name in aggs:
+        col = table.column(col_name) if col_name is not None else None
+        dtype = result_dtype(fn, None if col is None else col.dtype)
+        vals, validity = _segment_reduce(fn, col, gid, perm, n_groups, seg_rows)
+        reduced.append((out_name, fn, col, dtype, vals, validity))
+
+    # Representative row of each group → materialize the key columns on host.
+    reps = np.asarray(perm)[np.nonzero(np.asarray(boundary))[0]]
+    rep_rows = table.take(reps).select(group_keys)
+    if len(np.unique(_key_records(rep_rows, group_keys))) != n_groups:
+        # 64-bit collision interleaved two tuples in one sorted run: recompute
+        # exactly on host (rarity ~2^-64; correctness over speed).
+        return _host_aggregate(table, group_keys, aggs)
+
+    out = {}
+    for k in group_keys:
+        out[k] = rep_rows.column(k)
+    for out_name, fn, col, dtype, vals, validity in reduced:
+        out[out_name] = _out_column(fn, col, dtype, vals, validity)
+    return Table(out)

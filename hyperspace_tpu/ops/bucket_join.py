@@ -141,6 +141,15 @@ class PaddedBuckets:
         self.starts = starts
         self.mode = mode  # "value" | "hash"
 
+    @property
+    def nbytes(self) -> int:
+        """Bytes pinned by this rep (device matrices + host maps) — what the
+        engine's device-cache byte budget accounts."""
+        total = 0
+        for a in (self.keys, self.lengths, self.order, self.starts):
+            total += int(getattr(a, "nbytes", 0) or 0)
+        return total
+
 
 def pad_buckets_by_value(vals, starts_np: np.ndarray) -> Optional[PaddedBuckets]:
     """Value-direct padded matrices for a side whose buckets are ALREADY sorted by

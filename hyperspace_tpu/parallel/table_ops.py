@@ -233,12 +233,24 @@ def distributed_bucketed_join_pairs(
 ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
     """Mesh-sharded equivalent of `bucketed_merge_join_pairs`: all bucket pairs
     probed concurrently, each on the device owning that bucket range, with no data
-    exchange. Returns None when the bucket count doesn't divide over the mesh
-    (caller falls back to the single-device kernel)."""
+    exchange. Bucket counts that don't divide the mesh are padded with virtual
+    EMPTY buckets (zero length → zero probe work), so the default 200-bucket index
+    still takes this path on any mesh size (200 % 16 != 0 included). Returns None
+    only when the two sides' bucket counts disagree (caller falls back to the
+    single-device kernel)."""
     n_dev = mesh.devices.size
     B = len(l_starts_np) - 1
-    if B % n_dev != 0 or len(r_starts_np) - 1 != B:
+    if len(r_starts_np) - 1 != B:
         return None
+    pad_b = (-B) % n_dev
+    if pad_b:
+        l_starts_np = np.concatenate(
+            [l_starts_np, np.full(pad_b, l_starts_np[-1], dtype=l_starts_np.dtype)]
+        )
+        r_starts_np = np.concatenate(
+            [r_starts_np, np.full(pad_b, r_starts_np[-1], dtype=r_starts_np.dtype)]
+        )
+        B += pad_b
     buckets_local = B // n_dev
 
     l_lens = np.diff(l_starts_np)

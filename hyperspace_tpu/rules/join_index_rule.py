@@ -34,7 +34,7 @@ def _lower(names) -> List[str]:
 
 def _collect_expr_refs(plan: LogicalPlan) -> List[str]:
     refs: List[str] = []
-    from ..engine.logical import FilterNode, ProjectNode
+    from ..engine.logical import AggregateNode, FilterNode, OrderByNode, ProjectNode
 
     for node in plan.collect_nodes():
         if isinstance(node, FilterNode):
@@ -43,6 +43,8 @@ def _collect_expr_refs(plan: LogicalPlan) -> List[str]:
             refs.extend(node.column_names)
         elif isinstance(node, JoinNode):
             refs.extend(node.condition.references())
+        elif isinstance(node, (AggregateNode, OrderByNode)):
+            refs.extend(node.references())
     return refs
 
 

@@ -13,12 +13,15 @@ import base64
 import json
 from typing import Any, Dict
 
-from ..engine.expr import BinaryOp, Col, Expr, IsIn, Lit, Not
+from ..engine.expr import BinaryOp, Col, Expr, IsIn, IsNull, Lit, Not
 from ..engine.logical import (
+    AggregateNode,
     BucketSpec,
     FilterNode,
     JoinNode,
+    LimitNode,
     LogicalPlan,
+    OrderByNode,
     ProjectNode,
     ScanNode,
     SourceRelation,
@@ -142,6 +145,21 @@ def plan_to_dict(plan: LogicalPlan) -> Dict[str, Any]:
             "left": plan_to_dict(plan.left),
             "right": plan_to_dict(plan.right),
         }
+    if isinstance(plan, AggregateNode):
+        return {
+            "t": "aggregate",
+            "groupKeys": list(plan.group_keys),
+            "aggs": [[o, fn, c] for o, fn, c in plan.aggs],
+            "child": plan_to_dict(plan.child),
+        }
+    if isinstance(plan, OrderByNode):
+        return {
+            "t": "orderby",
+            "keys": [[k, asc] for k, asc in plan.keys],
+            "child": plan_to_dict(plan.child),
+        }
+    if isinstance(plan, LimitNode):
+        return {"t": "limit", "n": plan.n, "child": plan_to_dict(plan.child)}
     raise HyperspaceException(f"Cannot serialize plan node: {plan.simple_string()}")
 
 
@@ -160,6 +178,16 @@ def plan_from_dict(d: Dict[str, Any]) -> LogicalPlan:
             expr_from_dict(d["condition"]),
             d["how"],
         )
+    if t == "aggregate":
+        return AggregateNode(
+            d["groupKeys"],
+            [(o, fn, c) for o, fn, c in d["aggs"]],
+            plan_from_dict(d["child"]),
+        )
+    if t == "orderby":
+        return OrderByNode([(k, asc) for k, asc in d["keys"]], plan_from_dict(d["child"]))
+    if t == "limit":
+        return LimitNode(d["n"], plan_from_dict(d["child"]))
     raise HyperspaceException(f"Cannot deserialize plan tag: {t}")
 
 

@@ -1,0 +1,344 @@
+"""Aggregation, ORDER BY, LIMIT: the query shapes real index workloads take
+(BASELINE config-2 is a grouped aggregation over the indexed join — TPC-H Q3-like).
+The reference gets these operators from Spark SQL; the tests below hold the engine
+to SQL semantics (null grouping, null-ignoring aggregates, Spark null ordering) and
+to the reference's own E2E oracle: identical results with indexing on vs off
+(`E2EHyperspaceRulesTests.scala:454-470`).
+"""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import IndexConfig, IndexConstants
+from hyperspace_tpu.engine import HyperspaceSession, col
+from hyperspace_tpu.hyperspace import Hyperspace, disable_hyperspace, enable_hyperspace
+
+
+@pytest.fixture()
+def agg_session(tmp_path):
+    s = HyperspaceSession(warehouse=str(tmp_path))
+    s.conf.set(IndexConstants.INDEX_SYSTEM_PATH, str(tmp_path / "indexes"))
+    s.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 8)
+    os.makedirs(tmp_path / "sales")
+    pq.write_table(
+        pa.table(
+            {
+                "region": pa.array(["east", "west", "east", None, "west", "east", None]),
+                "item": pa.array([1, 2, 1, 3, 2, 2, 3], type=pa.int64()),
+                "amount": pa.array([10, 20, None, 40, 50, 60, None], type=pa.int64()),
+                "price": pa.array([1.5, 2.0, 2.5, None, 4.0, 5.5, 6.0]),
+            }
+        ),
+        str(tmp_path / "sales" / "part-00000.parquet"),
+    )
+    return s, str(tmp_path)
+
+
+def _sales(s, base):
+    return s.read.parquet(os.path.join(base, "sales"))
+
+
+class TestGroupBy:
+    def test_groupby_sum_count(self, agg_session):
+        s, base = agg_session
+        rows = (
+            _sales(s, base)
+            .group_by("region")
+            .agg(total=("amount", "sum"), n=("amount", "count"), rows=("*", "count"))
+            .sorted_rows()
+        )
+        # region null group: amounts 40, None -> sum 40, count 1, rows 2
+        assert sorted(rows, key=lambda r: (r[0] is None, r)) == [
+            ("east", 70, 2, 3),
+            ("west", 70, 2, 2),
+            (None, 40, 1, 2),
+        ]
+
+    def test_groupby_min_max_avg(self, agg_session):
+        s, base = agg_session
+        got = {
+            r[0]: r[1:]
+            for r in _sales(s, base)
+            .group_by("region")
+            .agg(lo=("amount", "min"), hi=("amount", "max"), mean=("price", "avg"))
+            .sorted_rows()
+        }
+        assert got["east"] == (10, 60, (1.5 + 2.5 + 5.5) / 3)
+        assert got["west"] == (20, 50, 3.0)
+        assert got[None] == (40, 40, 6.0)
+
+    def test_groupby_multiple_keys(self, agg_session):
+        s, base = agg_session
+        rows = (
+            _sales(s, base).group_by("region", "item").agg(n=("*", "count")).sorted_rows()
+        )
+        assert len(rows) == 4  # distinct keys: (east,1) (east,2) (west,2) (None,3)
+        counts = {(r[0], r[1]): r[2] for r in rows}
+        assert counts[("east", 1)] == 2
+        assert counts[("west", 2)] == 2
+        assert counts[(None, 3)] == 2
+
+    def test_all_null_group_aggregate_is_null(self, agg_session):
+        s, base = agg_session
+        got = {
+            r[0]: r[1]
+            for r in _sales(s, base)
+            .group_by("region")
+            .agg(total=("amount", "sum"))
+            .sorted_rows()
+        }
+        # No all-null group for amount here; filter to item=3 (amounts 40, None, None)
+        rows = (
+            _sales(s, base)
+            .filter(col("item") == 3)
+            .group_by("item")
+            .agg(s=("amount", "sum"), n=("amount", "count"))
+            .sorted_rows()
+        )
+        assert rows == [(3, 40, 1)]
+
+    def test_string_min_max(self, agg_session):
+        s, base = agg_session
+        rows = (
+            _sales(s, base)
+            .group_by("item")
+            .agg(first=("region", "min"), last=("region", "max"))
+            .sorted_rows()
+        )
+        got = {r[0]: r[1:] for r in rows}
+        assert got[1] == ("east", "east")
+        assert got[2] == ("east", "west")
+        # item 3: regions are [None, None] -> all-null group -> NULL min/max
+        assert got[3] == (None, None)
+
+    def test_bool_min_max_grouped(self, agg_session):
+        from hyperspace_tpu.engine.table import Table
+        from hyperspace_tpu.ops.aggregate import _host_aggregate, hash_aggregate
+
+        t = Table.from_pydict(
+            {"k": np.array([1, 1, 2, 2], np.int64), "b": np.array([True, False, True, True])}
+        )
+        aggs = [("lo", "min", "b"), ("hi", "max", "b")]
+        expected = [(1, False, True), (2, True, True)]
+        assert hash_aggregate(t, ["k"], aggs).sorted_rows() == expected
+        assert _host_aggregate(t, ["k"], aggs).sorted_rows() == expected
+
+    def test_global_agg(self, agg_session):
+        s, base = agg_session
+        rows = (
+            _sales(s, base)
+            .agg(total=("amount", "sum"), rows=("*", "count"), navg=("price", "avg"))
+            .sorted_rows()
+        )
+        assert rows == [(180, 7, pytest.approx((1.5 + 2 + 2.5 + 4 + 5.5 + 6) / 6))]
+
+    def test_global_agg_empty_input(self, agg_session):
+        s, base = agg_session
+        rows = (
+            _sales(s, base)
+            .filter(col("item") == 99)
+            .agg(total=("amount", "sum"), n=("*", "count"))
+            .sorted_rows()
+        )
+        assert rows == [(None, 0)]
+
+    def test_groupby_empty_input(self, agg_session):
+        s, base = agg_session
+        rows = (
+            _sales(s, base)
+            .filter(col("item") == 99)
+            .group_by("region")
+            .agg(n=("*", "count"))
+            .sorted_rows()
+        )
+        assert rows == []
+
+    def test_sum_on_string_raises(self, agg_session):
+        s, base = agg_session
+        from hyperspace_tpu import HyperspaceException
+
+        with pytest.raises(HyperspaceException, match="sum"):
+            _sales(s, base).group_by("item").agg(x=("region", "sum"))
+
+    def test_device_matches_host_oracle(self, agg_session):
+        """The device hash-sort/segment path against the exact host groupby."""
+        s, base = agg_session
+        from hyperspace_tpu.ops.aggregate import _host_aggregate, hash_aggregate
+
+        t = _sales(s, base).collect()
+        aggs = [
+            ("s", "sum", "amount"),
+            ("n", "count", "amount"),
+            ("lo", "min", "price"),
+            ("hi", "max", "price"),
+            ("m", "avg", "amount"),
+        ]
+        dev = hash_aggregate(t, ["region", "item"], aggs).sorted_rows()
+        host = _host_aggregate(t, ["region", "item"], aggs).sorted_rows()
+        assert dev == host
+
+    def test_device_matches_host_oracle_large_random(self, agg_session):
+        s, base = agg_session
+        from hyperspace_tpu.engine.table import Table
+        from hyperspace_tpu.ops.aggregate import _host_aggregate, hash_aggregate
+
+        rng = np.random.RandomState(7)
+        n = 20_000
+        t = Table.from_pydict(
+            {
+                "k": rng.randint(0, 500, n).astype(np.int64),
+                "v": rng.randint(-100, 100, n).astype(np.int64),
+                "f": rng.rand(n),
+            }
+        )
+        aggs = [
+            ("s", "sum", "v"),
+            ("n", "count", "*".replace("*", "v")),
+            ("lo", "min", "f"),
+            ("hi", "max", "f"),
+        ]
+        aggs = [("s", "sum", "v"), ("n", "count", "v"), ("lo", "min", "f"), ("hi", "max", "f")]
+        assert (
+            hash_aggregate(t, ["k"], aggs).sorted_rows()
+            == _host_aggregate(t, ["k"], aggs).sorted_rows()
+        )
+
+
+class TestOrderByLimit:
+    def test_order_by_asc_desc(self, agg_session):
+        s, base = agg_session
+        rows = _sales(s, base).order_by("item", ("amount", False)).select("item", "amount").collect().rows()
+        # item asc; within item, amount desc with nulls last
+        assert rows == [
+            (1, 10), (1, None), (2, 60), (2, 50), (2, 20), (3, 40), (3, None),
+        ]
+
+    def test_order_by_nulls_first_asc(self, agg_session):
+        s, base = agg_session
+        rows = _sales(s, base).order_by("amount").select("amount").collect().rows()
+        assert rows[:2] == [(None,), (None,)]
+        assert rows[2:] == [(10,), (20,), (40,), (50,), (60,)]
+
+    def test_order_by_string(self, agg_session):
+        s, base = agg_session
+        rows = _sales(s, base).order_by("region").select("region").collect().rows()
+        assert rows[:2] == [(None,), (None,)]
+        assert [r[0] for r in rows[2:]] == ["east", "east", "east", "west", "west"]
+
+    def test_limit(self, agg_session):
+        s, base = agg_session
+        assert _sales(s, base).limit(3).count() == 3
+        assert _sales(s, base).limit(0).count() == 0
+        assert _sales(s, base).limit(100).count() == 7
+        rows = _sales(s, base).order_by(("amount", False)).limit(2).select("amount").collect().rows()
+        assert rows == [(60,), (50,)]
+
+
+class TestIndexedAggregation:
+    """The point of the exercise: index rewrites accelerate aggregation-bearing
+    queries, and results match the non-indexed oracle."""
+
+    def test_groupby_over_indexed_join(self, agg_session, tmp_path):
+        s, base = agg_session
+        rng = np.random.RandomState(1)
+        s.write_parquet(
+            {
+                "itemId": np.arange(1, 5, dtype=np.int64),
+                "weight": rng.randint(1, 10, 4).astype(np.int64),
+            },
+            str(tmp_path / "items"),
+        )
+        hs = Hyperspace(s)
+        hs.create_index(
+            _sales(s, base), IndexConfig("salesIdx", ["item"], ["region", "amount"])
+        )
+        hs.create_index(
+            s.read.parquet(str(tmp_path / "items")),
+            IndexConfig("itemsIdx", ["itemId"], ["weight"]),
+        )
+
+        def q():
+            sales = _sales(s, base)
+            items = s.read.parquet(str(tmp_path / "items"))
+            return (
+                sales.join(items, col("item") == col("itemId"))
+                .group_by("region")
+                .agg(total=("amount", "sum"), w=("weight", "max"), n=("*", "count"))
+            )
+
+        disable_hyperspace(s)
+        expected = q().sorted_rows()
+        enable_hyperspace(s)
+        plan = q().explain_string()
+        assert "bucketed, no exchange" in plan
+        assert "HashAggregate" in plan
+        got = q().sorted_rows()
+        assert got == expected and len(got) > 0
+
+    def test_filter_index_under_aggregate(self, agg_session):
+        s, base = agg_session
+        hs = Hyperspace(s)
+        hs.create_index(
+            _sales(s, base),
+            IndexConfig("fIdx", ["region"], ["item", "amount", "price"]),
+        )
+
+        def q():
+            return (
+                _sales(s, base)
+                .filter(col("region") == "east")
+                .group_by("item")
+                .agg(total=("amount", "sum"))
+            )
+
+        disable_hyperspace(s)
+        expected = q().sorted_rows()
+        enable_hyperspace(s)
+        plan = q().explain_string()
+        assert "index=fIdx" in plan
+        got = q().sorted_rows()
+        assert got == expected and len(got) > 0
+
+    def test_orderby_limit_over_indexed_join(self, agg_session, tmp_path):
+        s, base = agg_session
+        hs = Hyperspace(s)
+        hs.create_index(
+            _sales(s, base), IndexConfig("sIdx2", ["item"], ["amount"])
+        )
+        s.write_parquet(
+            {"iid": np.arange(1, 4, dtype=np.int64), "tag": np.array(["a", "b", "c"])},
+            str(tmp_path / "tags"),
+        )
+        hs.create_index(
+            s.read.parquet(str(tmp_path / "tags")), IndexConfig("tIdx", ["iid"], ["tag"])
+        )
+
+        def q():
+            sales = _sales(s, base)
+            tags = s.read.parquet(str(tmp_path / "tags"))
+            return (
+                sales.join(tags, col("item") == col("iid"))
+                .order_by(("amount", False), "tag")
+                .limit(3)
+                .select("amount", "tag")
+            )
+
+        disable_hyperspace(s)
+        expected = q().collect().rows()
+        enable_hyperspace(s)
+        got = q().collect().rows()
+        assert got == expected and len(got) == 3
+
+
+def test_duplicate_agg_output_name_rejected(agg_session):
+    s, base = agg_session
+    from hyperspace_tpu import HyperspaceException
+
+    with pytest.raises(HyperspaceException, match="Duplicate"):
+        _sales(s, base).group_by("item").agg(item=("amount", "sum"))
+    with pytest.raises(HyperspaceException, match="Duplicate"):
+        _sales(s, base).group_by("item").agg(x=("amount", "sum"), X=("amount", "min"))

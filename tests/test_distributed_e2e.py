@@ -207,3 +207,40 @@ def test_string_key_distributed_join(dist_session, tmp_path):
     expected = q.sorted_rows()
     assert len(got) > 0
     assert got == expected
+
+
+def test_nondivisible_bucket_count_takes_distributed_probe(dist_session, monkeypatch):
+    """A bucket count that does NOT divide the mesh (20 % 8 != 0 — the default 200
+    on a v5e-16 has the same shape) must still take the sharded probe, via virtual
+    empty-bucket padding, and match the oracle."""
+    s, base = dist_session
+    s.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 20)
+    hs = Hyperspace(s)
+    hs.create_index(
+        s.read.parquet(os.path.join(base, "dept")),
+        IndexConfig("deptIdx20", ["deptId"], ["deptName"]),
+    )
+    hs.create_index(
+        s.read.parquet(os.path.join(base, "emp")),
+        IndexConfig("empIdx20", ["empDept"], ["empId"]),
+    )
+
+    from hyperspace_tpu.parallel import table_ops
+
+    calls = {"n": 0, "none": 0}
+    real = table_ops.distributed_bucketed_join_pairs
+
+    def spy(*a, **k):
+        out = real(*a, **k)
+        calls["n"] += 1
+        calls["none"] += out is None
+        return out
+
+    monkeypatch.setattr(table_ops, "distributed_bucketed_join_pairs", spy)
+
+    disable_hyperspace(s)
+    expected = _join_query(s, base).sorted_rows()
+    enable_hyperspace(s)
+    got = _join_query(s, base).sorted_rows()
+    assert got == expected and len(got) > 0
+    assert calls["n"] > 0 and calls["none"] == 0

@@ -146,6 +146,16 @@ class TestPlanSerde:
         restored = deserialize_plan(serialize_plan(j.plan))
         assert restored.tree_string() == j.plan.tree_string()
 
+    def test_roundtrip_isnull_isin(self, session, tmp_path):
+        session.write_parquet(SAMPLE, str(tmp_path / "t"))
+        df = (
+            session.read.parquet(str(tmp_path / "t"))
+            .filter(col("c1").is_null() | ~col("c2").is_null() | col("c2").isin(1, 2))
+            .select("c1")
+        )
+        restored = deserialize_plan(serialize_plan(df.plan))
+        assert restored.tree_string() == df.plan.tree_string()
+
     def test_version_check(self):
         import base64
         import json

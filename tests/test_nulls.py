@@ -192,3 +192,19 @@ def test_distributed_build_nullable(nullable_session):
     disable_hyperspace(s)
     off = q().sorted_rows()
     assert on == off and len(on) == 4
+
+
+def test_isin_with_null_in_list_kleene(nullable_session):
+    """`x IN (v, NULL)` is TRUE on match else UNKNOWN, so NOT(...) drops
+    non-matching rows too (SQL/Spark three-valued logic)."""
+    s, base = nullable_session
+    users = s.read.parquet(os.path.join(base, "users"))
+    # uid IN (1, NULL): only uid==1 is TRUE; everything else UNKNOWN -> dropped.
+    rows = users.filter(col("uid").isin([1, None])).select("uid").sorted_rows()
+    assert rows == [(1,)]
+    # NOT (uid IN (1, NULL)): never TRUE for any row -> empty.
+    rows = users.filter(~col("uid").isin([1, None])).select("uid").sorted_rows()
+    assert rows == []
+    # Without the null the complement keeps the known non-matches.
+    rows = users.filter(~col("uid").isin([1])).select("uid").sorted_rows()
+    assert rows == [(2,), (4,), (5,), (7,), (8,)]
