@@ -24,6 +24,11 @@ class IndexConstants:
     INDEX_CACHE_EXPIRY_DURATION_SECONDS = "hyperspace.index.cache.expiryDurationInSeconds"
     INDEX_CACHE_EXPIRY_DURATION_SECONDS_DEFAULT = 300
 
+    # Metadata-cache policy (reference `IndexCacheFactory.scala:23-38` keys the
+    # cache impl by type name; CREATION_TIME_BASED is the only built-in).
+    INDEX_CACHE_TYPE = "hyperspace.index.cache.type"
+    INDEX_CACHE_TYPE_DEFAULT = "CREATION_TIME_BASED"
+
     INDEX_HYBRID_SCAN_ENABLED = "hyperspace.index.hybridscan.enabled"
     INDEX_HYBRID_SCAN_ENABLED_DEFAULT = False
 
@@ -120,6 +125,12 @@ class HyperspaceConf:
         return self._c.get_int(
             IndexConstants.INDEX_CACHE_EXPIRY_DURATION_SECONDS,
             IndexConstants.INDEX_CACHE_EXPIRY_DURATION_SECONDS_DEFAULT,
+        )
+
+    @property
+    def cache_type(self) -> str:
+        return self._c.get(
+            IndexConstants.INDEX_CACHE_TYPE, IndexConstants.INDEX_CACHE_TYPE_DEFAULT
         )
 
     @property

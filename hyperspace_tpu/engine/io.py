@@ -22,7 +22,12 @@ from ..util.path_utils import is_data_path
 from .schema import BOOL, FLOAT32, FLOAT64, INT32, INT64, STRING, Field, Schema
 from .table import Column, Table
 
-_FORMAT_EXTENSIONS = {"parquet": (".parquet",), "csv": (".csv",), "json": (".json",)}
+_FORMAT_EXTENSIONS = {
+    "parquet": (".parquet",),
+    "csv": (".csv",),
+    "json": (".json",),
+    "orc": (".orc",),
+}
 
 
 def list_data_files(path: str, file_format: str, fs: Optional[FileSystem] = None) -> List[FileStatus]:
@@ -87,6 +92,11 @@ def _read_one(path: str, file_format: str, columns: Optional[List[str]] = None) 
         file_format = "parquet"  # delta data files are parquet
     if file_format == "parquet":
         return _arrow_to_table(pq.read_table(path, columns=columns))
+    if file_format == "orc":
+        # Reference format whitelist includes ORC (LogicalPlanSerDeUtils.scala:223-243).
+        from pyarrow import orc as pa_orc
+
+        return _arrow_to_table(pa_orc.ORCFile(path).read(columns=columns))
     if file_format == "csv":
         # Keep date-like strings as strings (no timestamp inference) — the engine's
         # type system treats temporal values as lexicographically ordered strings.
@@ -124,15 +134,33 @@ def _read_json_lines(path: str) -> pa.Table:
 
 
 def read_files(
-    files: List[str], file_format: str, columns: Optional[List[str]] = None
+    files: List[str],
+    file_format: str,
+    columns: Optional[List[str]] = None,
+    partitions=None,
 ) -> Table:
+    """Read + concat data files. `partitions` = (PartitionSpec, root_paths) for
+    hive-partitioned sources: the per-file cache holds the RAW file content (the
+    partition values are path facts, not file content) and the constant partition
+    columns are appended per file before the concat."""
     if not files:
         raise HyperspaceException("No data files to read.")
     from .scan_cache import global_scan_cache
 
+    file_columns = columns
+    if partitions is not None:
+        spec, roots = partitions
+        pset = {c.lower() for c in spec.columns}
+        if columns is not None:
+            file_columns = [c for c in columns if c.lower() not in pset]
+            if not file_columns:
+                # Only partition columns requested: still need row counts, so
+                # read the file's own columns and drop them in the select below.
+                file_columns = None
+
     cache = global_scan_cache()
     ordered = sorted(files)
-    tables: List[Optional[Table]] = [cache.get(f, columns) for f in ordered]
+    tables: List[Optional[Table]] = [cache.get(f, file_columns) for f in ordered]
     missing = [i for i, t in enumerate(tables) if t is None]
     if len(missing) > 1:
         # Decode cache misses concurrently: parquet/csv decode is pyarrow C++ work
@@ -142,16 +170,28 @@ def read_files(
 
         with ThreadPoolExecutor(max_workers=min(16, len(missing))) as pool:
             decoded = list(
-                pool.map(lambda i: _read_one(ordered[i], file_format, columns), missing)
+                pool.map(
+                    lambda i: _read_one(ordered[i], file_format, file_columns), missing
+                )
             )
         for i, t in zip(missing, decoded):
-            cache.put(ordered[i], columns, t)
+            cache.put(ordered[i], file_columns, t)
             tables[i] = t
     elif missing:
         i = missing[0]
-        t = _read_one(ordered[i], file_format, columns)
-        cache.put(ordered[i], columns, t)
+        t = _read_one(ordered[i], file_format, file_columns)
+        cache.put(ordered[i], file_columns, t)
         tables[i] = t
+
+    if partitions is not None:
+        from .partitioning import append_partition_columns
+
+        tables = [
+            append_partition_columns(t, spec, roots, f, wanted=columns)
+            for f, t in zip(ordered, tables)
+        ]
+        if columns is not None:
+            tables = [t.select(columns) for t in tables]
     return tables[0] if len(tables) == 1 else Table.concat(tables)
 
 
@@ -162,6 +202,10 @@ def infer_schema(files: List[str], file_format: str) -> Schema:
     f = sorted(files)[0]
     if file_format in ("parquet", "delta"):
         return arrow_schema_to_schema(pq.read_schema(f))
+    if file_format == "orc":
+        from pyarrow import orc as pa_orc
+
+        return arrow_schema_to_schema(pa_orc.ORCFile(f).schema)
     return _read_one(f, file_format).schema
 
 
@@ -205,6 +249,13 @@ def table_to_arrow(table: Table) -> pa.Table:
 def write_parquet(table: Table, path: str) -> None:
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     pq.write_table(table_to_arrow(table), path)
+
+
+def write_orc(table: Table, path: str) -> None:
+    from pyarrow import orc as pa_orc
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    pa_orc.write_table(table_to_arrow(table), path)
 
 
 def write_csv(table: Table, path: str) -> None:

@@ -38,6 +38,10 @@ class SourceRelation:
     hybrid_append: Optional["HybridAppend"] = None
     # Data-skipping: names of indexes whose sketches pruned this scan's file list:
     pruned_by: List[str] = field(default_factory=list)
+    # Hive-partitioned source: layout of `key=value` path segments whose values
+    # materialize as columns at read time (`engine.partitioning`); the partition
+    # fields are appended to `schema`.
+    partition_spec: Optional[object] = None
 
     def __repr__(self):
         tag = f" index={self.index_name}" if self.index_name else ""
@@ -50,12 +54,14 @@ class SourceRelation:
 
 @dataclass
 class HybridAppend:
-    """Appended source files + how to read them (their format/schema are the
-    SOURCE's, not the index's)."""
+    """Appended source files + how to read them (their format/schema/partition
+    layout are the SOURCE's, not the index's)."""
 
     files: List[FileStatus]
     file_format: str
     schema: Schema
+    root_paths: List[str] = field(default_factory=list)
+    partition_spec: Optional[object] = None
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,165 @@
+"""Hive-style partitioned sources: `root/key=value/.../file.parquet`.
+
+The reference indexes partitioned datasets — Spark's PartitioningAwareFileIndex
+turns `key=value` path segments into columns, and index creation pulls missing
+partition columns into the index when lineage is on
+(`CreateActionBase.scala:176-188`; partitioned cases throughout
+`E2EHyperspaceRulesTests.scala`). The engine analogue: discover the partition
+layout once at scan resolution, append the (per-file constant) partition columns
+at read time, and let everything downstream — signatures, rules, the index build —
+see them as ordinary columns.
+
+Values are URL-decoded; `__HIVE_DEFAULT_PARTITION__` is NULL (Spark's spelling of
+a null partition value). Column types: int64 when every non-null value parses as
+an integer, else string (Spark's inference, minus the fractional/date cases the
+engine's type system folds into strings anyway).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+from urllib.parse import unquote
+
+import numpy as np
+
+from ..exceptions import HyperspaceException
+from .schema import INT64, STRING, Field
+from .table import Column, Table
+
+HIVE_NULL = "__HIVE_DEFAULT_PARTITION__"
+
+
+@dataclass(frozen=True)
+class PartitionSpec:
+    """Ordered partition columns + inferred dtypes (int64 | string)."""
+
+    columns: Tuple[str, ...]
+    dtypes: Tuple[str, ...]
+
+    def to_json(self) -> dict:
+        return {"columns": list(self.columns), "dtypes": list(self.dtypes)}
+
+    @staticmethod
+    def from_json(d: Optional[dict]) -> Optional["PartitionSpec"]:
+        if d is None:
+            return None
+        return PartitionSpec(tuple(d["columns"]), tuple(d["dtypes"]))
+
+    @property
+    def fields(self) -> List[Field]:
+        return [Field(n, t) for n, t in zip(self.columns, self.dtypes)]
+
+
+def _segments(root_paths: Sequence[str], path: str) -> Optional[List[Tuple[str, str]]]:
+    """`key=value` components between the (best-matching) root and the file."""
+    norm = os.path.normpath(path)
+    best = None
+    for r in root_paths:
+        rn = os.path.normpath(r)
+        if norm == rn or norm.startswith(rn + os.sep):
+            if best is None or len(rn) > len(best):
+                best = rn
+    if best is None or norm == best:
+        return None
+    out = []
+    for comp in os.path.relpath(os.path.dirname(norm), best).split(os.sep):
+        if comp in (".", ""):
+            continue
+        if "=" not in comp:
+            return None  # mixed layout: a non-partition dir level → not partitioned
+        k, v = comp.split("=", 1)
+        if not k:
+            return None
+        out.append((k, unquote(v)))
+    return out if out else None
+
+
+def discover(root_paths: Sequence[str], file_paths: Sequence[str]) -> Optional[PartitionSpec]:
+    """Partition layout of a file inventory; None when the source is unpartitioned.
+    Every file must agree on the column sequence (Spark rejects mixed layouts)."""
+    per_file = []
+    for p in file_paths:
+        segs = _segments(root_paths, p)
+        if segs is None:
+            return None
+        per_file.append(segs)
+    names = tuple(k for k, _ in per_file[0])
+    for segs in per_file[1:]:
+        if tuple(k for k, _ in segs) != names:
+            raise HyperspaceException(
+                f"Inconsistent partition layout: {names} vs {tuple(k for k, _ in segs)}"
+            )
+    dtypes = []
+    for i in range(len(names)):
+        vals = [segs[i][1] for segs in per_file if segs[i][1] != HIVE_NULL]
+        dtypes.append(INT64 if vals and all(_is_int(v) for v in vals) else STRING)
+    return PartitionSpec(names, tuple(dtypes))
+
+
+def _is_int(s: str) -> bool:
+    try:
+        int(s)
+        return True
+    except ValueError:
+        return False
+
+
+def values_for(
+    spec: PartitionSpec, root_paths: Sequence[str], path: str
+) -> Tuple[Optional[object], ...]:
+    """This file's partition value per spec column (None = hive null)."""
+    segs = _segments(root_paths, path)
+    if segs is None or tuple(k for k, _ in segs) != spec.columns:
+        raise HyperspaceException(f"File does not match partition layout: {path}")
+    out = []
+    for (_, v), dt in zip(segs, spec.dtypes):
+        if v == HIVE_NULL:
+            out.append(None)
+        else:
+            out.append(int(v) if dt == INT64 else v)
+    return tuple(out)
+
+
+def constant_columns(
+    spec: PartitionSpec,
+    values: Tuple[Optional[object], ...],
+    n: int,
+    wanted: Optional[Sequence[str]] = None,
+) -> List[Tuple[str, Column]]:
+    """The partition columns as n-row constants (only those in `wanted`)."""
+    wanted_l = None if wanted is None else {w.lower() for w in wanted}
+    out = []
+    for name, dt, v in zip(spec.columns, spec.dtypes, values):
+        if wanted_l is not None and name.lower() not in wanted_l:
+            continue
+        if v is None:
+            validity = np.zeros(n, bool)
+            if dt == STRING:
+                col = Column(STRING, np.zeros(n, np.int32), np.array([""], "<U1"), validity)
+            else:
+                col = Column(dt, np.zeros(n, np.dtype(dt)), None, validity)
+        elif dt == STRING:
+            col = Column(STRING, np.zeros(n, np.int32), np.array([str(v)]), None)
+        else:
+            col = Column(dt, np.full(n, v, np.dtype(dt)), None, None)
+        out.append((name, col))
+    return out
+
+
+def append_partition_columns(
+    table: Table,
+    spec: PartitionSpec,
+    root_paths: Sequence[str],
+    path: str,
+    wanted: Optional[Sequence[str]] = None,
+) -> Table:
+    vals = values_for(spec, root_paths, path)
+    consts = constant_columns(spec, vals, table.num_rows, wanted)
+    if not consts:
+        return table
+    cols = dict(table.columns)
+    for name, col in consts:
+        cols[name] = col
+    return Table(cols)

@@ -104,7 +104,12 @@ class ScanExec(PhysicalNode):
             return Table(
                 {n: _empty_column(self.relation.schema.field(n).dtype) for n in names}
             )
-        return engine_io.read_files(files, self.relation.file_format, self.columns)
+        partitions = None
+        if self.relation.partition_spec is not None:
+            partitions = (self.relation.partition_spec, self.relation.root_paths)
+        return engine_io.read_files(
+            files, self.relation.file_format, self.columns, partitions=partitions
+        )
 
     def simple_string(self):
         cols = f" [{', '.join(self.columns)}]" if self.columns else ""
@@ -154,9 +159,14 @@ class BucketedIndexScanExec(PhysicalNode):
         wanted = self.columns or self.relation.schema.names
         lineage_col = IndexConstants.DATA_FILE_NAME_COLUMN
         source_cols = [c for c in wanted if c.lower() != lineage_col]
+        partitions = None
+        if ha.partition_spec is not None:
+            partitions = (ha.partition_spec, ha.root_paths)
         parts = []
         for f in ha.files:
-            t = engine_io.read_files([f.path], ha.file_format, source_cols)
+            t = engine_io.read_files(
+                [f.path], ha.file_format, source_cols, partitions=partitions
+            )
             if any(c.lower() == lineage_col for c in wanted):
                 cols = dict(t.columns)
                 cols[lineage_col] = Table.from_pydict(
@@ -606,7 +616,8 @@ def _cached_by_table(cache: Dict[int, tuple], table: Table, subkey, compute):
     else:
         ent[1][subkey] = val
     _device_cache_bytes += nbytes
-    # Evict least-recently-inserted OTHER tables while over budget.
+    # Evict least-recently-used OTHER entries while over budget (the verify
+    # cache shares the budget, so it is in the victim pool too).
     while _device_cache_bytes > _DEVICE_CACHE_BUDGET_BYTES:
         victim = None
         for c in (_key64_cache, _padded_cache):
@@ -616,11 +627,17 @@ def _cached_by_table(cache: Dict[int, tuple], table: Table, subkey, compute):
                     break
             if victim:
                 break
-        if victim is None:
+        if victim is not None:
+            dropped = victim[0].pop(victim[1], None)
+            if dropped is not None:
+                _device_cache_bytes -= sum(_val_nbytes(v) for v in dropped[1].values())
+            continue
+        vkey = next(iter(_verify_cache), None)
+        if vkey is None:
             break
-        dropped = victim[0].pop(victim[1], None)
+        dropped = _verify_cache.pop(vkey, None)
         if dropped is not None:
-            _device_cache_bytes -= sum(_val_nbytes(v) for v in dropped[1].values())
+            _device_cache_bytes -= _val_nbytes(dropped[2])
     return val
 
 
@@ -631,17 +648,30 @@ def _aligned_key_codes(left: Table, right: Table, lkey: str, rkey: str):
     query)."""
     import weakref
 
+    global _device_cache_bytes
     key = (id(left), id(right), lkey.lower(), rkey.lower())
     ent = _verify_cache.get(key)
     if ent is not None and ent[0]() is left and ent[1]() is right:
+        _verify_cache[key] = _verify_cache.pop(key)  # LRU refresh
         return ent[2]
     lc, rc = align_dictionaries(left.column(lkey), right.column(rkey))
     la, ra = lc.data, rc.data
 
     def _evict(_, key=key):
-        _verify_cache.pop(key, None)
+        global _device_cache_bytes
+        dropped = _verify_cache.pop(key, None)
+        if dropped is not None:
+            _device_cache_bytes -= _val_nbytes(dropped[2])
 
     _verify_cache[key] = (weakref.ref(left, _evict), weakref.ref(right, _evict), (la, ra))
+    _device_cache_bytes += _val_nbytes((la, ra))
+    while _device_cache_bytes > _DEVICE_CACHE_BUDGET_BYTES:
+        victim_key = next((k for k in _verify_cache if k != key), None)
+        if victim_key is None:
+            break
+        dropped = _verify_cache.pop(victim_key, None)
+        if dropped is not None:
+            _device_cache_bytes -= _val_nbytes(dropped[2])
     return la, ra
 
 

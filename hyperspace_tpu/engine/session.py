@@ -30,6 +30,7 @@ from .logical import (
     SourceRelation,
 )
 from .physical import ExecContext, PhysicalNode, plan_physical
+from .schema import Schema
 from .table import Table
 
 
@@ -168,11 +169,33 @@ class DataFrameReader:
         if not files:
             raise HyperspaceException(f"No {file_format} files found under {path_list}")
         schema = engine_io.infer_schema([f.path for f in files], file_format)
+        roots = [os.path.abspath(p) for p in path_list]
+        # Absolute file paths throughout: partition discovery compares against
+        # the abspath'd roots, and relative spellings must not change the schema.
+        from ..storage.filesystem import FileStatus
+
+        files = [
+            FileStatus(os.path.abspath(f.path), f.size, f.modified_time, f.is_dir)
+            for f in files
+        ]
+        # Hive layout: `key=value` path segments become columns appended to the
+        # schema (the PartitioningAwareFileIndex analogue).
+        from .partitioning import discover
+
+        spec = discover(roots, [f.path for f in files])
+        if spec is not None:
+            clash = [c for c in spec.columns if c in schema]
+            if clash:
+                raise HyperspaceException(
+                    f"Partition column(s) also present in data files: {clash}"
+                )
+            schema = Schema(list(schema.fields) + spec.fields)
         rel = SourceRelation(
-            root_paths=[os.path.abspath(p) for p in path_list],
+            root_paths=roots,
             file_format=file_format,
             schema=schema,
             files=files,
+            partition_spec=spec,
         )
         return DataFrame(self._session, ScanNode(rel))
 
@@ -184,6 +207,9 @@ class DataFrameReader:
 
     def json(self, *paths) -> DataFrame:
         return self._read(paths if len(paths) > 1 else paths[0], "json")
+
+    def orc(self, *paths) -> DataFrame:
+        return self._read(paths if len(paths) > 1 else paths[0], "orc")
 
     def delta(self, path: str) -> DataFrame:
         """Snapshot read of a delta-style transactional table (extension): the file
@@ -265,6 +291,10 @@ class HyperspaceSession:
     def write_parquet(self, data: Union[Table, Dict[str, list]], path: str) -> None:
         t = data if isinstance(data, Table) else Table.from_pydict(data)
         engine_io.write_parquet(t, os.path.join(path, "part-00000.parquet"))
+
+    def write_orc(self, data: Union[Table, Dict[str, list]], path: str) -> None:
+        t = data if isinstance(data, Table) else Table.from_pydict(data)
+        engine_io.write_orc(t, os.path.join(path, "part-00000.orc"))
 
     def write_csv(self, data: Union[Table, Dict[str, list]], path: str) -> None:
         t = data if isinstance(data, Table) else Table.from_pydict(data)

@@ -65,15 +65,33 @@ class CoveringIndexBuilder(IndexerBuilder):
 
     # -- the build (reference CreateActionBase.scala:119-191) ---------------
 
+    def _missing_partition_columns(self, rel: SourceRelation, wanted: List[str]) -> List[str]:
+        """Partition columns not already selected — lineage mode pulls them into the
+        index so a lineage row can be mapped back to its source partition
+        (reference `CreateActionBase.scala:176-188`)."""
+        if rel.partition_spec is None:
+            return []
+        have = {w.lower() for w in wanted}
+        return [c for c in rel.partition_spec.columns if c.lower() not in have]
+
     def _prepare_index_table(self, df: DataFrame, index_config: IndexConfig) -> Table:
-        """Select indexed+included columns (+ lineage `_data_file_name` when enabled)."""
+        """Select indexed+included columns (+ lineage `_data_file_name` and missing
+        partition columns when lineage is enabled)."""
         indexed, included = self._resolved_columns(df, index_config)
         rel = df.plan.relation
         wanted = indexed + included
+        partitions = (
+            None
+            if rel.partition_spec is None
+            else (rel.partition_spec, rel.root_paths)
+        )
         if self._session.hs_conf.lineage_enabled:
+            wanted = wanted + self._missing_partition_columns(rel, wanted)
             parts = []
             for f in rel.files:
-                t = engine_io.read_files([f.path], rel.file_format, wanted)
+                t = engine_io.read_files(
+                    [f.path], rel.file_format, wanted, partitions=partitions
+                )
                 lineage = Table.from_pydict(
                     {IndexConstants.DATA_FILE_NAME_COLUMN: [f.path] * t.num_rows}
                 )
@@ -84,7 +102,7 @@ class CoveringIndexBuilder(IndexerBuilder):
                 parts.append(Table(cols))
             return Table.concat(parts)
         files = [f.path for f in rel.files]
-        return engine_io.read_files(files, rel.file_format, wanted)
+        return engine_io.read_files(files, rel.file_format, wanted, partitions=partitions)
 
     def write(self, df: DataFrame, index_config: IndexConfig, index_data_path: str) -> None:
         indexed, _ = self._resolved_columns(df, index_config)
@@ -129,6 +147,8 @@ class CoveringIndexBuilder(IndexerBuilder):
         src = df.plan.output_schema
         fields: List[Field] = [src.field(n) for n in indexed + included]
         if self._session.hs_conf.lineage_enabled:
+            for p in self._missing_partition_columns(df.plan.relation, indexed + included):
+                fields.append(src.field(p))
             fields.append(Field(IndexConstants.DATA_FILE_NAME_COLUMN, STRING))
         return Schema(fields)
 
@@ -180,6 +200,8 @@ class CoveringIndexBuilder(IndexerBuilder):
             return reader.csv(*relation.root_paths)
         if fmt == "json":
             return reader.json(*relation.root_paths)
+        if fmt == "orc":
+            return reader.orc(*relation.root_paths)
         if fmt == "delta":
             return reader.delta(*relation.root_paths)
         raise HyperspaceException(f"Unsupported file format: {fmt}")
@@ -198,5 +220,6 @@ class CoveringIndexBuilder(IndexerBuilder):
             schema=rel.schema,
             files=[f for f in rel.files if f.path in wanted],
             options=dict(rel.options),
+            partition_spec=rel.partition_spec,
         )
         return DF(self._session, ScanNode(sub))

@@ -225,7 +225,21 @@ class IndexCollectionManager(IndexManager):
 # ---------------------------------------------------------------------------
 
 
-class CreationTimeBasedIndexCache:
+class IndexCache:
+    """Cache trait (reference `index/Cache.scala:23-41`): get/set/clear of the
+    full entry list."""
+
+    def get(self) -> Optional[List[IndexLogEntry]]:
+        raise NotImplementedError
+
+    def set(self, entries: List[IndexLogEntry]) -> None:
+        raise NotImplementedError
+
+    def clear(self) -> None:
+        raise NotImplementedError
+
+
+class CreationTimeBasedIndexCache(IndexCache):
     """TTL cache of the full entry list (reference `CreationTimeBasedIndexCache`,
     :117-168)."""
 
@@ -251,14 +265,41 @@ class CreationTimeBasedIndexCache:
         self._set_time = 0.0
 
 
+class IndexCacheFactory:
+    """Cache impl keyed by policy name (reference `IndexCacheFactory.scala:23-38`);
+    `register` is the pluggability seam tests/extensions inject through."""
+
+    CREATION_TIME_BASED = "CREATION_TIME_BASED"
+    _registry = {}
+
+    @classmethod
+    def register(cls, cache_type: str, ctor) -> None:
+        """ctor: (session) -> IndexCache"""
+        cls._registry[cache_type.upper()] = ctor
+
+    @classmethod
+    def create(cls, cache_type: str, session: HyperspaceSession) -> IndexCache:
+        ctor = cls._registry.get(cache_type.upper())
+        if ctor is None:
+            raise HyperspaceException(f"Unknown index cache type: {cache_type}")
+        return ctor(session)
+
+
+IndexCacheFactory.register(
+    IndexCacheFactory.CREATION_TIME_BASED,
+    lambda session: CreationTimeBasedIndexCache(
+        lambda: session.hs_conf.cache_expiry_seconds
+    ),
+)
+
+
 class CachingIndexCollectionManager(IndexCollectionManager):
-    """Read-path cache; every mutating API clears it (reference :77-100)."""
+    """Read-path cache; every mutating API clears it (reference :77-100). The
+    cache policy comes from `hyperspace.index.cache.type` via the factory."""
 
     def __init__(self, session: HyperspaceSession, **kwargs):
         super().__init__(session, **kwargs)
-        self._cache = CreationTimeBasedIndexCache(
-            lambda: session.hs_conf.cache_expiry_seconds
-        )
+        self._cache = IndexCacheFactory.create(session.hs_conf.cache_type, session)
 
     def get_indexes(self, states_filter: Optional[Sequence[str]] = None) -> List[IndexLogEntry]:
         cached = self._cache.get()

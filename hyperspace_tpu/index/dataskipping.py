@@ -187,9 +187,16 @@ class DataSkippingIndexBuilder(IndexerBuilder):
     def write(self, df, index_config: DataSkippingIndexConfig, index_data_path: str) -> None:
         rel = df.plan.relation
         cols = list(dict.fromkeys(s.column for s in index_config.sketches))
+        partitions = (
+            None
+            if rel.partition_spec is None
+            else (rel.partition_spec, rel.root_paths)
+        )
         rows: Dict[str, list] = {_FILE_COL: []}
         for f in rel.files:
-            t = engine_io.read_files([f.path], rel.file_format, cols)
+            t = engine_io.read_files(
+                [f.path], rel.file_format, cols, partitions=partitions
+            )
             rows[_FILE_COL].append(f.path)
             for s in index_config.sketches:
                 c = t.column(s.column)

@@ -15,7 +15,13 @@ from .log_manager import IndexLogManager, IndexLogManagerImpl
 
 class FileSystemFactory:
     def create(self, path: str) -> FileSystem:
-        return LocalFileSystem()
+        """Backend by path scheme (reference `FileSystemFactory.create(path)`,
+        `factories.scala:43-50`): remote protocols (memory://, s3://, ...) get the
+        fsspec adapter; everything else the local disk."""
+        from ..storage.remote import filesystem_for_path
+
+        remote = filesystem_for_path(path)
+        return remote if remote is not None else LocalFileSystem()
 
 
 class IndexLogManagerFactory:

@@ -160,6 +160,7 @@ class DataSkippingFilterRule:
                     files=kept_files,
                     options=dict(rel.options),
                     pruned_by=sorted(set(used_indexes)),
+                    partition_spec=rel.partition_spec,
                 )
                 new_node = FilterNode(node.condition, ScanNode(pruned))
                 EventLoggerFactory.get_logger(

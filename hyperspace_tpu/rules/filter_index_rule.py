@@ -101,6 +101,7 @@ class FilterIndexRule:
                         schema=scan.relation.schema,
                         files=chosen.appended,
                         options=dict(scan.relation.options),
+                        partition_spec=scan.relation.partition_spec,
                     )
                     index_child = UnionNode(
                         [

@@ -206,6 +206,8 @@ class JoinIndexRule:
                             files=cand.appended,
                             file_format=scan.relation.file_format,
                             schema=scan.relation.schema,
+                            root_paths=list(scan.relation.root_paths),
+                            partition_spec=scan.relation.partition_spec,
                         )
 
                     def replace(n: LogicalPlan) -> LogicalPlan:

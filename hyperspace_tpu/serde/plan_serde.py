@@ -26,6 +26,7 @@ from ..engine.logical import (
     ScanNode,
     SourceRelation,
 )
+from ..engine.partitioning import PartitionSpec
 from ..engine.schema import Schema
 from ..exceptions import HyperspaceException
 from ..storage.filesystem import FileStatus
@@ -100,6 +101,9 @@ def _relation_to_dict(rel: SourceRelation) -> Dict[str, Any]:
             }
         ),
         "indexName": rel.index_name,
+        "partitionSpec": (
+            None if rel.partition_spec is None else rel.partition_spec.to_json()
+        ),
     }
 
 
@@ -123,6 +127,7 @@ def _relation_from_dict(d: Dict[str, Any]) -> SourceRelation:
             )
         ),
         index_name=d.get("indexName"),
+        partition_spec=PartitionSpec.from_json(d.get("partitionSpec")),
     )
 
 

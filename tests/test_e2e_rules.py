@@ -278,10 +278,11 @@ class TestJoinIndexE2E:
 
 
 class TestIndexManagerE2E:
-    @pytest.mark.parametrize("fmt", ["parquet", "csv", "json"])
+    @pytest.mark.parametrize("fmt", ["parquet", "csv", "json", "orc"])
     def test_full_crud_and_refresh_across_formats(self, session, tmp_path, fmt):
         """Reference `IndexManagerTests` (:196-252): CRUD + refresh rebuild across
-        csv/parquet/json sources."""
+        csv/parquet/json/orc sources (the reference's format whitelist,
+        `LogicalPlanSerDeUtils.scala:223-243`)."""
         path = str(tmp_path / f"src_{fmt}")
         getattr(session, f"write_{fmt}")(SAMPLE, path)
         df = getattr(session.read, fmt)(path)

@@ -188,3 +188,54 @@ class TestCache:
         mgr._cache.set([])
         time.sleep(0.01)
         assert [e.name for e in mgr.get_indexes()] == ["cIdx"]
+
+
+class TestCacheFactory:
+    def test_pluggable_cache_injected_via_factory(self, session, tmp_path):
+        """Cache trait + factory keyed by policy name (reference
+        `IndexCacheFactory.scala:23-38`): a custom policy is selected by conf."""
+        from hyperspace_tpu.index.collection_manager import (
+            CachingIndexCollectionManager,
+            IndexCache,
+            IndexCacheFactory,
+        )
+
+        calls = {"get": 0, "set": 0, "clear": 0}
+
+        class SpyCache(IndexCache):
+            def __init__(self):
+                self._entries = None
+
+            def get(self):
+                calls["get"] += 1
+                return self._entries
+
+            def set(self, entries):
+                calls["set"] += 1
+                self._entries = list(entries)
+
+            def clear(self):
+                calls["clear"] += 1
+                self._entries = None
+
+        IndexCacheFactory.register("SPY", lambda s: SpyCache())
+        session.conf.set(IndexConstants.INDEX_CACHE_TYPE, "spy")
+        session.conf.set(IndexConstants.INDEX_SYSTEM_PATH, str(tmp_path / "idx"))
+        mgr = CachingIndexCollectionManager(session)
+        mgr.get_indexes()
+        assert calls["get"] == 1 and calls["set"] == 1
+        mgr.get_indexes()
+        assert calls["get"] == 2 and calls["set"] == 1  # hit
+        session.write_parquet(SAMPLE, str(tmp_path / "t"))
+        mgr.create(
+            session.read.parquet(str(tmp_path / "t")),
+            __import__("hyperspace_tpu").IndexConfig("cIdx", ["c3"], ["c2"]),
+        )
+        assert calls["clear"] >= 1  # mutation cleared the injected cache
+
+    def test_unknown_cache_type_raises(self, session):
+        from hyperspace_tpu import HyperspaceException
+        from hyperspace_tpu.index.collection_manager import IndexCacheFactory
+
+        with pytest.raises(HyperspaceException, match="cache type"):
+            IndexCacheFactory.create("NOPE", session)

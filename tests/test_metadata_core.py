@@ -166,14 +166,62 @@ class TestContent:
 # ---------------------------------------------------------------------------
 
 
+def _make_fs(kind: str):
+    if kind == "local":
+        return LocalFileSystem()
+    if kind == "memory":
+        return InMemoryFileSystem()
+    # Remote-protocol backend (fsspec adapter over an isolated instance).
+    from fsspec.implementations.memory import MemoryFileSystem
+
+    from hyperspace_tpu.storage.remote import FsspecFileSystem
+
+    inst = MemoryFileSystem()
+    inst.store = {}  # MemoryFileSystem state is class-global; isolate per test
+    inst.pseudo_dirs = [""]
+    return FsspecFileSystem(inst)
+
+
 class TestIndexLogManager:
-    @pytest.mark.parametrize("fs_kind", ["local", "memory"])
+    @pytest.mark.parametrize("fs_kind", ["local", "memory", "fsspec"])
     def test_occ_write_refuses_existing_id(self, tmp_path, fs_kind):
-        fs = LocalFileSystem() if fs_kind == "local" else InMemoryFileSystem()
+        fs = _make_fs(fs_kind)
         mgr = IndexLogManagerImpl(str(tmp_path / "idx"), fs)
         assert mgr.write_log(0, _sample_entry(state=states.CREATING))
         assert not mgr.write_log(0, _sample_entry(state=states.ACTIVE))  # OCC conflict
         assert mgr.get_log(0).state == states.CREATING
+
+    @pytest.mark.parametrize("fs_kind", ["local", "fsspec"])
+    def test_occ_racing_writers_exactly_one_wins(self, tmp_path, fs_kind):
+        """N threads race the same log id: exactly one commit succeeds (the
+        reference's temp+atomic-rename contract; conditional put on remote)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        fs = _make_fs(fs_kind)
+        mgr = IndexLogManagerImpl(str(tmp_path / "race"), fs)
+        with ThreadPoolExecutor(max_workers=8) as pool:
+            wins = list(
+                pool.map(
+                    lambda i: mgr.write_log(0, _sample_entry(state=states.CREATING)),
+                    range(8),
+                )
+            )
+        assert sum(bool(w) for w in wins) == 1
+
+    @pytest.mark.parametrize("fs_kind", ["local", "memory", "fsspec"])
+    def test_full_log_flow_per_backend(self, tmp_path, fs_kind):
+        """latestStable pointer + fallback scan, on every storage backend."""
+        fs = _make_fs(fs_kind)
+        mgr = IndexLogManagerImpl(str(tmp_path / "flow"), fs)
+        mgr.write_log(0, _sample_entry(state=states.CREATING))
+        assert mgr.get_latest_stable_log() is None
+        mgr.write_log(1, _sample_entry(state=states.ACTIVE))
+        assert mgr.get_latest_stable_log().state == states.ACTIVE
+        assert mgr.create_latest_stable_log(1)
+        assert mgr.get_latest_stable_log().id == 1
+        assert mgr.get_latest_id() == 1
+        assert mgr.delete_latest_stable_log()
+        assert mgr.get_latest_stable_log().id == 1  # descending scan fallback
 
     def test_latest_id_and_log(self, tmp_path):
         mgr = IndexLogManagerImpl(str(tmp_path / "idx"))

@@ -1,0 +1,261 @@
+"""Hive-partitioned sources: `root/key=value/.../file.parquet`.
+
+The reference indexes partitioned datasets (partitioned cases throughout
+`E2EHyperspaceRulesTests.scala`) and lineage pulls missing partition columns into
+the index (`CreateActionBase.scala:176-188`). These tests drive the engine's
+partition discovery + the rewrite rules over a partitioned dataset with the
+on/off result-equality oracle.
+"""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import IndexConfig, IndexConstants
+from hyperspace_tpu.engine import HyperspaceSession, col
+from hyperspace_tpu.hyperspace import Hyperspace, disable_hyperspace, enable_hyperspace
+
+
+@pytest.fixture()
+def part_session(tmp_path):
+    s = HyperspaceSession(warehouse=str(tmp_path))
+    s.conf.set(IndexConstants.INDEX_SYSTEM_PATH, str(tmp_path / "indexes"))
+    s.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
+    rng = np.random.RandomState(5)
+    root = tmp_path / "events"
+    for year in (2023, 2024):
+        for country in ("us", "de"):
+            d = root / f"year={year}" / f"country={country}"
+            os.makedirs(d)
+            n = 50
+            pq.write_table(
+                pa.table(
+                    {
+                        "uid": rng.randint(0, 40, n).astype(np.int64),
+                        "value": rng.randint(0, 1000, n).astype(np.int64),
+                    }
+                ),
+                str(d / "part-00000.parquet"),
+            )
+    return s, str(root), str(tmp_path)
+
+
+def test_partition_columns_materialize(part_session):
+    s, root, _ = part_session
+    df = s.read.parquet(root)
+    assert df.schema.names == ["uid", "value", "year", "country"]
+    assert df.schema.field("year").dtype == "int64"
+    assert df.schema.field("country").dtype == "string"
+    assert df.count() == 200
+    assert df.filter(col("year") == 2023).count() == 100
+    assert df.filter((col("country") == "us") & (col("year") == 2024)).count() == 50
+    # grouped over partition column
+    rows = df.group_by("country").agg(n=("*", "count")).sorted_rows()
+    assert rows == [("de", 100), ("us", 100)]
+
+
+def test_partition_value_types_and_nulls(part_session, tmp_path):
+    s = part_session[0]
+    root = tmp_path / "t2"
+    for seg, vals in (("k=12", [1]), ("k=__HIVE_DEFAULT_PARTITION__", [2]), ("k=7", [3])):
+        d = root / seg
+        os.makedirs(d)
+        pq.write_table(pa.table({"x": pa.array(vals, type=pa.int64())}), str(d / "f.parquet"))
+    df = s.read.parquet(str(root))
+    assert df.schema.field("k").dtype == "int64"
+    rows = df.select("x", "k").sorted_rows()
+    assert rows == [(1, 12), (2, None), (3, 7)]
+    # null partition value participates in IS NULL
+    assert df.filter(col("k").is_null()).count() == 1
+
+
+def test_partition_clash_with_data_column_rejected(part_session, tmp_path):
+    s = part_session[0]
+    root = tmp_path / "t3"
+    d = root / "x=1"
+    os.makedirs(d)
+    pq.write_table(pa.table({"x": pa.array([1], type=pa.int64())}), str(d / "f.parquet"))
+    from hyperspace_tpu import HyperspaceException
+
+    with pytest.raises(HyperspaceException, match="Partition column"):
+        s.read.parquet(str(root))
+
+
+def test_filter_index_over_partitioned_source(part_session):
+    """E2E filter-index on/off oracle with a partition column in the index."""
+    s, root, _ = part_session
+    hs = Hyperspace(s)
+    hs.create_index(
+        s.read.parquet(root),
+        IndexConfig("pfIdx", ["country"], ["uid", "value", "year"]),
+    )
+
+    def q():
+        return (
+            s.read.parquet(root)
+            .filter(col("country") == "de")
+            .select("uid", "value", "year")
+        )
+
+    disable_hyperspace(s)
+    expected = q().sorted_rows()
+    enable_hyperspace(s)
+    plan = q().explain_string()
+    assert "index=pfIdx" in plan
+    got = q().sorted_rows()
+    assert got == expected and len(got) == 100
+
+
+def test_join_index_over_partitioned_source(part_session, tmp_path):
+    """E2E join-index on/off oracle where one side is partitioned."""
+    s, root, _ = part_session
+    s.write_parquet(
+        {
+            "userId": np.arange(40, dtype=np.int64),
+            "name": np.array([f"u{i}" for i in range(40)]),
+        },
+        str(tmp_path / "users"),
+    )
+    hs = Hyperspace(s)
+    hs.create_index(
+        s.read.parquet(root), IndexConfig("evIdx", ["uid"], ["value", "year"])
+    )
+    hs.create_index(
+        s.read.parquet(str(tmp_path / "users")), IndexConfig("uIdx", ["userId"], ["name"])
+    )
+
+    def q():
+        e = s.read.parquet(root)
+        u = s.read.parquet(str(tmp_path / "users"))
+        return e.join(u, col("uid") == col("userId")).select("name", "value", "year")
+
+    disable_hyperspace(s)
+    expected = q().sorted_rows()
+    enable_hyperspace(s)
+    plan = q().explain_string()
+    assert "bucketed, no exchange" in plan
+    got = q().sorted_rows()
+    assert got == expected and len(got) == 200
+
+
+def test_lineage_pulls_missing_partition_columns(part_session):
+    """With lineage on, partition columns not in the config land in the index data
+    and schema (reference CreateActionBase.scala:176-188)."""
+    s, root, base = part_session
+    s.conf.set(IndexConstants.INDEX_LINEAGE_ENABLED, True)
+    hs = Hyperspace(s)
+    hs.create_index(s.read.parquet(root), IndexConfig("linIdx", ["uid"], ["value"]))
+    s.conf.set(IndexConstants.INDEX_LINEAGE_ENABLED, False)
+
+    # Inspect the written index files directly: they must carry year+country.
+    idx_dir = os.path.join(base, "indexes", "linIdx", "v__=0")
+    f = [x for x in sorted(os.listdir(idx_dir)) if x.startswith("part-")][0]
+    t = pq.read_table(os.path.join(idx_dir, f))
+    assert "year" in t.column_names and "country" in t.column_names
+    assert IndexConstants.DATA_FILE_NAME_COLUMN in t.column_names
+
+
+def test_incremental_refresh_partitioned(part_session):
+    """Appended partition dir + incremental refresh + hybrid-type query oracle."""
+    s, root, _ = part_session
+    hs = Hyperspace(s)
+    hs.create_index(
+        s.read.parquet(root), IndexConfig("incIdx", ["uid"], ["value", "country"])
+    )
+    # New partition arrives.
+    d = os.path.join(root, "year=2025", "country=fr")
+    os.makedirs(d)
+    rng = np.random.RandomState(9)
+    pq.write_table(
+        pa.table(
+            {
+                "uid": rng.randint(0, 40, 30).astype(np.int64),
+                "value": rng.randint(0, 1000, 30).astype(np.int64),
+            }
+        ),
+        os.path.join(d, "part-00000.parquet"),
+    )
+    hs.refresh_index("incIdx", mode="incremental")
+
+    def q():
+        return (
+            s.read.parquet(root).filter(col("uid") == 3).select("uid", "value", "country")
+        )
+
+    disable_hyperspace(s)
+    expected = q().sorted_rows()
+    enable_hyperspace(s)
+    got = q().sorted_rows()
+    assert got == expected
+
+
+def test_hybrid_scan_partitioned_append(part_session):
+    """Hybrid Scan merges appended rows from a NEW partition dir, carrying the
+    partition values, without a rebuild."""
+    s, root, _ = part_session
+    s.conf.set(IndexConstants.INDEX_HYBRID_SCAN_ENABLED, True)
+    hs = Hyperspace(s)
+    hs.create_index(
+        s.read.parquet(root),
+        IndexConfig("hyIdx", ["country"], ["uid", "value", "year"]),
+    )
+    d = os.path.join(root, "year=2025", "country=us")
+    os.makedirs(d)
+    pq.write_table(
+        pa.table(
+            {
+                "uid": pa.array([1, 2], type=pa.int64()),
+                "value": pa.array([11, 22], type=pa.int64()),
+            }
+        ),
+        os.path.join(d, "part-00000.parquet"),
+    )
+
+    def q():
+        return (
+            s.read.parquet(root)
+            .filter(col("country") == "us")
+            .select("uid", "value", "year")
+        )
+
+    disable_hyperspace(s)
+    expected = q().sorted_rows()
+    enable_hyperspace(s)
+    got = q().sorted_rows()
+    s.conf.set(IndexConstants.INDEX_HYBRID_SCAN_ENABLED, False)
+    assert got == expected and len(got) == 102
+
+
+def test_relative_path_read_discovers_partitions(part_session, monkeypatch):
+    """Partition discovery must not depend on path spelling (relative vs absolute)."""
+    s, root, base = part_session
+    monkeypatch.chdir(base)
+    rel_df = s.read.parquet("events")
+    abs_df = s.read.parquet(root)
+    assert rel_df.schema.names == abs_df.schema.names
+    assert rel_df.count() == abs_df.count() == 200
+
+
+def test_dataskipping_sketch_on_partition_column(part_session):
+    """MinMax sketch over a hive-partition column builds and prunes."""
+    s, root, _ = part_session
+    from hyperspace_tpu.index.dataskipping import DataSkippingIndexConfig, MinMaxSketch
+
+    hs = Hyperspace(s)
+    hs.create_index(
+        s.read.parquet(root), DataSkippingIndexConfig("dsYear", [MinMaxSketch("year")])
+    )
+
+    def q():
+        return s.read.parquet(root).filter(col("year") == 2023).select("uid", "value")
+
+    disable_hyperspace(s)
+    expected = q().sorted_rows()
+    enable_hyperspace(s)
+    plan = q().explain_string()
+    got = q().sorted_rows()
+    assert got == expected and len(got) == 100
+    assert "pruned by dsYear" in plan or "files pruned" in plan, plan
