@@ -64,6 +64,10 @@ class IndexConstants:
     # compile overhead dwarfs the work); tests set 0 to force the mesh path.
     DISTRIBUTED_MIN_ROWS = "hyperspace.distributed.minRows"
     DISTRIBUTED_MIN_ROWS_DEFAULT = 65536
+    # Hash partitions per device for the general-join exchange (more partitions =
+    # finer probe granularity per device, more padding overhead).
+    DISTRIBUTED_PARTITIONS_PER_DEVICE = "hyperspace.distributed.partitionsPerDevice"
+    DISTRIBUTED_PARTITIONS_PER_DEVICE_DEFAULT = 8
 
 
 class SessionConf:
@@ -160,4 +164,16 @@ class HyperspaceConf:
     def distributed_min_rows(self) -> int:
         return self._c.get_int(
             IndexConstants.DISTRIBUTED_MIN_ROWS, IndexConstants.DISTRIBUTED_MIN_ROWS_DEFAULT
+        )
+
+    @property
+    def partitions_per_device(self) -> int:
+        # Clamped: 0/negative would reach the exchange as a zero modulus and
+        # fail far from the misconfigured key.
+        return max(
+            1,
+            self._c.get_int(
+                IndexConstants.DISTRIBUTED_PARTITIONS_PER_DEVICE,
+                IndexConstants.DISTRIBUTED_PARTITIONS_PER_DEVICE_DEFAULT,
+            ),
         )

@@ -303,13 +303,15 @@ class ExchangeInfo:
     """Partition layout a ShuffleExchange attaches to its output table: rows are
     grouped into `len(starts)-1` hash partitions (sorted by key64 within each), so
     a downstream merge join of two tables exchanged on compatible keys over the
-    same mesh runs co-partitioned with no further communication."""
+    same mesh runs co-partitioned with no further communication. `blocks` is the
+    DEVICE-RESIDENT sharded key layout — the probe consumes it directly, so the
+    exchanged keys never round-trip through the host."""
 
-    def __init__(self, mesh, keys: List[str], starts: np.ndarray, key64_sorted: np.ndarray):
+    def __init__(self, mesh, keys: List[str], starts: np.ndarray, blocks):
         self.mesh = mesh
         self.keys = keys
         self.starts = starts
-        self.key64_sorted = key64_sorted
+        self.blocks = blocks
 
 
 class ShuffleExchangeExec(PhysicalNode):
@@ -331,14 +333,16 @@ class ShuffleExchangeExec(PhysicalNode):
     def children(self):
         return (self.child,)
 
-    def exchange_table(self, mesh, t: Table) -> Table:
+    def exchange_table(self, mesh, t: Table, partitions_per_device: int = 8) -> Table:
         """The real exchange: rows ride the all_to_all to their partition's device;
         the partition layout is attached for the downstream co-partitioned join."""
         from ..parallel.table_ops import distributed_exchange_table
 
-        exchanged, starts, k64 = distributed_exchange_table(mesh, t, self.keys)
+        exchanged, starts, blocks = distributed_exchange_table(
+            mesh, t, self.keys, partitions_per_device
+        )
         exchanged.exchange_info = ExchangeInfo(
-            mesh, [k.lower() for k in self.keys], starts, k64
+            mesh, [k.lower() for k in self.keys], starts, blocks
         )
         return exchanged
 
@@ -350,7 +354,7 @@ class ShuffleExchangeExec(PhysicalNode):
         mesh = ctx.session.mesh_for(t.num_rows) if ctx.session is not None else None
         if mesh is None or t.num_rows == 0:
             return t
-        return self.exchange_table(mesh, t)
+        return self.exchange_table(mesh, t, _partitions_per_device(ctx))
 
     def simple_string(self):
         return f"ShuffleExchange hashpartitioning({', '.join(self.keys)})"
@@ -702,6 +706,27 @@ def _padded_rep(table: Table, starts: np.ndarray, keys: List[str], force_hash: b
     return _cached_by_table(_padded_cache, table, kt, compute)
 
 
+def _partitions_per_device(ctx) -> int:
+    """Exchange partitions per device (conf-tunable; was a hardcoded 8)."""
+    if ctx is None or ctx.session is None:
+        return 8
+    return ctx.session.hs_conf.partitions_per_device
+
+
+def _dist_blocks(table: Table, starts: np.ndarray, keys: List[str], mesh):
+    """Sharded block layout of a bucketed side, cached per table identity (same
+    lifetime as the padded reps): built once per (table, mesh, keys) — steady-state
+    sharded joins start at the probe with zero host→device key traffic."""
+    from ..parallel.table_ops import build_dist_blocks
+
+    subkey = ("dist", tuple(k.lower() for k in keys), id(mesh), mesh.devices.size)
+
+    def compute():
+        return build_dist_blocks(mesh, _table_key64(table, list(keys)), starts)
+
+    return _cached_by_table(_padded_cache, table, subkey, compute)
+
+
 def _table_key64(table: Table, keys: List[str]):
     """Join key64 of a table, cached per table identity.
 
@@ -773,8 +798,9 @@ class SortMergeJoinExec(PhysicalNode):
             rt = rex.child.execute(ctx)
             mesh = ctx.session.mesh_for(lt.num_rows + rt.num_rows)
             if mesh is not None and lt.num_rows > 0 and rt.num_rows > 0:
-                lt = lex.exchange_table(mesh, lt)
-                rt = rex.exchange_table(mesh, rt)
+                ppd = _partitions_per_device(ctx)
+                lt = lex.exchange_table(mesh, lt, ppd)
+                rt = rex.exchange_table(mesh, rt, ppd)
         else:
             lt = self.left.execute(ctx)
             rt = self.right.execute(ctx)
@@ -800,11 +826,10 @@ class SortMergeJoinExec(PhysicalNode):
             return None
         if ri.keys != [k.lower() for k in self.right_keys]:
             return None
-        from ..parallel.table_ops import distributed_bucketed_join_pairs
+        from ..parallel.table_ops import probe_dist_blocks
 
-        return distributed_bucketed_join_pairs(
-            li.mesh, li.key64_sorted, li.starts, ri.key64_sorted, ri.starts
-        )
+        # The exchanged key blocks are still on device — probe them directly.
+        return probe_dist_blocks(li.mesh, li.blocks, ri.blocks)
 
     def _execute_bucketed(self, ctx) -> Table:
         """Batched co-bucketed merge join: equal keys are co-located by construction
@@ -831,16 +856,15 @@ class SortMergeJoinExec(PhysicalNode):
         if mesh is not None:
             # Sharded probe: each device joins its own bucket range with zero
             # collectives (non-divisible bucket counts are padded with empty
-            # virtual buckets inside).
-            from ..parallel.table_ops import distributed_bucketed_join_pairs
+            # virtual buckets inside). The block layouts are cached per table
+            # identity, so steady-state queries skip the host→device key upload
+            # and start at the probe.
+            from ..parallel.table_ops import probe_dist_blocks
 
-            pairs = distributed_bucketed_join_pairs(
-                mesh,
-                _table_key64(left, self.left_keys),
-                l_starts,
-                _table_key64(right, self.right_keys),
-                r_starts,
-            )
+            l_blocks = _dist_blocks(left, l_starts, self.left_keys, mesh)
+            r_blocks = _dist_blocks(right, r_starts, self.right_keys, mesh)
+            if l_blocks is not None and r_blocks is not None:
+                pairs = probe_dist_blocks(mesh, l_blocks, r_blocks)
         if pairs is None:
             # Single-device: cached device-resident padded matrices (value-direct
             # when possible), so the steady-state query starts at the probe. The

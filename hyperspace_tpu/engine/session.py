@@ -169,14 +169,20 @@ class DataFrameReader:
         if not files:
             raise HyperspaceException(f"No {file_format} files found under {path_list}")
         schema = engine_io.infer_schema([f.path for f in files], file_format)
-        roots = [os.path.abspath(p) for p in path_list]
-        # Absolute file paths throughout: partition discovery compares against
-        # the abspath'd roots, and relative spellings must not change the schema.
+        # Absolute local paths throughout: partition discovery compares files
+        # against roots, and relative spellings must not change the schema.
+        # URL-scheme paths (s3://, memory://, ...) pass through untouched —
+        # abspath would mangle them ("s3://x" -> "/cwd/s3:/x").
+        import re
+
+        def _abs(p: str) -> str:
+            return p if re.match(r"^[A-Za-z][A-Za-z0-9+.-]*://", p) else os.path.abspath(p)
+
+        roots = [_abs(p) for p in path_list]
         from ..storage.filesystem import FileStatus
 
         files = [
-            FileStatus(os.path.abspath(f.path), f.size, f.modified_time, f.is_dir)
-            for f in files
+            FileStatus(_abs(f.path), f.size, f.modified_time, f.is_dir) for f in files
         ]
         # Hive layout: `key=value` path segments become columns appended to the
         # schema (the PartitioningAwareFileIndex analogue).

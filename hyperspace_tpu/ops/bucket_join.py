@@ -95,19 +95,6 @@ def _expand_np(
     return l_starts[b] + l_slot, r_starts[b] + r_slot
 
 
-def _expand(lo, counts, l_order, r_order, l_starts, r_starts, total: int):
-    """Device-array signature kept for the distributed path; computes on host."""
-    li, ri = _expand_np(
-        np.asarray(lo),
-        np.asarray(counts),
-        np.asarray(l_starts),
-        np.asarray(r_starts),
-        np.asarray(l_order),
-        np.asarray(r_order),
-    )
-    return li, ri
-
-
 @partial(jax.jit, static_argnums=(2, 3))
 def _pad_only(vals, starts, num_buckets: int, cap: int, pad_value):
     """Scatter per-row values (concatenated in bucket order) into a padded [B, cap]

@@ -10,10 +10,15 @@ layer are written against.
 The load-bearing requirement is the operation log's optimistic concurrency: the
 commit primitive must be atomic no-overwrite. Object stores have no atomic
 rename, so this backend implements `atomic_write_text` with fsspec's exclusive
-create (`open(path, "xb")`) instead of the local temp+hard-link dance — a single
-conditional put, which IS the atomic primitive object stores offer (S3
-If-None-Match, GCS precondition, ABFS lease). `rename` remains check-then-move
-and is documented non-atomic; nothing on the OCC path uses it.
+create (`open(path, "xb")`), mapping to the conditional-put primitive object
+stores offer (S3 If-None-Match, GCS precondition, ABFS lease). CAVEAT, stated
+plainly: fsspec documents mode "x" as implementation-dependent — some backends
+implement it as a non-atomic exists+put or not at all. The OCC guarantee
+therefore holds exactly on backends whose driver does a true server-side
+conditional create (verified here: memory; s3fs>=2024 uses If-None-Match);
+deployments must verify their driver before trusting racing writers. `rename`
+remains check-then-move and is documented non-atomic; nothing on the OCC path
+uses it.
 """
 
 from __future__ import annotations
@@ -99,9 +104,11 @@ class FsspecFileSystem(FileSystem):
             f.write(data)
 
     def atomic_write_text(self, path: str, text: str) -> bool:
-        """OCC commit: exclusive create (`xb`) — the conditional-put primitive.
-        Exactly one of N racing writers of the same log id succeeds; the rest get
-        FileExistsError → False (`IndexLogManager.scala:146-162` contract)."""
+        """OCC commit: exclusive create (`xb`). On backends with a true
+        conditional put, exactly one of N racing writers of the same log id
+        succeeds; the rest get FileExistsError → False
+        (`IndexLogManager.scala:146-162` contract). See the module docstring for
+        the per-backend atomicity caveat."""
         parent = posixpath.dirname(path)
         if parent:
             self._fs.makedirs(parent, exist_ok=True)

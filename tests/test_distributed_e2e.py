@@ -228,7 +228,7 @@ def test_nondivisible_bucket_count_takes_distributed_probe(dist_session, monkeyp
     from hyperspace_tpu.parallel import table_ops
 
     calls = {"n": 0, "none": 0}
-    real = table_ops.distributed_bucketed_join_pairs
+    real = table_ops.probe_dist_blocks
 
     def spy(*a, **k):
         out = real(*a, **k)
@@ -236,7 +236,7 @@ def test_nondivisible_bucket_count_takes_distributed_probe(dist_session, monkeyp
         calls["none"] += out is None
         return out
 
-    monkeypatch.setattr(table_ops, "distributed_bucketed_join_pairs", spy)
+    monkeypatch.setattr(table_ops, "probe_dist_blocks", spy)
 
     disable_hyperspace(s)
     expected = _join_query(s, base).sorted_rows()
@@ -244,3 +244,27 @@ def test_nondivisible_bucket_count_takes_distributed_probe(dist_session, monkeyp
     got = _join_query(s, base).sorted_rows()
     assert got == expected and len(got) > 0
     assert calls["n"] > 0 and calls["none"] == 0
+
+
+def test_steady_state_probes_without_rebuilding_blocks(dist_session):
+    """The sharded join's block layouts upload ONCE per table; repeat queries hit
+    the cache and go straight to the probe (the r2 'host round-trip' finding)."""
+    s, base = dist_session
+    hs = Hyperspace(s)
+    hs.create_index(
+        s.read.parquet(os.path.join(base, "dept")),
+        IndexConfig("ssIdx1", ["deptId"], ["deptName"]),
+    )
+    hs.create_index(
+        s.read.parquet(os.path.join(base, "emp")),
+        IndexConfig("ssIdx2", ["empDept"], ["empId"]),
+    )
+    enable_hyperspace(s)
+    from hyperspace_tpu.parallel.table_ops import DIST_JOIN_STATS
+
+    _join_query(s, base).count()  # warm-up: builds both block layouts
+    b0, p0 = DIST_JOIN_STATS["block_builds"], DIST_JOIN_STATS["probes"]
+    for _ in range(3):
+        _join_query(s, base).count()
+    assert DIST_JOIN_STATS["block_builds"] == b0  # no re-upload
+    assert DIST_JOIN_STATS["probes"] == p0 + 3
