@@ -235,11 +235,16 @@ def _device_section(s, base, col, runs, backend) -> dict:
         jax.block_until_ready(_probe(lk, rk, l_rep.lengths, r_rep.lengths))
 
     one()  # compile
+    from hyperspace_tpu.telemetry.profiling import annotate, trace
+
+    profiling = bool(os.environ.get("BENCH_PROFILE_DIR"))
     times = []
-    for _ in range(runs):
-        t0 = _now()
-        one()
-        times.append(_now() - t0)
+    with trace(os.environ.get("BENCH_PROFILE_DIR")):  # xprof when requested
+        for _ in range(runs):
+            t0 = _now()
+            with annotate("bucketed-probe", enabled=profiling):
+                one()
+            times.append(_now() - t0)
     device_time_s = float(np.percentile(times, 50))
     nbytes = 3 * lk.dtype.itemsize * (
         int(np.prod(lk.shape)) + int(np.prod(rk.shape))

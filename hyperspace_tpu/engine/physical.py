@@ -55,6 +55,31 @@ class ExecContext:
         self.session = session
 
 
+_footer_count_cache: Dict[tuple, int] = {}
+
+
+def _footer_row_count(files, file_format: str) -> Optional[int]:
+    """Total row count from parquet footers — no column decode, no device work
+    (the analogue of Spark's metadata-only count). None for non-parquet formats
+    (CSV/JSON carry no row-count metadata)."""
+    if file_format not in ("parquet", "delta"):
+        return None
+    import pyarrow.parquet as pq
+
+    total = 0
+    for f in files:
+        key = (f.path, f.size, f.modified_time)
+        hit = _footer_count_cache.get(key)
+        if hit is None:
+            try:
+                hit = pq.ParquetFile(f.path).metadata.num_rows
+            except Exception:
+                return None
+            _footer_count_cache[key] = hit
+        total += hit
+    return total
+
+
 class PhysicalNode:
     name = "Physical"
 
@@ -63,6 +88,12 @@ class PhysicalNode:
 
     def execute(self, ctx: ExecContext) -> Table:
         raise NotImplementedError
+
+    def execute_count(self, ctx: ExecContext) -> int:
+        """Row count of this node's output. Default materializes; operators whose
+        count is knowable without assembling the output (scans via parquet
+        footers, joins via verified pair counts, projections) override."""
+        return self.execute(ctx).num_rows
 
     def simple_string(self) -> str:
         return self.name
@@ -110,6 +141,13 @@ class ScanExec(PhysicalNode):
         return engine_io.read_files(
             files, self.relation.file_format, self.columns, partitions=partitions
         )
+
+    def execute_count(self, ctx) -> int:
+        rel = self.relation
+        if rel.hybrid_append is not None and rel.bucket_spec is not None:
+            return BucketedIndexScanExec(rel, self.columns).execute_count(ctx)
+        n = _footer_row_count(rel.files, rel.file_format)
+        return n if n is not None else self.execute(ctx).num_rows
 
     def simple_string(self):
         cols = f" [{', '.join(self.columns)}]" if self.columns else ""
@@ -227,6 +265,18 @@ class BucketedIndexScanExec(PhysicalNode):
     def execute(self, ctx) -> Table:
         return self.execute_concat(ctx)[0]
 
+    def execute_count(self, ctx) -> int:
+        n = _footer_row_count(self.relation.files, "parquet")  # index data is parquet
+        ha = self.relation.hybrid_append
+        if n is None:
+            return self.execute(ctx).num_rows
+        if ha is not None:
+            appended = _footer_row_count(ha.files, ha.file_format)
+            if appended is None:
+                return self.execute(ctx).num_rows
+            n += appended
+        return n
+
     def simple_string(self):
         spec = self.relation.bucket_spec
         return (
@@ -275,6 +325,9 @@ class ProjectExec(PhysicalNode):
     def execute(self, ctx) -> Table:
         return self.child.execute(ctx).select(self.column_names)
 
+    def execute_count(self, ctx) -> int:
+        return self.child.execute_count(ctx)  # projection preserves row count
+
     def simple_string(self):
         return f"Project [{', '.join(self.column_names)}]"
 
@@ -294,6 +347,9 @@ class UnionExec(PhysicalNode):
         names = tables[0].column_names
         tables = [t if t.column_names == names else t.select(names) for t in tables]
         return Table.concat([t for t in tables])
+
+    def execute_count(self, ctx) -> int:
+        return sum(c.execute_count(ctx) for c in self._children)
 
     def simple_string(self):
         return f"Union ({len(self._children)})"
@@ -356,6 +412,9 @@ class ShuffleExchangeExec(PhysicalNode):
             return t
         return self.exchange_table(mesh, t, _partitions_per_device(ctx))
 
+    def execute_count(self, ctx) -> int:
+        return self.child.execute_count(ctx)  # exchange moves rows, never drops
+
     def simple_string(self):
         return f"ShuffleExchange hashpartitioning({', '.join(self.keys)})"
 
@@ -381,6 +440,9 @@ class SortExec(PhysicalNode):
 
     def execute(self, ctx) -> Table:
         return self.child.execute(ctx)
+
+    def execute_count(self, ctx) -> int:
+        return self.child.execute_count(ctx)
 
     def simple_string(self):
         return f"Sort [{', '.join(self.keys)}]"
@@ -427,6 +489,9 @@ class OrderByExec(PhysicalNode):
     def children(self):
         return (self.child,)
 
+    def execute_count(self, ctx) -> int:
+        return self.child.execute_count(ctx)
+
     def execute(self, ctx) -> Table:
         t = self.child.execute(ctx)
         if t.num_rows <= 1:
@@ -471,6 +536,9 @@ class LimitExec(PhysicalNode):
         if t.num_rows <= self.n:
             return t
         return t.take(np.arange(self.n))
+
+    def execute_count(self, ctx) -> int:
+        return min(self.n, self.child.execute_count(ctx))
 
     def simple_string(self):
         return f"Limit {self.n}"
@@ -527,19 +595,18 @@ def _assemble_join(
     return Table(out)
 
 
-def _gather_verified(
+def _verify_pairs(
     left: Table,
     right: Table,
     left_keys: List[str],
     right_keys: List[str],
     li: np.ndarray,
     ri: np.ndarray,
-    how: str = "inner",
-) -> Table:
-    """Verify candidate pairs (drop 64-bit hash collisions via exact key equality,
-    and pairs involving null keys — SQL: null never equals anything, itself
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Verify candidate pairs: drop 64-bit hash collisions via exact key equality,
+    and pairs involving null keys (SQL: null never equals anything, itself
     included; null slots share a fill value, so the equality check alone can't see
-    them), then assemble the output for the join type."""
+    them)."""
     lcols = [left.column(k) for k in left_keys]
     rcols = [right.column(k) for k in right_keys]
     if len(li):
@@ -562,6 +629,20 @@ def _gather_verified(
                 keep &= rc.validity[ri]
         if not keep.all():
             li, ri = li[keep], ri[keep]
+    return li, ri
+
+
+def _gather_verified(
+    left: Table,
+    right: Table,
+    left_keys: List[str],
+    right_keys: List[str],
+    li: np.ndarray,
+    ri: np.ndarray,
+    how: str = "inner",
+) -> Table:
+    """Verify candidate pairs then assemble the output for the join type."""
+    li, ri = _verify_pairs(left, right, left_keys, right_keys, li, ri)
     return _assemble_join(left, right, li, ri, how)
 
 
@@ -743,18 +824,14 @@ def _table_key64(table: Table, keys: List[str]):
     )
 
 
-def _join_tables(
-    left: Table,
-    right: Table,
-    left_keys: List[str],
-    right_keys: List[str],
-    how: str = "inner",
-) -> Table:
-    """Hash-key merge join of two tables with exact verification."""
+def _join_pairs(
+    left: Table, right: Table, left_keys: List[str], right_keys: List[str]
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Hash-key merge join pair indices with exact verification."""
     li, ri = merge_join_pairs(
         _table_key64(left, left_keys), _table_key64(right, right_keys)
     )
-    return _gather_verified(left, right, left_keys, right_keys, li, ri, how)
+    return _verify_pairs(left, right, left_keys, right_keys, li, ri)
 
 
 class SortMergeJoinExec(PhysicalNode):
@@ -786,8 +863,33 @@ class SortMergeJoinExec(PhysicalNode):
         return node if isinstance(node, ShuffleExchangeExec) else None
 
     def execute(self, ctx) -> Table:
+        left, right, li, ri = self._compute_pairs(ctx)
+        return _assemble_join(left, right, li, ri, self.how)
+
+    def execute_count(self, ctx) -> int:
+        """Count the join output WITHOUT assembling it: the verified pair count
+        (+ per-side unmatched counts for outer variants) is the answer — a
+        count-only query skips the whole gather/concat of payload columns."""
+        left, right, li, ri = self._compute_pairs(ctx)
+        how = self.how
+        if how == "inner":
+            return len(li)
+        lm = len(np.unique(li))
+        if how == "left_semi":
+            return lm
+        if how == "left_anti":
+            return left.num_rows - lm
+        n = len(li)
+        if how in ("left", "full"):
+            n += left.num_rows - lm
+        if how in ("right", "full"):
+            n += right.num_rows - len(np.unique(ri))
+        return n
+
+    def _compute_pairs(self, ctx) -> Tuple[Table, Table, np.ndarray, np.ndarray]:
+        """Execute both children and produce the VERIFIED join pair indices."""
         if self.bucketed:
-            return self._execute_bucketed(ctx)
+            return self._bucketed_pairs(ctx)
         lex = self._unwrap_exchange(self.left)
         rex = self._unwrap_exchange(self.right)
         if lex is not None and rex is not None and ctx.session is not None:
@@ -806,11 +908,12 @@ class SortMergeJoinExec(PhysicalNode):
             rt = self.right.execute(ctx)
         pairs = self._copartitioned_pairs(lt, rt)
         if pairs is not None:
-            li, ri = pairs
-            return _gather_verified(
-                lt, rt, self.left_keys, self.right_keys, li, ri, self.how
+            li, ri = _verify_pairs(
+                lt, rt, self.left_keys, self.right_keys, pairs[0], pairs[1]
             )
-        return _join_tables(lt, rt, self.left_keys, self.right_keys, self.how)
+            return lt, rt, li, ri
+        li, ri = _join_pairs(lt, rt, self.left_keys, self.right_keys)
+        return lt, rt, li, ri
 
     def _copartitioned_pairs(self, lt: Table, rt: Table):
         """Distributed general join: when both children came through a real
@@ -831,7 +934,7 @@ class SortMergeJoinExec(PhysicalNode):
         # The exchanged key blocks are still on device — probe them directly.
         return probe_dist_blocks(li.mesh, li.blocks, ri.blocks)
 
-    def _execute_bucketed(self, ctx) -> Table:
+    def _bucketed_pairs(self, ctx) -> Tuple[Table, Table, np.ndarray, np.ndarray]:
         """Batched co-bucketed merge join: equal keys are co-located by construction
         (both sides hash-partitioned with the same function and bucket count), so all
         bucket pairs join independently — executed as ONE device program over padded
@@ -843,10 +946,7 @@ class SortMergeJoinExec(PhysicalNode):
         left, l_starts = self.left.execute_concat(ctx)
         right, r_starts = self.right.execute_concat(ctx)
         if left.num_rows == 0 or right.num_rows == 0:
-            return _gather_verified(
-                left, right, self.left_keys, self.right_keys,
-                np.empty(0, np.int64), np.empty(0, np.int64), self.how,
-            )
+            return left, right, np.empty(0, np.int64), np.empty(0, np.int64)
         pairs = None
         mesh = (
             ctx.session.mesh_for(left.num_rows + right.num_rows)
@@ -878,10 +978,10 @@ class SortMergeJoinExec(PhysicalNode):
                 else:
                     r_rep = _padded_rep(right, r_starts, self.right_keys, force_hash=True)
             pairs = probe_padded(l_rep, r_rep)
-        li, ri = pairs
-        return _gather_verified(
-            left, right, self.left_keys, self.right_keys, li, ri, self.how
+        li, ri = _verify_pairs(
+            left, right, self.left_keys, self.right_keys, pairs[0], pairs[1]
         )
+        return left, right, li, ri
 
     def simple_string(self):
         mode = " (bucketed, no exchange)" if self.bucketed else ""

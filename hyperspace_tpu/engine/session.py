@@ -85,6 +85,13 @@ class DataFrame:
     def limit(self, n: int) -> "DataFrame":
         return DataFrame(self.session, LimitNode(n, self.plan))
 
+    def distinct(self) -> "DataFrame":
+        """Row dedup = GROUP BY every column with no aggregates (rides the same
+        device hash-sort/segment kernel as aggregation)."""
+        return DataFrame(
+            self.session, AggregateNode(self.plan.output_schema.names, [], self.plan)
+        )
+
     # -- actions ------------------------------------------------------------
 
     @property
@@ -102,7 +109,9 @@ class DataFrame:
         return phys.execute(ExecContext(self.session))
 
     def count(self) -> int:
-        return self.collect().num_rows
+        # Counts never assemble output they don't need: scans answer from parquet
+        # footers, joins from verified pair counts (`PhysicalNode.execute_count`).
+        return self.physical_plan().execute_count(ExecContext(self.session))
 
     def to_pydict(self) -> Dict[str, list]:
         return self.collect().to_pydict()
@@ -112,6 +121,30 @@ class DataFrame:
 
     def explain_string(self) -> str:
         return self.physical_plan().tree_string()
+
+    def show(self, n: int = 20, redirect=print) -> None:
+        """Spark-style formatted preview of the first `n` rows."""
+        t = self.limit(n + 1).collect()
+        truncated = t.num_rows > n
+        names = t.column_names
+        cols = {c: t.column(c).decode_objects()[:n] for c in names}
+        cells = [
+            [("null" if v is None else str(v)) for v in cols[c]] for c in names
+        ]
+        widths = [
+            max(len(name), *(len(x) for x in col), 0) if col else len(name)
+            for name, col in zip(names, cells)
+        ]
+        sep = "+" + "+".join("-" * (w + 2) for w in widths) + "+"
+        def fmt(vals):
+            return "|" + "|".join(f" {v:>{w}} " for v, w in zip(vals, widths)) + "|"
+        lines = [sep, fmt(names), sep]
+        for i in range(min(n, t.num_rows)):
+            lines.append(fmt([cells[j][i] for j in range(len(names))]))
+        lines.append(sep)
+        if truncated:
+            lines.append(f"only showing top {n} row{'s' if n != 1 else ''}")
+        redirect("\n".join(lines))
 
 
 class GroupedDataFrame:

@@ -170,12 +170,7 @@ def distributed_exchange_table(
     buckets_local = num_partitions // n_dev
     lens = np.diff(starts)
     cap = _pow2(int(lens.max())) if lens.size and lens.max(initial=0) else 1
-    bounds = starts[0::buckets_local][: n_dev + 1]
-    lstarts = np.zeros((n_dev, buckets_local + 1), dtype=np.int64)
-    for d in range(n_dev):
-        lstarts[d] = (
-            starts[d * buckets_local : (d + 1) * buckets_local + 1] - bounds[d]
-        )
+    _, lstarts = _local_starts(starts, n_dev, buckets_local)
     blocks = DistBlocks(
         masked,
         jax.device_put(jnp.asarray(lstarts), NamedSharding(mesh, P(BUCKET_AXIS))),
@@ -235,20 +230,33 @@ def _probe_program(mesh: Mesh, buckets_local: int, cap_l: int, cap_r: int):
     return jax.jit(mapped)
 
 
+def _local_starts(
+    starts_np: np.ndarray, n_dev: int, buckets_local: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """(device bounds [n_dev+1], per-device local bucket offsets [n_dev, B_local+1])
+    — the single source of the 'device d owns its contiguous bucket range' layout
+    contract shared by the host block builder and the exchange output."""
+    bounds = starts_np[0 :: buckets_local][: n_dev + 1]
+    local = np.zeros((n_dev, buckets_local + 1), dtype=np.int64)
+    for d in range(n_dev):
+        local[d] = (
+            starts_np[d * buckets_local : (d + 1) * buckets_local + 1] - bounds[d]
+        )
+    return bounds, local
+
+
 def _block_layout(
     keys_np: np.ndarray, starts_np: np.ndarray, n_dev: int, buckets_local: int
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Lay out per-row keys (bucket order) as [n_dev, max_block] device blocks plus
     per-device local bucket offsets [n_dev, B_local+1]; device d's block is its
     contiguous bucket range — host→device transfer is one sharded device_put."""
-    bounds = starts_np[0 :: buckets_local][: n_dev + 1]
+    bounds, local_starts = _local_starts(starts_np, n_dev, buckets_local)
     max_block = _pow2(int(np.diff(bounds).max()) if n_dev else 1)
     blocks = np.full((n_dev, max_block), _PAD, dtype=np.int64)
-    local_starts = np.zeros((n_dev, buckets_local + 1), dtype=np.int64)
     for d in range(n_dev):
         lo, hi = int(bounds[d]), int(bounds[d + 1])
         blocks[d, : hi - lo] = keys_np[lo:hi]
-        local_starts[d] = starts_np[d * buckets_local : (d + 1) * buckets_local + 1] - lo
     return blocks, local_starts
 
 

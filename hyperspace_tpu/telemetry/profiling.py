@@ -1,0 +1,66 @@
+"""XLA profiler hooks (SURVEY §5: the reference's only tracing surface is the
+explain subsystem; the TPU-native framework additionally exposes the device-level
+profiler so "where did the time go" is answerable below the plan level).
+
+`trace(log_dir)` wraps a scope in `jax.profiler` start/stop — the output is an
+xprof/TensorBoard trace directory with per-kernel device timelines. `annotate`
+names a region so engine phases (probe, exchange, build) are findable in the
+trace. Both degrade to no-ops when profiling is unavailable (e.g. a backend
+without profiler support), so they are safe to leave in production paths.
+
+The bench consumes this via `BENCH_PROFILE_DIR=/path python bench.py`, which
+traces the device section; `block_until_ready` wall deltas in the bench JSON
+remain the machine-readable summary (device_time_s / utilization)."""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+
+@contextlib.contextmanager
+def trace(log_dir: Optional[str], enabled: bool = True) -> Iterator[None]:
+    """Profile a scope into `log_dir` (xprof format); no-op when disabled/unset."""
+    if not enabled or not log_dir:
+        yield
+        return
+    import jax
+
+    try:
+        jax.profiler.start_trace(log_dir)
+    except Exception:
+        yield  # profiler unavailable on this backend — scope still runs
+        return
+    try:
+        yield
+    finally:
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+
+
+@contextlib.contextmanager
+def annotate(name: str, enabled: bool = True) -> Iterator[None]:
+    """Name a region in the device trace (`jax.profiler.TraceAnnotation`).
+
+    The try covers only annotation SETUP — the body's own exceptions must
+    propagate unmasked (a second yield in an except handler would swallow them
+    into contextlib's 'generator didn't stop' RuntimeError)."""
+    ann = None
+    if enabled:
+        try:
+            import jax
+
+            ann = jax.profiler.TraceAnnotation(name)
+            ann.__enter__()
+        except Exception:
+            ann = None
+    try:
+        yield
+    finally:
+        if ann is not None:
+            try:
+                ann.__exit__(None, None, None)
+            except Exception:
+                pass

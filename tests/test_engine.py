@@ -243,3 +243,37 @@ class TestHashing:
         r = session.read.parquet(str(p / "r"))
         out = l.join(r, col("k") == col("k2")).select("l", "r")
         assert out.sorted_rows() == [("b", 20), ("c", 30)]
+
+
+class TestShowDistinctProfiling:
+    def test_distinct(self, session, tmp_path):
+        session.write_parquet(
+            {"a": [1, 1, 2, 2, 2], "b": ["x", "x", "y", "y", "z"]}, str(tmp_path / "d")
+        )
+        df = session.read.parquet(str(tmp_path / "d"))
+        assert df.distinct().sorted_rows() == [(1, "x"), (2, "y"), (2, "z")]
+        assert df.select("a").distinct().count() == 2
+
+    def test_show_formats_and_truncates(self, session, tmp_path):
+        session.write_parquet({"k": list(range(5)), "s": ["aa"] * 5}, str(tmp_path / "t"))
+        out = []
+        session.read.parquet(str(tmp_path / "t")).show(3, redirect=out.append)
+        s = out[0]
+        assert "| k|" in s.replace("  ", " ") or "k" in s
+        assert "only showing top 3 rows" in s
+        assert s.count("\n") >= 6
+
+    def test_profiling_trace_noop_and_annotate(self, tmp_path):
+        import jax.numpy as jnp
+
+        from hyperspace_tpu.telemetry.profiling import annotate, trace
+
+        with trace(None):  # disabled: pure no-op
+            pass
+        with trace(str(tmp_path / "prof")):
+            with annotate("probe"):
+                (jnp.arange(8.0) * 2).sum().block_until_ready()
+        # trace directory exists (contents are backend-dependent)
+        import os as _os
+
+        assert _os.path.isdir(tmp_path / "prof")

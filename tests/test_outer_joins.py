@@ -115,3 +115,18 @@ def test_outer_join_runs_with_hyperspace_enabled(jsession):
     # The inner join over the same data still uses both indexes.
     qi = l.join(r, col("k") == col("k2"), how="inner").select("lv", "rv")
     assert "bucketed, no exchange" in qi.explain_string()
+
+
+def test_count_fast_path_matches_materialized_counts(jsession):
+    """`count()` (footer/pair-count fast path) must equal collect().num_rows for
+    every join type, null keys included."""
+    s, base = jsession
+    l = lambda: s.read.parquet(os.path.join(base, "l"))
+    r = lambda: s.read.parquet(os.path.join(base, "r"))
+    for how in ("inner", "left", "right", "full", "semi", "anti"):
+        df = l().join(r(), col("k") == col("k2"), how=how)
+        assert df.count() == df.collect().num_rows, how
+    # plain scans + limit + orderby + union-ish shapes
+    assert l().count() == l().collect().num_rows
+    assert l().limit(2).count() == 2
+    assert l().order_by("k").count() == l().count()
