@@ -8,6 +8,13 @@ per-bucket sort in the bucketed writer (`CreateActionBase.scala:119-140`,
 are grouped by bucket AND sorted by the indexed columns within each bucket, so bucket
 extraction is a contiguous slice. Static shapes throughout; one device sort is the
 whole job.
+
+Backend-adaptive: on the CPU backend the permutation comes from a host
+`np.lexsort` instead (XLA's CPU variadic sort is single-threaded and ~3x slower
+at build sizes); the device `lax.sort` path is the TPU design. Both produce the
+identical (bucket, keys...) ordering contract —
+`tests/test_engine.py::test_device_sort_perm_matches_lexsort` pins them to each
+other.
 """
 
 from __future__ import annotations
@@ -47,8 +54,23 @@ def bucketize_table(
     cols = [table.column(c) for c in bucket_columns]
     arrs = [jnp.asarray(c.data) for c in cols]
     b = bucket_id(cols, arrs, num_buckets)
-    perm, sorted_b = _sort_perm(b, tuple(_sortable(a) for a in arrs), table.num_rows)
-    perm_host = np.asarray(perm)
-    sorted_b_host = np.asarray(sorted_b)
+    if jax.default_backend() == "cpu":
+        # Backend-adaptive: XLA's CPU variadic sort is single-threaded and ~3x
+        # slower than numpy's lexsort at index-build sizes; the one-device-sort
+        # design is for the TPU, where lax.sort is the right primitive. The
+        # output contract (permutation by (bucket, keys...)) is identical.
+        b_host = np.asarray(b)
+        lanes = tuple(
+            c.data.astype(np.int32) if c.data.dtype == np.bool_ else c.data
+            for c in reversed(cols)
+        ) + (b_host,)
+        perm_host = np.lexsort(lanes)
+        sorted_b_host = b_host[perm_host]
+    else:
+        perm, sorted_b = _sort_perm(
+            b, tuple(_sortable(a) for a in arrs), table.num_rows
+        )
+        perm_host = np.asarray(perm)
+        sorted_b_host = np.asarray(sorted_b)
     starts = np.searchsorted(sorted_b_host, np.arange(num_buckets + 1))
     return table.take(perm_host), starts

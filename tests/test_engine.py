@@ -277,3 +277,30 @@ class TestShowDistinctProfiling:
         import os as _os
 
         assert _os.path.isdir(tmp_path / "prof")
+
+
+def test_device_sort_perm_matches_lexsort():
+    """The device `_sort_perm` (TPU path) and the host lexsort (CPU path) must
+    produce the same (bucket, keys...) ordering contract — the CPU suite would
+    otherwise never execute the device branch."""
+    import jax.numpy as jnp
+
+    from hyperspace_tpu.ops.partition import _sort_perm
+
+    rng = np.random.RandomState(3)
+    n = 5000
+    key = rng.randint(0, 400, n).astype(np.int64)
+    key2 = rng.randint(0, 7, n).astype(np.int64)
+    bucket = (key % 16).astype(np.int32)
+
+    perm_dev, sorted_b_dev = _sort_perm(
+        jnp.asarray(bucket), (jnp.asarray(key), jnp.asarray(key2)), n
+    )
+    perm_dev = np.asarray(perm_dev)
+    perm_host = np.lexsort((key2, key, bucket))
+
+    # Permutations may differ on exact ties; the ORDERED TUPLES must be equal.
+    dev_rows = list(zip(bucket[perm_dev], key[perm_dev], key2[perm_dev]))
+    host_rows = list(zip(bucket[perm_host], key[perm_host], key2[perm_host]))
+    assert dev_rows == host_rows
+    assert np.array_equal(np.asarray(sorted_b_dev), bucket[perm_host])
