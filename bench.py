@@ -224,15 +224,15 @@ def _device_section(s, base, col, runs, backend) -> dict:
             l_rep = _padded_rep(left, l_starts, join_exec.left_keys, force_hash=True)
         else:
             r_rep = _padded_rep(right, r_starts, join_exec.right_keys, force_hash=True)
-    lk, rk = l_rep.keys, r_rep.keys
-    if lk.dtype != rk.dtype:  # probe_padded's own promotion step
-        import jax.numpy as jnp
+    # Same orientation + promotion as probe_padded — one shared heuristic, so
+    # the timed kernel cannot drift from what production dispatches.
+    from hyperspace_tpu.ops.bucket_join import probe_keys_promoted, probe_orientation
 
-        common = jnp.promote_types(lk.dtype, rk.dtype)
-        lk, rk = lk.astype(common), rk.astype(common)
+    a, b, _ = probe_orientation(l_rep, r_rep)
+    lk, rk = probe_keys_promoted(a.keys, b.keys)
 
     def one():
-        jax.block_until_ready(_probe(lk, rk, l_rep.lengths, r_rep.lengths))
+        jax.block_until_ready(_probe(lk, rk, a.lengths, b.lengths))
 
     one()  # compile
     from hyperspace_tpu.telemetry.profiling import annotate, trace

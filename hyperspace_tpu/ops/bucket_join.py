@@ -172,6 +172,26 @@ def pad_buckets_by_hash(key64_arr, starts_np: np.ndarray) -> PaddedBuckets:
     return PaddedBuckets(keys, lengths, np.asarray(order), starts_np, "hash")
 
 
+def probe_orientation(left, right):
+    """Canonical probe orientation — the SMALLER-capacity side probes into the
+    larger (search count scales linearly with the probing side's capacity, only
+    logarithmically with the other's). Single source of the heuristic, shared by
+    `probe_padded`, the sharded `probe_dist_blocks`, and the bench's kernel
+    isolation. Returns (probe_side, build_side, swapped)."""
+    if left.keys.shape[1] > right.keys.shape[1]:
+        return right, left, True
+    return left, right, False
+
+
+def probe_keys_promoted(a_keys, b_keys):
+    """Key matrices promoted to a common dtype (value-direct sides may be int32/
+    float while hash sides are int64)."""
+    if a_keys.dtype != b_keys.dtype:
+        common = jnp.promote_types(a_keys.dtype, b_keys.dtype)
+        return a_keys.astype(common), b_keys.astype(common)
+    return a_keys, b_keys
+
+
 def probe_padded(left: PaddedBuckets, right: PaddedBuckets):
     """Batched range probe of two padded sides → host (left_row, right_row) pairs.
 
@@ -181,16 +201,15 @@ def probe_padded(left: PaddedBuckets, right: PaddedBuckets):
     `SortMergeJoinExec._execute_bucketed`)."""
     if left.mode != right.mode:
         raise ValueError(f"mixed padded modes: {left.mode} vs {right.mode}")
-    lk, rk = left.keys, right.keys
-    if lk.dtype != rk.dtype:
-        common = jnp.promote_types(lk.dtype, rk.dtype)
-        lk, rk = lk.astype(common), rk.astype(common)
-    lo, counts = _probe(lk, rk, left.lengths, right.lengths)
+    a, b, swapped = probe_orientation(left, right)
+    ak, bk = probe_keys_promoted(a.keys, b.keys)
+    lo, counts = _probe(ak, bk, a.lengths, b.lengths)
     counts_np = np.asarray(counts)
     if counts_np.sum() == 0:
         return np.empty(0, np.int64), np.empty(0, np.int64)
-    return _expand_np(
-        np.asarray(lo), counts_np, left.starts, right.starts, left.order, right.order
+    ai, bi = _expand_np(
+        np.asarray(lo), counts_np, a.starts, b.starts, a.order, b.order
     )
+    return (bi, ai) if swapped else (ai, bi)
 
 

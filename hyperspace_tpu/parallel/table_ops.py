@@ -332,9 +332,18 @@ def probe_dist_blocks(
 ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
     """Sharded zero-collective probe of two pre-built block layouts → global
     (left_row, right_row) pairs. The per-query device→host traffic is the probe
-    OUTPUT (lo/counts/orders — bounded by bucket capacity), never the keys."""
+    OUTPUT (lo/counts/orders — bounded by bucket capacity), never the keys.
+
+    Probes the SMALLER side into the larger (search count scales with the
+    probing side's capacity), swapping the output pair order back."""
     if left.buckets_local != right.buckets_local:
         return None
+    if left.cap > right.cap:
+        out = probe_dist_blocks(mesh, right, left)
+        if out is None:
+            return None
+        ri, li = out
+        return li, ri
     DIST_JOIN_STATS["probes"] += 1
     lo, counts, l_order, r_order = _probe_program(
         mesh, left.buckets_local, left.cap, right.cap
