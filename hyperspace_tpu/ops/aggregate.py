@@ -33,6 +33,7 @@ from ..engine.schema import BOOL, FLOAT32, FLOAT64, INT32, INT64, STRING
 from ..engine.table import Column, Table
 from ..exceptions import HyperspaceException
 from .hashing import key64
+from .join import stable_argsort
 
 #: (out_name, fn, column|None) — column is None only for count(*).
 AggTriple = Tuple[str, str, Optional[str]]
@@ -243,7 +244,7 @@ def hash_aggregate(table: Table, group_keys, aggs: Sequence[AggTriple]) -> Table
     n = table.num_rows
     arrs = [jnp.asarray(c.data) for c in key_cols]
     k64 = key64(key_cols, arrs)
-    perm = jnp.argsort(k64, stable=True)
+    perm = stable_argsort(k64)
 
     # Group boundaries from ADJACENT ACTUAL VALUES (+ validity), never the hash.
     eq = jnp.ones(n - 1, bool) if n > 1 else jnp.zeros(0, bool)
