@@ -73,6 +73,8 @@ def run_bench() -> dict:
             {
                 "orderkey": rng.randint(0, n_orders, n_lineitem).astype(np.int64),
                 "qty": rng.randint(1, 51, n_lineitem).astype(np.int64),
+                "price": (rng.rand(n_lineitem) * 1000).astype(np.float64),
+                "discount": (rng.randint(0, 11, n_lineitem) / 100.0).astype(np.float64),
             },
             os.path.join(base, "lineitem"),
         )
@@ -90,13 +92,17 @@ def run_bench() -> dict:
             return l.join(o, col("orderkey") == col("o_orderkey")).select("qty", "o_custkey")
 
         def agg_query():
-            # TPC-H Q3-like: grouped aggregation over the indexed join.
+            # TPC-H Q3 shape: SUM(price * (1 - discount)) revenue grouped over
+            # the indexed join.
             l = s.read.parquet(os.path.join(base, "lineitem"))
             o = s.read.parquet(os.path.join(base, "orders"))
             return (
                 l.join(o, col("orderkey") == col("o_orderkey"))
+                .with_column("revenue", col("price") * (1 - col("discount")))
                 .group_by("o_custkey")
-                .agg(sum_qty=("qty", "sum"), n=("qty", "count"))
+                .agg(revenue=("revenue", "sum"), n=("qty", "count"))
+                .order_by(("revenue", False))
+                .limit(10)
             )
 
         def timed_p50(fn, n):
@@ -119,7 +125,7 @@ def run_bench() -> dict:
         t0 = _now()
         hs.create_index(
             s.read.parquet(os.path.join(base, "lineitem")),
-            IndexConfig("liIdx", ["orderkey"], ["qty"]),
+            IndexConfig("liIdx", ["orderkey"], ["qty", "price", "discount"]),
         )
         hs.create_index(
             s.read.parquet(os.path.join(base, "orders")),

@@ -246,6 +246,42 @@ def _compare(op: str, a, b):
     raise HyperspaceException(op)
 
 
+def evaluate_column(expr: Expr, table: Table) -> Column:
+    """Evaluate an arbitrary expression to a materialized Column (the withColumn
+    executor). Invalid slots are re-filled with the canonical zero so downstream
+    hashing/grouping over computed columns keeps the nulls-cluster invariant."""
+    n = table.num_rows
+    v = evaluate(expr, table, {})
+    if v.kind == "str":
+        codes = np.asarray(v.arr, dtype=np.int32)
+        valid = None if v.valid is None else np.asarray(v.valid, dtype=bool)
+        if valid is not None:
+            codes = np.where(valid, codes, 0).astype(np.int32)
+        return Column("string", codes, np.asarray(v.dictionary), valid)
+    if v.kind == "lit":
+        if v.value is None:
+            return Column("int64", np.zeros(n, np.int64), None, np.zeros(n, bool))
+        if isinstance(v.value, str):
+            return Column(
+                "string", np.zeros(n, np.int32), np.asarray([v.value]), None
+            )
+        arr = np.full(n, v.value)
+        if arr.dtype == np.bool_:
+            pass
+        elif np.issubdtype(arr.dtype, np.integer):
+            arr = arr.astype(np.int64)
+        else:
+            arr = arr.astype(np.float64)
+        return Column.from_values(arr)
+    arr = np.asarray(v.arr)
+    valid = None if v.valid is None else np.asarray(v.valid, dtype=bool)
+    if valid is not None and not valid.all():
+        arr = np.where(valid, arr, np.zeros((), dtype=arr.dtype))
+    from .schema import dtype_from_numpy
+
+    return Column(dtype_from_numpy(arr.dtype), arr, None, valid)
+
+
 def evaluate_predicate(expr: Expr, table: Table) -> jnp.ndarray:
     """Evaluate a boolean expression over a table → device mask. A row survives
     only when the predicate is TRUE and KNOWN (SQL WHERE drops unknowns)."""

@@ -57,11 +57,26 @@ class Expr:
     def __add__(self, other):
         return BinaryOp("+", self, _lit(other))
 
+    def __radd__(self, other):
+        return BinaryOp("+", _lit(other), self)
+
     def __sub__(self, other):
         return BinaryOp("-", self, _lit(other))
 
+    def __rsub__(self, other):
+        return BinaryOp("-", _lit(other), self)
+
     def __mul__(self, other):
         return BinaryOp("*", self, _lit(other))
+
+    def __rmul__(self, other):
+        return BinaryOp("*", _lit(other), self)
+
+    def __truediv__(self, other):
+        return BinaryOp("/", self, _lit(other))
+
+    def __rtruediv__(self, other):
+        return BinaryOp("/", _lit(other), self)
 
     def is_null(self) -> "IsNull":
         return IsNull(self, negated=False)

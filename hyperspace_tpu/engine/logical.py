@@ -253,6 +253,84 @@ class JoinNode(LogicalPlan):
         return f"Join {self.how} on {self.condition!r}"
 
 
+def infer_expr_dtype(e: Expr, schema: Schema) -> str:
+    """Static result type of an expression against a schema (comparisons/boolean/
+    null-tests → bool; '/' → float64; +,-,* promote numerically; bare columns and
+    literals keep their own types)."""
+    from ..exceptions import HyperspaceException
+    from .expr import BinaryOp, Col, IsIn, IsNull, Lit, Not
+
+    if isinstance(e, Col):
+        return schema.field(e.name).dtype
+    if isinstance(e, Lit):
+        v = e.value
+        if isinstance(v, bool):
+            return "bool"
+        if isinstance(v, int):
+            return "int64"
+        if isinstance(v, float):
+            return "float64"
+        if isinstance(v, str):
+            return "string"
+        raise HyperspaceException(f"Cannot type literal: {v!r}")
+    if isinstance(e, (Not, IsNull, IsIn)):
+        return "bool"
+    if isinstance(e, BinaryOp):
+        if e.op in BinaryOp.COMPARISONS or e.op in BinaryOp.BOOLEAN:
+            return "bool"
+        lt = infer_expr_dtype(e.left, schema)
+        rt = infer_expr_dtype(e.right, schema)
+        if "string" in (lt, rt) or "bool" in (lt, rt):
+            raise HyperspaceException(f"Arithmetic on {lt}/{rt}: {e!r}")
+        if e.op == "/":
+            # True division: floating result; float32-only operands stay float32.
+            return "float32" if lt == rt == "float32" else "float64"
+        import numpy as _np
+
+        return str(_np.result_type(_np.dtype(lt), _np.dtype(rt)))
+    raise HyperspaceException(f"Cannot type expression: {e!r}")
+
+
+class WithColumnNode(LogicalPlan):
+    """Computed column: `name` = `expr` evaluated per row (the Spark `withColumn`
+    analogue — what lets aggregation run over derived measures like TPC-H's
+    `price * (1 - discount)`). Replaces an existing column of the same name in
+    place, else appends."""
+
+    def __init__(self, name: str, expr: Expr, child: LogicalPlan):
+        self.name = name
+        self.expr = expr
+        self.child = child
+        dtype = infer_expr_dtype(expr, child.output_schema)
+        fields = []
+        replaced = False
+        for f in child.output_schema.fields:
+            if f.name.lower() == name.lower():
+                fields.append(Field(f.name, dtype))
+                replaced = True
+            else:
+                fields.append(f)
+        if not replaced:
+            fields.append(Field(name, dtype))
+        self._schema = Schema(fields)
+
+    def children(self):
+        return (self.child,)
+
+    @property
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def with_children(self, children):
+        return WithColumnNode(self.name, self.expr, children[0])
+
+    def references(self) -> List[str]:
+        return sorted(self.expr.references())
+
+    def simple_string(self):
+        return f"WithColumn {self.name} = {self.expr!r}"
+
+
 class AggregateNode(LogicalPlan):
     """GROUP BY + aggregates (sum/count/min/max/avg). The reference gets this from
     Spark SQL for free (`docs/_docs/13-toh-overview.md:33-36` — index scans

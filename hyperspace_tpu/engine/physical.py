@@ -43,6 +43,7 @@ from .logical import (
     ScanNode,
     SourceRelation,
     UnionNode,
+    WithColumnNode,
 )
 from .schema import Schema
 from .table import Column, Table, align_dictionaries
@@ -446,6 +447,53 @@ class SortExec(PhysicalNode):
 
     def simple_string(self):
         return f"Sort [{', '.join(self.keys)}]"
+
+
+class WithColumnExec(PhysicalNode):
+    name = "WithColumn"
+
+    def __init__(self, col_name: str, expr: Expr, child: PhysicalNode, dtype: Optional[str] = None):
+        self.col_name = col_name
+        self.expr = expr
+        self.child = child
+        self.dtype = dtype  # declared schema dtype; execution conforms to it
+
+    def children(self):
+        return (self.child,)
+
+    def execute(self, ctx) -> Table:
+        from .evaluate import evaluate_column
+
+        t = self.child.execute(ctx)
+        new_col = evaluate_column(self.expr, t)
+        if (
+            self.dtype is not None
+            and self.dtype != "string"
+            and not new_col.is_string
+            and new_col.data.dtype != np.dtype(self.dtype)
+        ):
+            # Backend promotion quirks (e.g. jax int32/int32 division) must not
+            # leak into the schema contract: cast to the DECLARED dtype.
+            new_col = Column(
+                self.dtype, new_col.data.astype(np.dtype(self.dtype)), None, new_col.validity
+            )
+        out: Dict[str, Column] = {}
+        replaced = False
+        for n, c in t.columns.items():
+            if n.lower() == self.col_name.lower():
+                out[n] = new_col
+                replaced = True
+            else:
+                out[n] = c
+        if not replaced:
+            out[self.col_name] = new_col
+        return Table(out)
+
+    def execute_count(self, ctx) -> int:
+        return self.child.execute_count(ctx)  # adds a column, never rows
+
+    def simple_string(self):
+        return f"WithColumn {self.col_name} = {self.expr!r}"
 
 
 class HashAggregateExec(PhysicalNode):
@@ -1044,6 +1092,26 @@ def plan_physical(logical: LogicalPlan, required: Optional[List[str]] = None) ->
 
     if isinstance(logical, UnionNode):
         return UnionExec([plan_physical(c, required) for c in logical.children()])
+
+    if isinstance(logical, WithColumnNode):
+        if required is not None and all(
+            r.lower() != logical.name.lower() for r in required
+        ):
+            # The computed column is pruned out downstream: skip the evaluation
+            # entirely (it cannot change row count or other columns).
+            return plan_physical(logical.child, required)
+        child_required = None
+        if required is not None:
+            keep = [r for r in required if r.lower() != logical.name.lower()]
+            child_required = list(
+                dict.fromkeys(keep + sorted(logical.expr.references()))
+            )
+        return WithColumnExec(
+            logical.name,
+            logical.expr,
+            plan_physical(logical.child, child_required),
+            dtype=logical.output_schema.field(logical.name).dtype,
+        )
 
     if isinstance(logical, AggregateNode):
         # The aggregate consumes only its group keys + agg inputs; push that set

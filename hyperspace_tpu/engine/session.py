@@ -28,6 +28,7 @@ from .logical import (
     ProjectNode,
     ScanNode,
     SourceRelation,
+    WithColumnNode,
 )
 from .physical import ExecContext, PhysicalNode, plan_physical
 from .schema import Schema
@@ -55,6 +56,13 @@ class DataFrame:
 
     def join(self, other: "DataFrame", on: Expr, how: str = "inner") -> "DataFrame":
         return DataFrame(self.session, JoinNode(self.plan, other.plan, on, how))
+
+    def with_column(self, name: str, expr: Expr) -> "DataFrame":
+        """Computed column (Spark `withColumn`): replaces a same-named column in
+        place, else appends. `df.with_column("revenue", col("price") * (1 - col("discount")))`."""
+        return DataFrame(self.session, WithColumnNode(name, expr, self.plan))
+
+    withColumn = with_column
 
     def group_by(self, *keys: str) -> "GroupedDataFrame":
         names = list(keys[0]) if len(keys) == 1 and isinstance(keys[0], (list, tuple)) else list(keys)

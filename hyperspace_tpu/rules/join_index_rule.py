@@ -34,7 +34,13 @@ def _lower(names) -> List[str]:
 
 def _collect_expr_refs(plan: LogicalPlan) -> List[str]:
     refs: List[str] = []
-    from ..engine.logical import AggregateNode, FilterNode, OrderByNode, ProjectNode
+    from ..engine.logical import (
+        AggregateNode,
+        FilterNode,
+        OrderByNode,
+        ProjectNode,
+        WithColumnNode,
+    )
 
     for node in plan.collect_nodes():
         if isinstance(node, FilterNode):
@@ -43,7 +49,7 @@ def _collect_expr_refs(plan: LogicalPlan) -> List[str]:
             refs.extend(node.column_names)
         elif isinstance(node, JoinNode):
             refs.extend(node.condition.references())
-        elif isinstance(node, (AggregateNode, OrderByNode)):
+        elif isinstance(node, (AggregateNode, OrderByNode, WithColumnNode)):
             refs.extend(node.references())
     return refs
 

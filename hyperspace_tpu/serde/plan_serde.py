@@ -25,6 +25,7 @@ from ..engine.logical import (
     ProjectNode,
     ScanNode,
     SourceRelation,
+    WithColumnNode,
 )
 from ..engine.partitioning import PartitionSpec
 from ..engine.schema import Schema
@@ -165,6 +166,13 @@ def plan_to_dict(plan: LogicalPlan) -> Dict[str, Any]:
         }
     if isinstance(plan, LimitNode):
         return {"t": "limit", "n": plan.n, "child": plan_to_dict(plan.child)}
+    if isinstance(plan, WithColumnNode):
+        return {
+            "t": "withcolumn",
+            "name": plan.name,
+            "expr": expr_to_dict(plan.expr),
+            "child": plan_to_dict(plan.child),
+        }
     raise HyperspaceException(f"Cannot serialize plan node: {plan.simple_string()}")
 
 
@@ -193,6 +201,10 @@ def plan_from_dict(d: Dict[str, Any]) -> LogicalPlan:
         return OrderByNode([(k, asc) for k, asc in d["keys"]], plan_from_dict(d["child"]))
     if t == "limit":
         return LimitNode(d["n"], plan_from_dict(d["child"]))
+    if t == "withcolumn":
+        return WithColumnNode(
+            d["name"], expr_from_dict(d["expr"]), plan_from_dict(d["child"])
+        )
     raise HyperspaceException(f"Cannot deserialize plan tag: {t}")
 
 

@@ -1,0 +1,214 @@
+"""Computed columns (`with_column`): arithmetic expressions as first-class
+columns, including the TPC-H revenue shape `price * (1 - discount)` aggregated
+over an indexed join — the real workload BASELINE config-2 describes.
+"""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import IndexConfig, IndexConstants
+from hyperspace_tpu.engine import HyperspaceSession, col, lit
+from hyperspace_tpu.hyperspace import Hyperspace, disable_hyperspace, enable_hyperspace
+
+
+@pytest.fixture()
+def wc_session(tmp_path):
+    s = HyperspaceSession(warehouse=str(tmp_path))
+    s.conf.set(IndexConstants.INDEX_SYSTEM_PATH, str(tmp_path / "indexes"))
+    s.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
+    os.makedirs(tmp_path / "li")
+    pq.write_table(
+        pa.table(
+            {
+                "okey": pa.array([1, 1, 2, 3, 3], type=pa.int64()),
+                "price": pa.array([10.0, 20.0, 30.0, 40.0, None]),
+                "discount": pa.array([0.1, 0.0, 0.5, None, 0.2]),
+            }
+        ),
+        str(tmp_path / "li" / "part-00000.parquet"),
+    )
+    return s, str(tmp_path)
+
+
+def test_arithmetic_column(wc_session):
+    s, base = wc_session
+    df = (
+        s.read.parquet(os.path.join(base, "li"))
+        .with_column("revenue", col("price") * (1 - col("discount")))
+        .select("okey", "revenue")
+    )
+    assert df.schema.field("revenue").dtype == "float64"
+    rows = df.collect().rows()
+    # rows with a null operand yield null revenue
+    assert sorted(rows, key=lambda r: (r[0], r[1] is None, r[1])) == [
+        (1, 9.0),
+        (1, 20.0),
+        (2, 15.0),
+        (3, None),
+        (3, None),
+    ]
+
+
+def test_replace_existing_column_in_place(wc_session):
+    s, base = wc_session
+    df = s.read.parquet(os.path.join(base, "li")).with_column(
+        "price", col("price") * 2
+    )
+    assert df.schema.names == ["okey", "price", "discount"]
+    got = {r[0:1] + (r[1],) for r in df.select("okey", "price").sorted_rows()}
+    assert (1, 20.0) in got and (2, 60.0) in got
+
+
+def test_division_and_rsub(wc_session):
+    s, base = wc_session
+    df = (
+        s.read.parquet(os.path.join(base, "li"))
+        .with_column("half", col("okey") / 2)
+        .with_column("neg", 10 - col("okey"))
+        .select("half", "neg")
+    )
+    assert df.schema.field("half").dtype == "float64"
+    assert df.schema.field("neg").dtype == "int64"
+    rows = df.sorted_rows()
+    assert rows[0] == (0.5, 9) and rows[-1] == (1.5, 7)
+
+
+def test_boolean_computed_column_and_filter(wc_session):
+    s, base = wc_session
+    df = (
+        s.read.parquet(os.path.join(base, "li"))
+        .with_column("cheap", col("price") < 25)
+        .filter(col("cheap") == True)  # noqa: E712
+        .select("okey")
+    )
+    assert df.sorted_rows() == [(1,), (1,)]
+
+
+def test_groupby_over_computed_column(wc_session):
+    s, base = wc_session
+    rows = (
+        s.read.parquet(os.path.join(base, "li"))
+        .with_column("revenue", col("price") * (1 - col("discount")))
+        .group_by("okey")
+        .agg(rev=("revenue", "sum"), n=("revenue", "count"))
+        .sorted_rows()
+    )
+    assert rows == [(1, 29.0, 2), (2, 15.0, 1), (3, None, 0)]
+
+
+def test_revenue_over_indexed_join_oracle(wc_session, tmp_path):
+    """TPC-H Q3 shape: revenue aggregation over the indexed join, on/off oracle."""
+    s, base = wc_session
+    s.write_parquet(
+        {
+            "o_key": np.array([1, 2, 3], dtype=np.int64),
+            "cust": np.array([100, 100, 200], dtype=np.int64),
+        },
+        str(tmp_path / "ord"),
+    )
+    hs = Hyperspace(s)
+    hs.create_index(
+        s.read.parquet(os.path.join(base, "li")),
+        IndexConfig("liIdx", ["okey"], ["price", "discount"]),
+    )
+    hs.create_index(
+        s.read.parquet(str(tmp_path / "ord")), IndexConfig("oIdx", ["o_key"], ["cust"])
+    )
+
+    def q():
+        li = s.read.parquet(os.path.join(base, "li"))
+        o = s.read.parquet(str(tmp_path / "ord"))
+        return (
+            li.join(o, col("okey") == col("o_key"))
+            .with_column("revenue", col("price") * (1 - col("discount")))
+            .group_by("cust")
+            .agg(rev=("revenue", "sum"))
+            .order_by(("rev", False))
+        )
+
+    disable_hyperspace(s)
+    expected = q().collect().rows()
+    enable_hyperspace(s)
+    plan = q().explain_string()
+    assert "bucketed, no exchange" in plan and "WithColumn" in plan
+    got = q().collect().rows()
+    assert got == expected and len(got) == 2
+
+
+def test_serde_roundtrip_with_column(wc_session):
+    s, base = wc_session
+    from hyperspace_tpu.serde import deserialize_plan, serialize_plan
+
+    df = (
+        s.read.parquet(os.path.join(base, "li"))
+        .with_column("r", col("price") * (lit(1.0) - col("discount")))
+    )
+    restored = deserialize_plan(serialize_plan(df.plan))
+    assert restored.tree_string() == df.plan.tree_string()
+
+
+def test_string_arithmetic_rejected(wc_session, tmp_path):
+    s, _ = wc_session
+    s.write_parquet({"a": ["x", "y"]}, str(tmp_path / "str_t"))
+    from hyperspace_tpu import HyperspaceException
+
+    with pytest.raises(HyperspaceException, match="Arithmetic"):
+        s.read.parquet(str(tmp_path / "str_t")).with_column("b", col("a") * 2)
+
+
+def test_declared_dtype_matches_execution_f32_i32(wc_session, tmp_path):
+    """Schema contract: the executed column's dtype equals the declared one,
+    including 32-bit inputs where backend promotion rules differ."""
+    s, _ = wc_session
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    os.makedirs(tmp_path / "narrow")
+    pq.write_table(
+        pa.table(
+            {
+                "i": pa.array([1, 2, 3], type=pa.int32()),
+                "f": pa.array([1.5, 2.5, 3.5], type=pa.float32()),
+            }
+        ),
+        str(tmp_path / "narrow" / "p.parquet"),
+    )
+    df = (
+        s.read.parquet(str(tmp_path / "narrow"))
+        .with_column("q", col("i") / col("i"))
+        .with_column("p", col("i") * col("i"))
+        .with_column("g", col("f") / col("f"))
+    )
+    t = df.collect()
+    for name in ("q", "p", "g"):
+        declared = df.schema.field(name).dtype
+        assert str(t.column(name).data.dtype) == declared, (
+            name, declared, t.column(name).data.dtype
+        )
+
+
+def test_pruned_computed_column_not_evaluated(wc_session, monkeypatch):
+    """A computed column dropped by downstream pruning is never evaluated."""
+    s, base = wc_session
+    import hyperspace_tpu.engine.physical as phys
+
+    calls = {"n": 0}
+    real = phys.WithColumnExec.execute
+
+    def spy(self, ctx):
+        calls["n"] += 1
+        return real(self, ctx)
+
+    monkeypatch.setattr(phys.WithColumnExec, "execute", spy)
+    df = (
+        s.read.parquet(os.path.join(base, "li"))
+        .with_column("revenue", col("price") * (1 - col("discount")))
+        .select("okey")
+    )
+    assert df.count() == 5
+    assert df.collect().column_names == ["okey"]
+    assert calls["n"] == 0  # elided by the planner
