@@ -369,6 +369,12 @@ def _child_main():
     if os.environ.get(_CHILD_ENV) == "dist":
         print(json.dumps(run_distributed_bench()), flush=True)
         return
+    # Init handshake: the parent aborts early when the backend claim is wedged
+    # (observed failure mode: jax.devices() blocks forever on the terminal
+    # claim). A fast line here = init succeeded, the full budget applies.
+    import jax
+
+    print(f"BENCH_CHILD_INIT_OK {jax.devices()[0].platform}", flush=True)
     result = run_bench()
     print(json.dumps(result), flush=True)
 
@@ -404,6 +410,8 @@ def main():
     t_setup0 = _now()
     diag = {"attempts": []}
     if not os.environ.get("BENCH_FORCE_CPU"):
+        import threading
+
         env = dict(os.environ)
         env[_CHILD_ENV] = "1"
         env.setdefault("JAX_PLATFORMS", "axon")
@@ -414,8 +422,59 @@ def main():
             stderr=subprocess.PIPE,
             text=True,
         )
-        try:
-            out, err = p.communicate(timeout=_CHILD_TIMEOUT_S)
+        out_lines, err_chunks = [], []
+        init_ok = threading.Event()
+
+        def _rd_out():
+            for line in p.stdout:
+                out_lines.append(line)
+                if line.startswith("BENCH_CHILD_INIT_OK"):
+                    init_ok.set()
+
+        def _rd_err():
+            err_chunks.append(p.stderr.read() or "")
+
+        t_out = threading.Thread(target=_rd_out, daemon=True)
+        t_err = threading.Thread(target=_rd_err, daemon=True)
+        t_out.start()
+        t_err.start()
+
+        # Two-stage budget: a wedged terminal claim hangs backend init forever
+        # (observed failure mode), so give INIT a short deadline; once init
+        # reports, the full budget covers compile + the bench itself.
+        init_timeout = int(os.environ.get("BENCH_TPU_INIT_TIMEOUT_S", 150))
+        deadline = _now() + init_timeout
+        while not init_ok.is_set() and p.poll() is None and _now() < deadline:
+            init_ok.wait(timeout=1)  # also returns promptly on child exit
+        timed_out = False
+        if not init_ok.is_set() and p.poll() is None:
+            timed_out = True
+            stage = f"init-timeout ({init_timeout}s)"
+        else:
+            try:
+                p.wait(timeout=_CHILD_TIMEOUT_S)
+            except subprocess.TimeoutExpired:
+                timed_out = True
+                stage = f"run-timeout ({_CHILD_TIMEOUT_S}s)"
+        if timed_out:
+            # Stack-dump then kill: SIGUSR1 triggers the child's faulthandler,
+            # so the artifact records WHERE init/compute froze (e.g. stuck in
+            # PJRT_Client_Create waiting on the terminal claim).
+            p.send_signal(signal.SIGUSR1)
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+        t_out.join(timeout=5)
+        t_err.join(timeout=5)
+        err = "".join(err_chunks)
+        out = "".join(out_lines)
+        if timed_out:
+            diag["attempts"].append(
+                {"rc": stage, "stderr_stack_tail": err.strip()[-1500:]}
+            )
+        else:
             diag["attempts"].append({"rc": p.returncode, "stderr": err.strip()[-800:]})
             if p.returncode == 0 and out.strip():
                 try:
@@ -426,23 +485,6 @@ def main():
                     # Malformed child stdout (interleaved banners etc.): record
                     # and fall through to the CPU run — a number is always printed.
                     diag["attempts"][-1]["parse_error"] = f"{type(e).__name__}: {e}"
-        except subprocess.TimeoutExpired:
-            # Stack-dump then kill: SIGUSR1 triggers the child's faulthandler,
-            # so the artifact records WHERE init/compute froze (e.g. stuck in
-            # PJRT_Client_Create waiting on the terminal claim).
-            p.send_signal(signal.SIGUSR1)
-            try:
-                out, err = p.communicate(timeout=10)
-            except subprocess.TimeoutExpired:
-                p.kill()
-                out, err = p.communicate()
-            diag["attempts"].append(
-                {
-                    "rc": "timeout",
-                    "timeout_s": _CHILD_TIMEOUT_S,
-                    "stderr_stack_tail": (err or "").strip()[-1500:],
-                }
-            )
         diag["probe"] = "tpu child failed; benching on cpu"
         print(json.dumps({"warning": diag["probe"]}), file=sys.stderr)
     else:

@@ -225,7 +225,13 @@ def evaluate(expr: Expr, table: Table, devcols: Dict[str, jnp.ndarray]) -> _Val:
         if op == "*":
             return _Val("num", lv * rv, valid=valid)
         if op == "/":
-            return _Val("num", lv / rv, valid=valid)
+            # SQL: x / 0 is NULL, not inf/nan — zero divisors go invalid.
+            zero = rv == 0
+            safe = jnp.where(zero, jnp.ones_like(rv), rv)
+            value = lv / safe
+            nonzero = jnp.broadcast_to(~zero, value.shape)
+            valid = nonzero if valid is None else (valid & nonzero)
+            return _Val("num", jnp.where(nonzero, value, 0.0), valid=valid)
 
     raise HyperspaceException(f"Cannot evaluate expression: {expr!r}")
 
@@ -259,8 +265,8 @@ def evaluate_column(expr: Expr, table: Table) -> Column:
             codes = np.where(valid, codes, 0).astype(np.int32)
         return Column("string", codes, np.asarray(v.dictionary), valid)
     if v.kind == "lit":
-        if v.value is None:
-            return Column("int64", np.zeros(n, np.int64), None, np.zeros(n, bool))
+        # (A bare None literal never reaches here: infer_expr_dtype rejects it
+        # at plan construction.)
         if isinstance(v.value, str):
             return Column(
                 "string", np.zeros(n, np.int32), np.asarray([v.value]), None

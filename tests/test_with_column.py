@@ -212,3 +212,17 @@ def test_pruned_computed_column_not_evaluated(wc_session, monkeypatch):
     assert df.count() == 5
     assert df.collect().column_names == ["okey"]
     assert calls["n"] == 0  # elided by the planner
+
+
+def test_division_by_zero_is_null(wc_session, tmp_path):
+    """SQL semantics: x / 0 -> NULL (not inf/nan), and aggregates ignore it."""
+    s, _ = wc_session
+    s.write_parquet(
+        {"a": np.array([10, 20, 30], np.int64), "b": np.array([2, 0, 5], np.int64)},
+        str(tmp_path / "div"),
+    )
+    df = s.read.parquet(str(tmp_path / "div")).with_column("q", col("a") / col("b"))
+    rows = df.select("a", "q").sorted_rows()
+    assert rows == [(10, 5.0), (20, None), (30, 6.0)]
+    agg = df.agg(total=("q", "sum"), n=("q", "count")).sorted_rows()
+    assert agg == [(11.0, 2)]
