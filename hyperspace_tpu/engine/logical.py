@@ -429,6 +429,30 @@ class LimitNode(LogicalPlan):
         return f"Limit {self.n}"
 
 
+def push_filters_below_computed(plan: LogicalPlan) -> LogicalPlan:
+    """Predicate pushdown through computed columns: `Filter > WithColumn` becomes
+    `WithColumn > Filter` whenever the predicate doesn't reference the computed
+    column. Filters earlier = less per-row work, and more importantly the
+    rewrite rules pattern-match `Filter > Scan` — without this a
+    `.with_column(...).filter(...)` query could never use a filter index
+    (Spark's optimizer does the same pushdown before the Hyperspace rules run).
+    The sink recurses through stacks of computed columns in one pass."""
+
+    def sink(cond: Expr, child: LogicalPlan) -> LogicalPlan:
+        if isinstance(child, WithColumnNode):
+            refs = {r.lower() for r in cond.references()}
+            if child.name.lower() not in refs:
+                return WithColumnNode(child.name, child.expr, sink(cond, child.child))
+        return FilterNode(cond, child)
+
+    def swap(node: LogicalPlan) -> LogicalPlan:
+        if isinstance(node, FilterNode) and isinstance(node.child, WithColumnNode):
+            return sink(node.condition, node.child)
+        return node
+
+    return plan.transform_up(swap)
+
+
 def find_single_relation(plan: LogicalPlan) -> Optional[ScanNode]:
     """Extract the single ScanNode of a linear plan (reference
     `RuleUtils.getLogicalRelation`, `RuleUtils.scala:67-74`); None if not linear or

@@ -326,6 +326,9 @@ class HyperspaceSession:
         return DataFrameReader(self)
 
     def optimize(self, plan: LogicalPlan) -> LogicalPlan:
+        from .logical import push_filters_below_computed
+
+        plan = push_filters_below_computed(plan)
         for rule in self.extra_optimizations:
             plan = rule.apply(plan, self)
         return plan

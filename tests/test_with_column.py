@@ -226,3 +226,45 @@ def test_division_by_zero_is_null(wc_session, tmp_path):
     assert rows == [(10, 5.0), (20, None), (30, 6.0)]
     agg = df.agg(total=("q", "sum"), n=("q", "count")).sorted_rows()
     assert agg == [(11.0, 2)]
+
+
+def test_filter_pushdown_enables_filter_index(wc_session):
+    """`.with_column(...).filter(src_col)` still uses a filter index: the
+    optimizer sinks the filter below the computed column before the rules run."""
+    s, base = wc_session
+    hs = Hyperspace(s)
+    hs.create_index(
+        s.read.parquet(os.path.join(base, "li")),
+        IndexConfig("fwIdx", ["okey"], ["price", "discount"]),
+    )
+
+    def q():
+        return (
+            s.read.parquet(os.path.join(base, "li"))
+            .with_column("revenue", col("price") * (1 - col("discount")))
+            .with_column("double_rev", col("revenue") * 2)
+            .filter(col("okey") == 1)
+            .select("okey", "revenue", "double_rev")
+        )
+
+    disable_hyperspace(s)
+    expected = q().collect().rows()
+    enable_hyperspace(s)
+    plan = q().explain_string()
+    assert "index=fwIdx" in plan, plan
+    got = q().collect().rows()
+    assert sorted(map(repr, got)) == sorted(map(repr, expected)) and len(got) == 2
+
+
+def test_filter_on_computed_column_not_pushed(wc_session):
+    """A predicate that references the computed column stays above it (and the
+    query still answers correctly)."""
+    s, base = wc_session
+    df = (
+        s.read.parquet(os.path.join(base, "li"))
+        .with_column("revenue", col("price") * (1 - col("discount")))
+        .filter(col("revenue") > 10)
+        .select("okey", "revenue")
+    )
+    rows = df.collect().rows()
+    assert sorted(r[0] for r in rows) == [1, 2]
