@@ -436,18 +436,29 @@ def push_filters_below_computed(plan: LogicalPlan) -> LogicalPlan:
     rewrite rules pattern-match `Filter > Scan` — without this a
     `.with_column(...).filter(...)` query could never use a filter index
     (Spark's optimizer does the same pushdown before the Hyperspace rules run).
-    The sink recurses through stacks of computed columns in one pass."""
+    The sink recurses through stacks of computed columns AND intervening filters
+    (row-wise predicates commute) in one pass — `.with_column(r, ...)
+    .filter(r > 10).filter(src == 1)` still lands the source predicate on the
+    scan. A filter only moves when an eligible computed column actually sits
+    beneath it (no gratuitous reordering of plain filter stacks)."""
 
-    def sink(cond: Expr, child: LogicalPlan) -> LogicalPlan:
-        if isinstance(child, WithColumnNode):
-            refs = {r.lower() for r in cond.references()}
-            if child.name.lower() not in refs:
-                return WithColumnNode(child.name, child.expr, sink(cond, child.child))
+    def sinkable(refs, child: LogicalPlan) -> bool:
+        while isinstance(child, FilterNode):
+            child = child.child
+        return isinstance(child, WithColumnNode) and child.name.lower() not in refs
+
+    def sink(cond: Expr, refs, child: LogicalPlan) -> LogicalPlan:
+        if isinstance(child, WithColumnNode) and child.name.lower() not in refs:
+            return WithColumnNode(child.name, child.expr, sink(cond, refs, child.child))
+        if isinstance(child, FilterNode) and sinkable(refs, child.child):
+            return FilterNode(child.condition, sink(cond, refs, child.child))
         return FilterNode(cond, child)
 
     def swap(node: LogicalPlan) -> LogicalPlan:
-        if isinstance(node, FilterNode) and isinstance(node.child, WithColumnNode):
-            return sink(node.condition, node.child)
+        if isinstance(node, FilterNode):
+            refs = {r.lower() for r in node.condition.references()}
+            if sinkable(refs, node.child):
+                return sink(node.condition, refs, node.child)
         return node
 
     return plan.transform_up(swap)

@@ -157,15 +157,17 @@ class DataFrame:
 
 class GroupedDataFrame:
     """`df.group_by(keys)` → aggregation builder (the Spark RelationalGroupedDataset
-    analogue, sized to the five SQL aggregates the engine executes on device)."""
+    analogue, over the SQL aggregates the engine executes on device:
+    sum, count, count_distinct, min, max, avg)."""
 
     def __init__(self, df: DataFrame, keys: List[str]):
         self._df = df
         self._keys = keys
 
     def agg(self, **aggs) -> DataFrame:
-        """`.agg(out_name=("column", "fn"), ...)` with fn ∈ sum|count|min|max|avg;
-        `.agg(n=("*", "count"))` is count(*)."""
+        """`.agg(out_name=("column", "fn"), ...)` with
+        fn ∈ sum|count|count_distinct|min|max|avg; `.agg(n=("*", "count"))` is
+        count(*)."""
         if not aggs:
             raise HyperspaceException("agg() requires at least one aggregate")
         triples = []

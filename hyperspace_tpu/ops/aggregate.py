@@ -42,13 +42,15 @@ _NUMERIC = (INT32, INT64, FLOAT32, FLOAT64, BOOL)
 
 
 def result_dtype(fn: str, in_dtype: Optional[str]) -> str:
-    """Aggregate result type: count→int64; avg→float64; sum widens to int64/float64;
-    min/max preserve the input type (strings included — dictionary order is value
-    order because dictionaries are sorted)."""
+    """Aggregate result type: count/count_distinct→int64; avg→float64; sum widens
+    to int64/float64; min/max preserve the input type (strings included —
+    dictionary order is value order because dictionaries are sorted)."""
     if fn == "count":
         return INT64
     if in_dtype is None:
         raise HyperspaceException(f"{fn}() requires a column")
+    if fn == "count_distinct":
+        return INT64
     if fn == "avg":
         if in_dtype not in _NUMERIC:
             raise HyperspaceException(f"avg() unsupported for {in_dtype}")
@@ -90,6 +92,33 @@ def _empty_result(table: Table, group_keys, aggs: Sequence[AggTriple]) -> Table:
     return Table(out)
 
 
+def _distinct_values(data: np.ndarray) -> np.ndarray:
+    """Value lane for distinct-dedup: floats canonicalized (all NaNs one value,
+    -0.0 == +0.0) and viewed as bit patterns, because structured np.unique
+    compares NaN != NaN and would count every NaN occurrence separately."""
+    if np.issubdtype(data.dtype, np.floating):
+        d = data.astype(np.float64)
+        d = np.where(np.isnan(d), np.float64("nan"), d)
+        d = np.where(d == 0.0, np.float64(0.0), d)
+        return d.view(np.int64)
+    return data
+
+
+def _count_distinct_per_group(
+    group_ids: np.ndarray, col: Column, valid: np.ndarray, n_groups: int
+) -> np.ndarray:
+    """Exact per-group distinct counts via (group, value) pair dedup — the ONE
+    implementation behind the grouped device path, the host oracle, and
+    (with a single group) the global path. Values are codes for strings."""
+    pairs = np.rec.fromarrays(
+        [group_ids[valid], _distinct_values(col.data)[valid]]
+    )
+    uniq_pairs = np.unique(pairs)
+    vals = np.zeros(n_groups, np.int64)
+    np.add.at(vals, uniq_pairs.f0, 1)
+    return vals
+
+
 def _global_aggregate(table: Table, aggs: Sequence[AggTriple]) -> Table:
     """No group keys: one output row (SQL global aggregate; empty input gives
     count=0 and NULL sum/min/max/avg)."""
@@ -105,6 +134,12 @@ def _global_aggregate(table: Table, aggs: Sequence[AggTriple]) -> Table:
         nv = int(valid.sum())
         if fn == "count":
             out[out_name] = _out_column(fn, col, dtype, np.array([nv]), None)
+            continue
+        if fn == "count_distinct":
+            counts = _count_distinct_per_group(
+                np.zeros(n, np.int64), col, np.asarray(valid, bool), 1
+            )
+            out[out_name] = _out_column(fn, col, dtype, counts, None)
             continue
         if nv == 0:
             out[out_name] = _out_column(
@@ -210,6 +245,10 @@ def _host_aggregate(table: Table, group_keys, aggs: Sequence[AggTriple]) -> Tabl
         if fn == "count":
             out[out_name] = _out_column(fn, col, dtype, nv, None)
             continue
+        if fn == "count_distinct":
+            vals = _count_distinct_per_group(inverse, col, valid, n_groups)
+            out[out_name] = _out_column(fn, col, dtype, vals, None)
+            continue
         any_valid = nv > 0
         data = col.data
         if fn in ("sum", "avg"):
@@ -261,10 +300,23 @@ def hash_aggregate(table: Table, group_keys, aggs: Sequence[AggTriple]) -> Table
     n_groups = int(gid[-1]) + 1
 
     seg_rows = jax.ops.segment_sum(jnp.ones(n, jnp.int64), gid, num_segments=n_groups)
+    gid_of_row = None
     reduced = []
     for out_name, fn, col_name in aggs:
         col = table.column(col_name) if col_name is not None else None
         dtype = result_dtype(fn, None if col is None else col.dtype)
+        if fn == "count_distinct":
+            # Exact distinct: dedupe (group, value) pairs on host (same exactness
+            # contract as the collision-repair path).
+            if gid_of_row is None:
+                gid_of_row = np.empty(n, np.int64)
+                gid_of_row[np.asarray(perm)] = np.asarray(gid)
+            valid = (
+                col.validity if col.validity is not None else np.ones(n, bool)
+            )
+            vals = _count_distinct_per_group(gid_of_row, col, valid, n_groups)
+            reduced.append((out_name, fn, col, dtype, vals, None))
+            continue
         vals, validity = _segment_reduce(fn, col, gid, perm, n_groups, seg_rows)
         reduced.append((out_name, fn, col, dtype, vals, validity))
 

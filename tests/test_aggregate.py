@@ -342,3 +342,63 @@ def test_duplicate_agg_output_name_rejected(agg_session):
         _sales(s, base).group_by("item").agg(item=("amount", "sum"))
     with pytest.raises(HyperspaceException, match="Duplicate"):
         _sales(s, base).group_by("item").agg(x=("amount", "sum"), X=("amount", "min"))
+
+
+def test_count_distinct(agg_session):
+    s, base = agg_session
+    rows = (
+        _sales(s, base)
+        .group_by("region")
+        .agg(items=("item", "count_distinct"), amounts=("amount", "count_distinct"))
+        .sorted_rows()
+    )
+    got = {r[0]: r[1:] for r in rows}
+    # east: items {1,2}, amounts {10,60} (None excluded)
+    assert got["east"] == (2, 2)
+    # west: items {2}, amounts {20,50}
+    assert got["west"] == (1, 2)
+    # null region: items {3}, amounts {40}
+    assert got[None] == (1, 1)
+    # global
+    assert _sales(s, base).agg(n=("item", "count_distinct")).sorted_rows() == [(3,)]
+    # host oracle agrees
+    from hyperspace_tpu.ops.aggregate import _host_aggregate, hash_aggregate
+
+    t = _sales(s, base).collect()
+    aggs = [("d", "count_distinct", "item"), ("a", "count_distinct", "amount")]
+    assert (
+        hash_aggregate(t, ["region"], aggs).sorted_rows()
+        == _host_aggregate(t, ["region"], aggs).sorted_rows()
+    )
+
+
+def test_having_style_filter_on_aggregate_output(agg_session):
+    """SQL HAVING: filter over the aggregation's output columns."""
+    s, base = agg_session
+    rows = (
+        _sales(s, base)
+        .group_by("region")
+        .agg(total=("amount", "sum"))
+        .filter(col("total") > 50)
+        .sorted_rows()
+    )
+    assert sorted(r[0] for r in rows) == ["east", "west"]
+
+
+def test_count_distinct_nan_consistency():
+    """NaN counts as ONE distinct value, identically in grouped / host / global
+    paths (structured np.unique would otherwise split every NaN)."""
+    from hyperspace_tpu.engine.table import Table
+    from hyperspace_tpu.ops.aggregate import _host_aggregate, hash_aggregate
+
+    t = Table.from_pydict(
+        {
+            "k": np.array([1, 1, 1, 2], np.int64),
+            "x": np.array([np.nan, np.nan, 1.0, -0.0]),
+        }
+    )
+    aggs = [("d", "count_distinct", "x")]
+    grouped = hash_aggregate(t, ["k"], aggs).sorted_rows()
+    assert grouped == [(1, 2), (2, 1)]  # {nan, 1.0} and {0.0}
+    assert _host_aggregate(t, ["k"], aggs).sorted_rows() == grouped
+    assert hash_aggregate(t, [], aggs).sorted_rows() == [(3,)]  # {nan, 1.0, 0.0}

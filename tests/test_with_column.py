@@ -268,3 +268,39 @@ def test_filter_on_computed_column_not_pushed(wc_session):
     )
     rows = df.collect().rows()
     assert sorted(r[0] for r in rows) == [1, 2]
+
+
+def test_filter_pushdown_through_filter_stack(wc_session):
+    """A source-column filter stacked ABOVE a computed-column filter still sinks
+    to the scan (filters commute), so the filter index applies."""
+    s, base = wc_session
+    hs = Hyperspace(s)
+    hs.create_index(
+        s.read.parquet(os.path.join(base, "li")),
+        IndexConfig("stackIdx", ["okey"], ["price", "discount"]),
+    )
+
+    def q():
+        return (
+            s.read.parquet(os.path.join(base, "li"))
+            .with_column("revenue", col("price") * (1 - col("discount")))
+            .filter(col("revenue") > 10)
+            .filter(col("okey") == 1)
+            .select("okey", "revenue")
+        )
+
+    disable_hyperspace(s)
+    expected = q().collect().rows()
+    enable_hyperspace(s)
+    plan = q().explain_string()
+    assert "index=stackIdx" in plan, plan
+    got = q().collect().rows()
+    assert sorted(map(repr, got)) == sorted(map(repr, expected))
+    # plain filter stacks are NOT reordered
+    p2 = (
+        s.read.parquet(os.path.join(base, "li"))
+        .filter(col("okey") == 1)
+        .filter(col("price") > 5)
+    )
+    t = p2.optimized_plan().tree_string()
+    assert t.index("price") < t.index("okey"), t  # outer filter still outermost
