@@ -145,7 +145,32 @@ def read_files(
     columns are appended per file before the concat."""
     if not files:
         raise HyperspaceException("No data files to read.")
-    from .scan_cache import global_scan_cache
+    from .scan_cache import global_concat_cache, global_scan_cache
+
+    # Multi-file concat cache: re-assembling N per-file tables (and re-unioning
+    # string dictionaries) per query dominates repeated multi-file scans — e.g.
+    # a filter-index scan over num_buckets small files. Key = per-file
+    # (path,size,mtime) + columns + partition layout, so any file rewrite (or a
+    # different partition interpretation of the same files) invalidates.
+    concat_key = None
+    if len(files) > 1:
+        try:
+            stats = []
+            for p in sorted(files):
+                st = os.stat(p)
+                stats.append((p, st.st_size, int(st.st_mtime * 1000)))
+            part_marker = None
+            if partitions is not None:
+                pspec, proots = partitions
+                part_marker = (tuple(pspec.columns), tuple(pspec.dtypes), tuple(proots))
+            concat_key = (
+                "concat", file_format, tuple(stats), tuple(columns or ()), part_marker
+            )
+            hit = global_concat_cache().get(concat_key)
+            if hit is not None:
+                return hit[0]
+        except OSError:
+            concat_key = None
 
     file_columns = columns
     if partitions is not None:
@@ -192,7 +217,10 @@ def read_files(
         ]
         if columns is not None:
             tables = [t.select(columns) for t in tables]
-    return tables[0] if len(tables) == 1 else Table.concat(tables)
+    out = tables[0] if len(tables) == 1 else Table.concat(tables)
+    if concat_key is not None:
+        global_concat_cache().put(concat_key, out, None)
+    return out
 
 
 def infer_schema(files: List[str], file_format: str) -> Schema:

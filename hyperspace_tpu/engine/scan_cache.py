@@ -131,3 +131,12 @@ _BUCKETED = BucketedConcatCache()
 
 def global_bucketed_cache() -> BucketedConcatCache:
     return _BUCKETED
+
+
+# Plain multi-file concat results get their OWN budget so ordinary scans can
+# never evict the steady-state bucketed-join entries above.
+_CONCAT = BucketedConcatCache()
+
+
+def global_concat_cache() -> BucketedConcatCache:
+    return _CONCAT

@@ -259,3 +259,23 @@ def test_dataskipping_sketch_on_partition_column(part_session):
     got = q().sorted_rows()
     assert got == expected and len(got) == 100
     assert "pruned by dsYear" in plan or "files pruned" in plan, plan
+
+
+def test_concat_cache_partition_aware(part_session, tmp_path):
+    """The multi-file concat cache must not alias partitioned and plain reads of
+    the same files (the partition columns are path facts, not file content)."""
+    s, root, _ = part_session
+    # Partitioned read first (4 files -> concat cached WITH year/country).
+    df1 = s.read.parquet(root)
+    assert df1.schema.names == ["uid", "value", "year", "country"]
+    assert df1.count() == 200
+    r1 = df1.select("uid", "value", "year", "country").collect()
+    # Plain read of one partition SUBDIR (2 files, non-partitioned layout below it).
+    sub = os.path.join(root, "year=2023")
+    df2 = s.read.parquet(sub)
+    assert df2.schema.names == ["uid", "value", "country"]
+    t2 = df2.collect()
+    assert t2.num_rows == 100 and "year" not in t2.column_names
+    # Re-run the partitioned read: still carries all partition columns.
+    t3 = s.read.parquet(root).collect()
+    assert t3.column_names == r1.column_names and t3.num_rows == 200
