@@ -162,11 +162,13 @@ class TestFilterIndexE2E:
         q = lambda: session.read.parquet(str(tmp_path / "t")).filter(col("c3") == "donde").select("c2")
         assert scanned_index_names(q()) == {"edidx"}
         disable_hyperspace(session)
+        disable_hyperspace(session)  # disable twice is a no-op
         assert not is_hyperspace_enabled(session)
         assert scanned_index_names(q()) == set()
         enable_hyperspace(session)
         enable_hyperspace(session)  # idempotent
         assert len(session.extra_optimizations) == 3  # join, filter, data-skipping
+        assert scanned_index_names(q()) == {"edidx"}  # round-trip preserves rewrites
 
 
 class TestJoinIndexE2E:
@@ -368,3 +370,27 @@ class TestIndexManagerE2E:
             assert (got_buckets == b).all()  # every row in its bucket
             assert (np.diff(karr.data) >= 0).all()  # sorted within bucket
         assert total == n
+
+
+class TestMultiInstance:
+    def test_two_instances_same_session_see_each_other(self, session, tmp_path):
+        """Reference `HyperspaceTests`: two Hyperspace instances over one session
+        share the lake state — an index created through one is visible to, and
+        usable by, the other (and mutations propagate through the TTL cache)."""
+        session.write_parquet(SAMPLE, str(tmp_path / "t"))
+        df = session.read.parquet(str(tmp_path / "t"))
+        hs1 = Hyperspace(session)
+        hs2 = Hyperspace(session)
+        hs1.create_index(df, IndexConfig("sharedIdx", ["c3"], ["c2"]))
+        assert hs2.indexes().to_pydict()["name"] == ["sharedIdx"]
+        hs2.delete_index("sharedIdx")
+        assert hs1.indexes().to_pydict()["state"] == ["DELETED"]
+        hs1.restore_index("sharedIdx")
+        verify_index_usage(
+            session,
+            lambda: session.read.parquet(str(tmp_path / "t"))
+            .filter(col("c3") == "facebook")
+            .select("c2"),
+            ["sharedIdx"],
+        )
+
