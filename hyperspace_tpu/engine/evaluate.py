@@ -280,7 +280,13 @@ def evaluate_column(expr: Expr, table: Table) -> Column:
             arr = arr.astype(np.float64)
         return Column.from_values(arr)
     arr = np.asarray(v.arr)
+    if arr.ndim == 0:
+        # Literal arithmetic (e.g. lit(2) * lit(3)) evaluates to a 0-d array;
+        # broadcast it to the table length like the bare-literal branch does.
+        arr = np.full(n, arr[()], dtype=arr.dtype)
     valid = None if v.valid is None else np.asarray(v.valid, dtype=bool)
+    if valid is not None and np.ndim(valid) == 0:
+        valid = np.full(n, bool(valid))
     if valid is not None and not valid.all():
         arr = np.where(valid, arr, np.zeros((), dtype=arr.dtype))
     from .schema import dtype_from_numpy

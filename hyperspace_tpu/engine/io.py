@@ -164,7 +164,12 @@ def read_files(
                 pspec, proots = partitions
                 part_marker = (tuple(pspec.columns), tuple(pspec.dtypes), tuple(proots))
             concat_key = (
-                "concat", file_format, tuple(stats), tuple(columns or ()), part_marker
+                "concat",
+                file_format,
+                tuple(stats),
+                # None (all columns) must not share a key with [] (zero columns).
+                ("<all>",) if columns is None else tuple(columns),
+                part_marker,
             )
             hit = global_concat_cache().get(concat_key)
             if hit is not None:

@@ -240,7 +240,8 @@ class BucketedIndexScanExec(PhysicalNode):
             return None
         return (
             tuple((f.path, f.size, f.modified_time) for f in self.relation.files),
-            tuple(self.columns or ()),
+            # None (all columns) must not share a key with [] (zero columns).
+            ("<all>",) if self.columns is None else tuple(self.columns),
         )
 
     def execute_concat(self, ctx) -> Tuple[Table, np.ndarray]:
@@ -697,6 +698,8 @@ def _gather_verified(
 _key64_cache: Dict[int, tuple] = {}
 _padded_cache: Dict[int, tuple] = {}
 _verify_cache: Dict[tuple, tuple] = {}
+_CACHES = {"k64": _key64_cache, "pad": _padded_cache, "ver": _verify_cache}
+_CACHE_TAGS = {id(_key64_cache): "k64", id(_padded_cache): "pad"}
 
 # Device-resident memo budget. The padded/key64 reps pin device memory (~2x key
 # bytes per join-key set) independent of the host-table scan caches, so they get
@@ -704,6 +707,46 @@ _verify_cache: Dict[tuple, tuple] = {}
 # total crosses the budget (re-derivable at the cost of one re-pad).
 _DEVICE_CACHE_BUDGET_BYTES = 2 << 30
 _device_cache_bytes = 0
+_device_cache_evictions = 0
+
+# Missing-vs-cached-None discriminator: build_dist_blocks legitimately returns
+# None (empty side), and that negative result must be a cache hit too.
+_MISS = object()
+
+# One recency order across all three caches: (tag, key) in LRU→MRU insertion
+# order. Eviction pops from the front; hits and inserts re-append.
+_recency: Dict[tuple, None] = {}
+
+
+def _touch(tag, key) -> None:
+    _recency.pop((tag, key), None)
+    _recency[(tag, key)] = None
+
+
+def _entry_nbytes(tag: str, ent) -> int:
+    if tag == "ver":
+        return _val_nbytes(ent[2])
+    return sum(_val_nbytes(v) for v in ent[1].values())
+
+
+def _drop_entry(tag: str, key) -> None:
+    global _device_cache_bytes
+    _recency.pop((tag, key), None)
+    dropped = _CACHES[tag].pop(key, None)
+    if dropped is not None:
+        _device_cache_bytes -= _entry_nbytes(tag, dropped)
+
+
+def _evict_over_budget(protect: tuple) -> None:
+    """Evict the least-recently-used entry across ALL device caches until under
+    budget, never evicting the entry just inserted (`protect`)."""
+    global _device_cache_evictions
+    while _device_cache_bytes > _DEVICE_CACHE_BUDGET_BYTES:
+        victim = next((rk for rk in _recency if rk != protect), None)
+        if victim is None:
+            return
+        _drop_entry(*victim)
+        _device_cache_evictions += 1
 
 
 def _val_nbytes(val) -> int:
@@ -721,56 +764,36 @@ def _val_nbytes(val) -> int:
 def _cached_by_table(cache: Dict[int, tuple], table: Table, subkey, compute):
     """Per-table-identity memo (weakref-keyed so entries die with their tables —
     which are themselves owned by the scan caches). Byte-bounded: when the total
-    device bytes held across the key64/padded caches exceed the budget, other
-    tables' entries are evicted oldest-first."""
+    device bytes held across the key64/padded/verify caches exceed the budget,
+    the least-recently-used entry across all three is evicted."""
     import weakref
 
     global _device_cache_bytes
-    ent = cache.get(id(table))
+    tag = _CACHE_TAGS[id(cache)]
+    key = id(table)
+    ent = cache.get(key)
     if ent is not None and ent[0]() is table:
-        hit = ent[1].get(subkey)
-        if hit is not None:
-            # Refresh recency (dicts iterate in insertion order; eviction below
-            # walks from the front, so re-inserting on hit makes it a real LRU).
-            cache[id(table)] = cache.pop(id(table))
+        hit = ent[1].get(subkey, _MISS)
+        if hit is not _MISS:
+            _touch(tag, key)
             return hit
     val = compute()
     nbytes = _val_nbytes(val)
     if ent is None or ent[0]() is not table:
-        key = id(table)
+        if ent is not None:
+            # Stale id(table) reuse before the old weakref callback ran: the
+            # displaced entry's bytes must leave the accounting.
+            _device_cache_bytes -= _entry_nbytes(tag, ent)
 
-        def _evict(_, key=key, cache=cache):
-            global _device_cache_bytes
-            dropped = cache.pop(key, None)
-            if dropped is not None:
-                _device_cache_bytes -= sum(_val_nbytes(v) for v in dropped[1].values())
+        def _evict(_, tag=tag, key=key):
+            _drop_entry(tag, key)
 
         cache[key] = (weakref.ref(table, _evict), {subkey: val})
     else:
         ent[1][subkey] = val
     _device_cache_bytes += nbytes
-    # Evict least-recently-used OTHER entries while over budget (the verify
-    # cache shares the budget, so it is in the victim pool too).
-    while _device_cache_bytes > _DEVICE_CACHE_BUDGET_BYTES:
-        victim = None
-        for c in (_key64_cache, _padded_cache):
-            for k in c:
-                if k != id(table):
-                    victim = (c, k)
-                    break
-            if victim:
-                break
-        if victim is not None:
-            dropped = victim[0].pop(victim[1], None)
-            if dropped is not None:
-                _device_cache_bytes -= sum(_val_nbytes(v) for v in dropped[1].values())
-            continue
-        vkey = next(iter(_verify_cache), None)
-        if vkey is None:
-            break
-        dropped = _verify_cache.pop(vkey, None)
-        if dropped is not None:
-            _device_cache_bytes -= _val_nbytes(dropped[2])
+    _touch(tag, key)
+    _evict_over_budget((tag, key))
     return val
 
 
@@ -785,26 +808,20 @@ def _aligned_key_codes(left: Table, right: Table, lkey: str, rkey: str):
     key = (id(left), id(right), lkey.lower(), rkey.lower())
     ent = _verify_cache.get(key)
     if ent is not None and ent[0]() is left and ent[1]() is right:
-        _verify_cache[key] = _verify_cache.pop(key)  # LRU refresh
+        _touch("ver", key)
         return ent[2]
     lc, rc = align_dictionaries(left.column(lkey), right.column(rkey))
     la, ra = lc.data, rc.data
 
     def _evict(_, key=key):
-        global _device_cache_bytes
-        dropped = _verify_cache.pop(key, None)
-        if dropped is not None:
-            _device_cache_bytes -= _val_nbytes(dropped[2])
+        _drop_entry("ver", key)
 
+    if ent is not None:
+        _device_cache_bytes -= _val_nbytes(ent[2])
     _verify_cache[key] = (weakref.ref(left, _evict), weakref.ref(right, _evict), (la, ra))
     _device_cache_bytes += _val_nbytes((la, ra))
-    while _device_cache_bytes > _DEVICE_CACHE_BUDGET_BYTES:
-        victim_key = next((k for k in _verify_cache if k != key), None)
-        if victim_key is None:
-            break
-        dropped = _verify_cache.pop(victim_key, None)
-        if dropped is not None:
-            _device_cache_bytes -= _val_nbytes(dropped[2])
+    _touch("ver", key)
+    _evict_over_budget(("ver", key))
     return la, ra
 
 
@@ -1075,6 +1092,11 @@ def plan_physical(logical: LogicalPlan, required: Optional[List[str]] = None) ->
         if required is not None:
             wanted = {r.lower() for r in required}
             cols = [n for n in rel.schema.names if n.lower() in wanted]
+            if not cols and rel.schema.names:
+                # A computed-only projection (e.g. select of a pure-literal
+                # with_column) references no source columns; keep one so the
+                # scan still carries the row count.
+                cols = [rel.schema.names[0]]
         if rel.bucket_spec is not None:
             return BucketedIndexScanExec(rel, cols)
         return ScanExec(rel, cols)

@@ -42,7 +42,9 @@ class ScanCache:
     def _key(self, path: str, columns: Optional[List[str]]):
         try:
             st = os.stat(path)
-            return (path, st.st_size, int(st.st_mtime * 1000), tuple(columns or ()))
+            # None (all columns) must not share a key with [] (zero columns).
+            cols = ("<all>",) if columns is None else tuple(columns)
+            return (path, st.st_size, int(st.st_mtime * 1000), cols)
         except OSError:
             return None
 

@@ -304,3 +304,17 @@ def test_filter_pushdown_through_filter_stack(wc_session):
     )
     t = p2.optimized_plan().tree_string()
     assert t.index("price") < t.index("okey"), t  # outer filter still outermost
+
+
+def test_literal_arithmetic_column(wc_session):
+    """An expression referencing NO columns (lit(2) * lit(3)) must broadcast its
+    0-d result to the table length (advisor r3 medium finding)."""
+    s, base = wc_session
+    df = s.read.parquet(os.path.join(base, "li")).with_column("x", lit(2) * lit(3))
+    rows = df.select("okey", "x").collect().rows()
+    assert len(rows) == 5
+    assert all(r[1] == 6 for r in rows)
+    # Float literal arithmetic keeps its dtype through the broadcast.
+    df2 = s.read.parquet(os.path.join(base, "li")).with_column("y", lit(1.5) + lit(2.0))
+    vals = [r[0] for r in df2.select("y").collect().rows()]
+    assert vals == [3.5] * 5
