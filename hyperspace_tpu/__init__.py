@@ -27,4 +27,4 @@ def __getattr__(name):
     raise AttributeError(name)
 
 
-__version__ = "0.1.0"
+__version__ = "0.4.0"  # keep in sync with pyproject.toml

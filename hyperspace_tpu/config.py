@@ -47,6 +47,12 @@ class IndexConstants:
 
     EVENT_LOGGER_CLASS = "hyperspace.eventLoggerClass"
 
+    # Column-name resolution (the spark.sql.caseSensitive analogue; reference
+    # `util/ResolverUtils.scala:26-74` reads the session resolver). Consumed by
+    # index creation, both covering rules, data skipping, and planner pruning.
+    RESOLUTION_CASE_SENSITIVE = "hyperspace.resolution.caseSensitive"
+    RESOLUTION_CASE_SENSITIVE_DEFAULT = False
+
     # Data-skipping extension (north-star; absent from the v0 reference snapshot).
     DATASKIPPING_TARGET_INDEX_DATA_FILE_SIZE = "hyperspace.index.dataskipping.targetIndexDataFileSize"
 
@@ -149,6 +155,13 @@ class HyperspaceConf:
     @property
     def event_logger_class(self) -> Optional[str]:
         return self._c.get(IndexConstants.EVENT_LOGGER_CLASS)
+
+    @property
+    def case_sensitive(self) -> bool:
+        return self._c.get_bool(
+            IndexConstants.RESOLUTION_CASE_SENSITIVE,
+            IndexConstants.RESOLUTION_CASE_SENSITIVE_DEFAULT,
+        )
 
     @property
     def build_mesh_devices(self) -> int:

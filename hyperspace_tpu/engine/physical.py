@@ -785,8 +785,13 @@ def _cached_by_table(cache: Dict[int, tuple], table: Table, subkey, compute):
             # displaced entry's bytes must leave the accounting.
             _device_cache_bytes -= _entry_nbytes(tag, ent)
 
-        def _evict(_, tag=tag, key=key):
-            _drop_entry(tag, key)
+        def _evict(wr, tag=tag, key=key):
+            # Only drop the entry this weakref installed: a dead table's id can
+            # be reused by a NEW table before this deferred callback runs, and
+            # the replacement entry must survive it.
+            ent_now = _CACHES[tag].get(key)
+            if ent_now is not None and ent_now[0] is wr:
+                _drop_entry(tag, key)
 
         cache[key] = (weakref.ref(table, _evict), {subkey: val})
     else:
@@ -813,8 +818,12 @@ def _aligned_key_codes(left: Table, right: Table, lkey: str, rkey: str):
     lc, rc = align_dictionaries(left.column(lkey), right.column(rkey))
     la, ra = lc.data, rc.data
 
-    def _evict(_, key=key):
-        _drop_entry("ver", key)
+    def _evict(wr, key=key):
+        # Same id-reuse guard as _cached_by_table: only the installing weakref
+        # may drop the entry.
+        ent_now = _verify_cache.get(key)
+        if ent_now is not None and (ent_now[0] is wr or ent_now[1] is wr):
+            _drop_entry("ver", key)
 
     if ent is not None:
         _device_cache_bytes -= _val_nbytes(ent[2])
@@ -1084,14 +1093,23 @@ def _orient_join_keys(
     return lkeys, rkeys
 
 
-def plan_physical(logical: LogicalPlan, required: Optional[List[str]] = None) -> PhysicalNode:
-    """Compile a logical plan to a physical one, pushing column pruning into scans."""
+def plan_physical(
+    logical: LogicalPlan,
+    required: Optional[List[str]] = None,
+    case_sensitive: bool = False,
+) -> PhysicalNode:
+    """Compile a logical plan to a physical one, pushing column pruning into scans.
+
+    `case_sensitive` governs how `required` names match schema names
+    (`hyperspace.resolution.caseSensitive`; default matches Spark's
+    case-insensitive resolution)."""
+    key = (lambda s: s) if case_sensitive else str.lower
     if isinstance(logical, ScanNode):
         rel = logical.relation
         cols = None
         if required is not None:
-            wanted = {r.lower() for r in required}
-            cols = [n for n in rel.schema.names if n.lower() in wanted]
+            wanted = {key(r) for r in required}
+            cols = [n for n in rel.schema.names if key(n) in wanted]
             if not cols and rel.schema.names:
                 # A computed-only projection (e.g. select of a pure-literal
                 # with_column) references no source columns; keep one so the
@@ -1105,33 +1123,33 @@ def plan_physical(logical: LogicalPlan, required: Optional[List[str]] = None) ->
         child_required = None
         if required is not None:
             child_required = list(dict.fromkeys(list(required) + sorted(logical.condition.references())))
-        return FilterExec(logical.condition, plan_physical(logical.child, child_required))
+        return FilterExec(logical.condition, plan_physical(logical.child, child_required, case_sensitive))
 
     if isinstance(logical, ProjectNode):
         return ProjectExec(
-            logical.column_names, plan_physical(logical.child, list(logical.column_names))
+            logical.column_names, plan_physical(logical.child, list(logical.column_names), case_sensitive)
         )
 
     if isinstance(logical, UnionNode):
-        return UnionExec([plan_physical(c, required) for c in logical.children()])
+        return UnionExec([plan_physical(c, required, case_sensitive) for c in logical.children()])
 
     if isinstance(logical, WithColumnNode):
         if required is not None and all(
-            r.lower() != logical.name.lower() for r in required
+            key(r) != key(logical.name) for r in required
         ):
             # The computed column is pruned out downstream: skip the evaluation
             # entirely (it cannot change row count or other columns).
-            return plan_physical(logical.child, required)
+            return plan_physical(logical.child, required, case_sensitive)
         child_required = None
         if required is not None:
-            keep = [r for r in required if r.lower() != logical.name.lower()]
+            keep = [r for r in required if key(r) != key(logical.name)]
             child_required = list(
                 dict.fromkeys(keep + sorted(logical.expr.references()))
             )
         return WithColumnExec(
             logical.name,
             logical.expr,
-            plan_physical(logical.child, child_required),
+            plan_physical(logical.child, child_required, case_sensitive),
             dtype=logical.output_schema.field(logical.name).dtype,
         )
 
@@ -1144,7 +1162,7 @@ def plan_physical(logical: LogicalPlan, required: Optional[List[str]] = None) ->
             # Pure count(*): keep one column so the scan still yields row counts.
             child_required = logical.child.output_schema.names[:1] or None
         return HashAggregateExec(
-            logical.group_keys, logical.aggs, plan_physical(logical.child, child_required)
+            logical.group_keys, logical.aggs, plan_physical(logical.child, child_required, case_sensitive)
         )
 
     if isinstance(logical, OrderByNode):
@@ -1153,10 +1171,10 @@ def plan_physical(logical: LogicalPlan, required: Optional[List[str]] = None) ->
             child_required = list(
                 dict.fromkeys(list(required) + [k for k, _ in logical.keys])
             )
-        return OrderByExec(logical.keys, plan_physical(logical.child, child_required))
+        return OrderByExec(logical.keys, plan_physical(logical.child, child_required, case_sensitive))
 
     if isinstance(logical, LimitNode):
-        return LimitExec(logical.n, plan_physical(logical.child, required))
+        return LimitExec(logical.n, plan_physical(logical.child, required, case_sensitive))
 
     if isinstance(logical, JoinNode):
         pairs = extract_equi_join_keys(logical.condition)
@@ -1170,17 +1188,17 @@ def plan_physical(logical: LogicalPlan, required: Optional[List[str]] = None) ->
 
         lreq = rreq = None
         if required is not None:
-            req = {r.lower() for r in required}
-            lreq = [n for n in lschema.names if n.lower() in req] + lkeys
-            rreq = [n for n in rschema.names if n.lower() in req] + rkeys
+            req = {key(r) for r in required}
+            lreq = [n for n in lschema.names if key(n) in req] + lkeys
+            rreq = [n for n in rschema.names if key(n) in req] + rkeys
             lreq = list(dict.fromkeys(lreq))
             rreq = list(dict.fromkeys(rreq))
         if how in ("left_semi", "left_anti"):
             # Semi/anti output only the left side; the right scan needs its keys.
             rreq = list(dict.fromkeys(rkeys))
 
-        lphys = plan_physical(logical.left, lreq)
-        rphys = plan_physical(logical.right, rreq)
+        lphys = plan_physical(logical.left, lreq, case_sensitive)
+        rphys = plan_physical(logical.right, rreq, case_sensitive)
 
         # Bucketed fast path: both sides are bucketed index scans, partitioned on
         # exactly the join keys, listing bucket columns in the same order under the

@@ -110,7 +110,10 @@ class DataFrame:
         return self.session.optimize(self.plan)
 
     def physical_plan(self) -> PhysicalNode:
-        return plan_physical(self.optimized_plan())
+        return plan_physical(
+            self.optimized_plan(),
+            case_sensitive=self.session.hs_conf.case_sensitive,
+        )
 
     def collect(self) -> Table:
         phys = self.physical_plan()
@@ -260,6 +263,10 @@ class DataFrameReader:
     def orc(self, *paths) -> DataFrame:
         return self._read(paths if len(paths) > 1 else paths[0], "orc")
 
+    def view(self, name: str) -> DataFrame:
+        """Read a named view registered with `session.create_view`."""
+        return self._session.view(name)
+
     def delta(self, path: str) -> DataFrame:
         """Snapshot read of a delta-style transactional table (extension): the file
         set is resolved from the `_delta_log`, not a directory listing."""
@@ -296,6 +303,7 @@ class HyperspaceSession:
         # Rule protocol: rule.apply(plan, session) -> plan.
         self.extra_optimizations: List = []
         self._mesh = None
+        self._views: Dict[str, LogicalPlan] = {}
         HyperspaceSession._active = self
 
     @classmethod
@@ -326,6 +334,32 @@ class HyperspaceSession:
     @property
     def read(self) -> DataFrameReader:
         return DataFrameReader(self)
+
+    # -- named views (the temp-view/catalog-table analogue) ------------------
+
+    def create_view(self, name: str, df: DataFrame, replace: bool = True) -> None:
+        """Register `df`'s logical plan under `name` (the createOrReplaceTempView
+        analogue). Reading the view resolves to the underlying plan, so the
+        index-rewrite rules see straight through it — the reference rewrites
+        queries over views the same way
+        (`E2EHyperspaceRulesTests.scala:221-247`).
+
+        View NAMES are always case-insensitive (like Spark identifiers, whose
+        caseSensitive conf governs column resolution, not table names) — a fixed
+        rule, so toggling the conf can never strand a registered view."""
+        key = name.lower()
+        if not replace and key in self._views:
+            raise HyperspaceException(f"View already exists: {name}")
+        self._views[key] = df.plan
+
+    def drop_view(self, name: str) -> bool:
+        return self._views.pop(name.lower(), None) is not None
+
+    def view(self, name: str) -> DataFrame:
+        plan = self._views.get(name.lower())
+        if plan is None:
+            raise HyperspaceException(f"View not found: {name}")
+        return DataFrame(self, plan)
 
     def optimize(self, plan: LogicalPlan) -> LogicalPlan:
         from .logical import push_filters_below_computed

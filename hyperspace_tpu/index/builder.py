@@ -50,8 +50,9 @@ class CoveringIndexBuilder(IndexerBuilder):
                 "Only creating index over a plain relation scan is supported."
             )
         schema_names = df.plan.output_schema.names
+        cs = self._session.hs_conf.case_sensitive
         for group in (index_config.indexed_columns, index_config.included_columns):
-            if resolve_all(group, schema_names) is None:
+            if resolve_all(group, schema_names, cs) is None:
                 raise HyperspaceException(
                     f"Index config columns {group} could not be resolved against "
                     f"dataframe columns {schema_names}."
@@ -59,8 +60,9 @@ class CoveringIndexBuilder(IndexerBuilder):
 
     def _resolved_columns(self, df: DataFrame, index_config: IndexConfig):
         names = df.plan.output_schema.names
-        indexed = resolve_all(index_config.indexed_columns, names)
-        included = resolve_all(index_config.included_columns, names)
+        cs = self._session.hs_conf.case_sensitive
+        indexed = resolve_all(index_config.indexed_columns, names, cs)
+        included = resolve_all(index_config.included_columns, names, cs)
         return indexed, included
 
     # -- the build (reference CreateActionBase.scala:119-191) ---------------

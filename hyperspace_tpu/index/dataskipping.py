@@ -178,7 +178,9 @@ class DataSkippingIndexBuilder(IndexerBuilder):
                 "Only creating index over a plain relation scan is supported."
             )
         names = df.plan.output_schema.names
-        if resolve_all(index_config.indexed_columns, names) is None:
+        if resolve_all(
+            index_config.indexed_columns, names, self._session.hs_conf.case_sensitive
+        ) is None:
             raise HyperspaceException(
                 f"Sketch columns {index_config.indexed_columns} could not be resolved "
                 f"against dataframe columns {names}."

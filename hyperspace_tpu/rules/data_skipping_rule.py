@@ -25,7 +25,7 @@ from ..index.dataskipping import (
 )
 from ..telemetry.event_logging import EventLoggerFactory
 from ..telemetry.events import HyperspaceIndexUsageEvent
-from .rule_utils import get_candidate_indexes
+from .rule_utils import get_candidate_indexes, log_rule_failure
 
 
 def _normalize_conjunct(e: Expr):
@@ -75,6 +75,10 @@ class DataSkippingFilterRule:
 
         try:
             index_manager = _index_manager_for(session)
+            cs = session.hs_conf.case_sensitive
+
+            def nkey(n: str) -> str:
+                return n if cs else n.lower()
 
             def sketch_data(entry):
                 key = (entry.name, tuple(entry.content.files()))
@@ -119,7 +123,7 @@ class DataSkippingFilterRule:
                     applied = False
                     for s in sketches_of(entry):
                         for op, col_name, value in conjuncts:
-                            if col_name.lower() != s.column.lower():
+                            if nkey(col_name) != nkey(s.column):
                                 continue
                             column_dtype = scan.relation.schema.field(col_name).dtype
                             for path in list(keep):
@@ -177,5 +181,6 @@ class DataSkippingFilterRule:
                 return new_node
 
             return plan.transform_up(rewrite)
-        except Exception:
+        except Exception as e:
+            log_rule_failure(session, "DataSkippingFilterRule", e)
             return plan

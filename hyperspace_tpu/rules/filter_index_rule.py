@@ -28,7 +28,7 @@ from ..index.log_entry import IndexLogEntry
 from ..telemetry.event_logging import EventLoggerFactory
 from ..telemetry.events import HyperspaceIndexUsageEvent
 from ..util.resolver_utils import resolve, resolve_all
-from .rule_utils import get_candidate_indexes, index_files_as_statuses
+from .rule_utils import get_candidate_indexes, index_files_as_statuses, log_rule_failure
 
 
 def _extract_filter_node(plan: LogicalPlan):
@@ -83,7 +83,12 @@ class FilterIndexRule:
                 usable = [
                     c
                     for c in candidates
-                    if index_covers_plan(list(output_columns), filter_columns, c.entry)
+                    if index_covers_plan(
+                        list(output_columns),
+                        filter_columns,
+                        c.entry,
+                        session.hs_conf.case_sensitive,
+                    )
                 ]
                 if not usable:
                     return node
@@ -126,8 +131,10 @@ class FilterIndexRule:
                 return new_plan
 
             return plan.transform_up(rewrite)
-        except Exception:
-            # Never break the user's query over an index problem (reference :74-78).
+        except Exception as e:
+            # Never break the user's query over an index problem (reference :74-78),
+            # but record the swallowed failure (warning + telemetry event).
+            log_rule_failure(session, "FilterIndexRule", e)
             return plan
 
 

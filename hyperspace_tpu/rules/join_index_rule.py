@@ -25,11 +25,16 @@ from ..engine.logical import JoinNode, LogicalPlan, ScanNode, find_single_relati
 from ..index.log_entry import IndexLogEntry
 from ..telemetry.event_logging import EventLoggerFactory
 from ..telemetry.events import HyperspaceIndexUsageEvent
-from .rule_utils import get_candidate_indexes
+from .rule_utils import get_candidate_indexes, log_rule_failure
 
 
-def _lower(names) -> List[str]:
-    return [n.lower() for n in names]
+def _nkey(name: str, cs: bool) -> str:
+    """Resolution key for one name under the session's case-sensitivity conf."""
+    return name if cs else name.lower()
+
+
+def _norm(names, cs: bool) -> List[str]:
+    return [_nkey(n, cs) for n in names]
 
 
 def _collect_expr_refs(plan: LogicalPlan) -> List[str]:
@@ -55,15 +60,18 @@ def _collect_expr_refs(plan: LogicalPlan) -> List[str]:
 
 
 def _orient_pairs(
-    pairs: List[Tuple[str, str]], lschema_names: List[str], rschema_names: List[str]
+    pairs: List[Tuple[str, str]],
+    lschema_names: List[str],
+    rschema_names: List[str],
+    cs: bool = False,
 ) -> Optional[List[Tuple[str, str]]]:
     """Orient each (a, b) pair as (left_col, right_col); None if any column is
     ambiguous or unresolvable (reference requires attrs to resolve to exactly one
     base relation, :287-326)."""
-    lset, rset = set(_lower(lschema_names)), set(_lower(rschema_names))
+    lset, rset = set(_norm(lschema_names, cs)), set(_norm(rschema_names, cs))
     out = []
     for a, b in pairs:
-        al, bl = a.lower(), b.lower()
+        al, bl = _nkey(a, cs), _nkey(b, cs)
         a_in_l, a_in_r = al in lset, al in rset
         b_in_l, b_in_r = bl in lset, bl in rset
         if a_in_l and b_in_r and not (a_in_r or b_in_l):
@@ -75,13 +83,15 @@ def _orient_pairs(
     return out
 
 
-def _one_to_one(oriented: List[Tuple[str, str]]) -> Optional[Dict[str, str]]:
+def _one_to_one(
+    oriented: List[Tuple[str, str]], cs: bool = False
+) -> Optional[Dict[str, str]]:
     """Exclusive one-to-one L→R column mapping; duplicates of the same pair are fine,
     conflicting mappings are not (reference :287-326)."""
     fwd: Dict[str, str] = {}
     bwd: Dict[str, str] = {}
     for l, r in oriented:
-        ll, rl = l.lower(), r.lower()
+        ll, rl = _nkey(l, cs), _nkey(r, cs)
         if fwd.get(ll, rl) != rl or bwd.get(rl, ll) != ll:
             return None
         fwd[ll] = rl
@@ -89,29 +99,33 @@ def _one_to_one(oriented: List[Tuple[str, str]]) -> Optional[Dict[str, str]]:
     return fwd
 
 
-def _usable_indexes(candidates, join_cols: List[str], required_cols: List[str]):
+def _usable_indexes(
+    candidates, join_cols: List[str], required_cols: List[str], cs: bool = False
+):
     """indexedCols set-equal to join cols AND all required ⊆ index cols
     (reference :481-493). Operates on CandidateIndex objects."""
     out = []
-    jset = set(_lower(join_cols))
-    rset = set(_lower(required_cols))
+    jset = set(_norm(join_cols, cs))
+    rset = set(_norm(required_cols, cs))
     for c in candidates:
         e = c.entry
-        indexed = set(_lower(e.indexed_columns))
-        all_cols = set(_lower(e.indexed_columns + e.included_columns))
+        indexed = set(_norm(e.indexed_columns, cs))
+        all_cols = set(_norm(e.indexed_columns + e.included_columns, cs))
         if indexed == jset and rset <= all_cols:
             out.append(c)
     return out
 
 
-def _compatible_pairs(l_candidates, r_candidates, l_to_r: Dict[str, str]):
+def _compatible_pairs(
+    l_candidates, r_candidates, l_to_r: Dict[str, str], cs: bool = False
+):
     """Pairs listing indexed columns in the same order under the mapping
-    (reference :516-563)."""
+    (reference :516-563). `l_to_r` maps and yields resolution keys."""
     out = []
     for lc in l_candidates:
-        mapped = [l_to_r[c] for c in _lower(lc.entry.indexed_columns)]
+        mapped = [l_to_r[c] for c in _norm(lc.entry.indexed_columns, cs)]
         for rc in r_candidates:
-            if _lower(rc.entry.indexed_columns) == mapped:
+            if _norm(rc.entry.indexed_columns, cs) == [_nkey(m, cs) for m in mapped]:
                 out.append((lc, rc))
     return out
 
@@ -143,6 +157,7 @@ class JoinIndexRule:
 
         try:
             index_manager = _index_manager_for(session)
+            cs = session.hs_conf.case_sensitive
 
             def rewrite(node: LogicalPlan) -> LogicalPlan:
                 if not isinstance(node, JoinNode) or node.how != "inner":
@@ -159,15 +174,15 @@ class JoinIndexRule:
 
                 lnames = l_scan.output_schema.names
                 rnames = r_scan.output_schema.names
-                oriented = _orient_pairs(pairs, lnames, rnames)
+                oriented = _orient_pairs(pairs, lnames, rnames, cs)
                 if oriented is None:
                     return node
-                l_to_r = _one_to_one(oriented)
+                l_to_r = _one_to_one(oriented, cs)
                 if l_to_r is None:
                     return node
 
                 lkeys = list(dict.fromkeys(l for l, _ in oriented))
-                rkeys = [l_to_r[k.lower()] for k in lkeys]
+                rkeys = [l_to_r[_nkey(k, cs)] for k in lkeys]
 
                 # Required = every column of this side referenced anywhere in the
                 # WHOLE query (expressions, other joins, the top-level output) +
@@ -177,25 +192,26 @@ class JoinIndexRule:
                 # with each side's schema instead — an unreferenced source column
                 # must not disqualify an otherwise-covering index.
                 root_refs = set(
-                    _lower(plan.output_schema.names) + _lower(_collect_expr_refs(plan))
+                    _norm(plan.output_schema.names, cs)
+                    + _norm(_collect_expr_refs(plan), cs)
                 )
                 l_required = list(
                     dict.fromkeys(
-                        [n for n in lnames if n.lower() in root_refs] + lkeys
+                        [n for n in lnames if _nkey(n, cs) in root_refs] + lkeys
                     )
                 )
                 r_required = list(
                     dict.fromkeys(
-                        [n for n in rnames if n.lower() in root_refs] + rkeys
+                        [n for n in rnames if _nkey(n, cs) in root_refs] + rkeys
                     )
                 )
 
                 hybrid = session.hs_conf.hybrid_scan_enabled
                 l_candidates = get_candidate_indexes(index_manager, l_scan, hybrid)
                 r_candidates = get_candidate_indexes(index_manager, r_scan, hybrid)
-                l_usable = _usable_indexes(l_candidates, lkeys, l_required)
-                r_usable = _usable_indexes(r_candidates, rkeys, r_required)
-                compatible = _compatible_pairs(l_usable, r_usable, l_to_r)
+                l_usable = _usable_indexes(l_candidates, lkeys, l_required, cs)
+                r_usable = _usable_indexes(r_candidates, rkeys, r_required, cs)
+                compatible = _compatible_pairs(l_usable, r_usable, l_to_r, cs)
                 if not compatible:
                     return node
                 lc, rc = rank_join_pairs(compatible)[0]
@@ -241,5 +257,6 @@ class JoinIndexRule:
                 return new_plan
 
             return plan.transform_up(rewrite)
-        except Exception:
+        except Exception as e:
+            log_rule_failure(session, "JoinIndexRule", e)
             return plan

