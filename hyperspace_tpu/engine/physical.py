@@ -1103,7 +1103,10 @@ def plan_physical(
     `case_sensitive` governs how `required` names match schema names
     (`hyperspace.resolution.caseSensitive`; default matches Spark's
     case-insensitive resolution)."""
-    key = (lambda s: s) if case_sensitive else str.lower
+    from ..util.resolver_utils import resolution_key
+
+    def key(s: str) -> str:
+        return resolution_key(s, case_sensitive)
     if isinstance(logical, ScanNode):
         rel = logical.relation
         cols = None

@@ -192,6 +192,21 @@ def probe_keys_promoted(a_keys, b_keys):
     return a_keys, b_keys
 
 
+def probe_ranges(ls, rs, l_len, r_len):
+    """Probe dispatcher: the Pallas tiled-compare kernel when wanted (on-TPU
+    within its capacity budget, or HYPERSPACE_PALLAS_PROBE=1), else the XLA
+    vmap'd-searchsorted probe. Any Pallas failure is recorded once and falls
+    back permanently — an index problem must never break a query."""
+    from .pallas_probe import pallas_probe_wanted, probe_pallas, record_pallas_failure
+
+    if pallas_probe_wanted(int(ls.shape[1]), int(rs.shape[1])):
+        try:
+            return probe_pallas(ls, rs, l_len, r_len)
+        except Exception as e:  # Mosaic lowering/runtime problems
+            record_pallas_failure(e)
+    return _probe(ls, rs, l_len, r_len)
+
+
 def probe_padded(left: PaddedBuckets, right: PaddedBuckets):
     """Batched range probe of two padded sides → host (left_row, right_row) pairs.
 
@@ -203,7 +218,7 @@ def probe_padded(left: PaddedBuckets, right: PaddedBuckets):
         raise ValueError(f"mixed padded modes: {left.mode} vs {right.mode}")
     a, b, swapped = probe_orientation(left, right)
     ak, bk = probe_keys_promoted(a.keys, b.keys)
-    lo, counts = _probe(ak, bk, a.lengths, b.lengths)
+    lo, counts = probe_ranges(ak, bk, a.lengths, b.lengths)
     counts_np = np.asarray(counts)
     if counts_np.sum() == 0:
         return np.empty(0, np.int64), np.empty(0, np.int64)

@@ -26,8 +26,11 @@ def stable_argsort(x: jnp.ndarray) -> jnp.ndarray:
     documents: XLA's CPU sort is ~3x slower than numpy's, so the CPU backend
     sorts on host; the device argsort is the TPU path (jnp.argsort is stable by
     default). Applied to the NON-indexed baseline path too, so the bench's
-    indexed-vs-scan speedup compares two equally-tuned implementations."""
-    if jax.default_backend() == "cpu":
+    indexed-vs-scan speedup compares two equally-tuned implementations.
+    `HYPERSPACE_FORCE_DEVICE_OPS=1` forces the device path (ops.backend)."""
+    from .backend import use_device_path
+
+    if not use_device_path():
         return jnp.asarray(np.argsort(np.asarray(x), kind="stable"))
     return jnp.argsort(x)
 

@@ -1,0 +1,159 @@
+"""Pallas TPU kernel for the co-bucketed range probe.
+
+The XLA path (`bucket_join._probe`) vmaps `jnp.searchsorted` over the bucket
+axis. This kernel recasts the probe as the VPU-friendly identity
+
+    searchsorted(sorted_row, key, 'left')  == count(row <  key)
+    searchsorted(sorted_row, key, 'right') == count(row <= key)
+
+computed as tiled broadcast-compare + reductions: grid (bucket, left-tile,
+right-tile), each step compares a [TL] slice of left keys against a [TR] slice
+of the right bucket and accumulates the two counts. No gathers, no dynamic
+shapes — exactly the shape of work Mosaic schedules well. The per-bucket merge
+this implements is what the reference gets from SortMergeJoinExec over
+co-bucketed index scans (`JoinIndexRule.scala:137-162`).
+
+Key dtype: 64-bit keys (hash mode is int64; value mode is promoted) do not
+exist on the TPU VPU, so keys are pre-split OUTSIDE the kernel into a
+lexicographic (hi, lo) int32 pair whose signed compare reproduces the 64-bit
+order (floats go through the standard order-preserving bit transform first,
+with -0.0 canonicalized to +0.0 so searchsorted equality classes survive).
+
+Cost note: the tiled compare is O(cap_l * cap_r) per bucket vs the XLA path's
+O(cap_l * log cap_r); it wins on dispatch/fusion for small-to-medium buckets
+and loses asymptotically on very large ones, so `probe_ranges` dispatches by
+capacity product (override with HYPERSPACE_PALLAS_PROBE=1/0). Equivalence with
+the XLA path is pinned by tests/test_pallas_probe.py (interpret mode off-TPU).
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_ENV_KEY = "HYPERSPACE_PALLAS_PROBE"
+# Above this cap_l*cap_r the quadratic compare loses to XLA's log-probe.
+_AUTO_MAX_PRODUCT = 1 << 22
+_pallas_broken: list = []  # first failure recorded; falls back permanently
+
+
+def _pallas_mode() -> str:
+    return os.environ.get(_ENV_KEY, "auto")
+
+
+def _sortable_i64(x: jnp.ndarray) -> jnp.ndarray:
+    """Order-preserving map of any 64-bit key space into signed int64."""
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        x = x.astype(jnp.float64) + 0.0  # canonicalize -0.0
+        bits = jax.lax.bitcast_convert_type(x, jnp.int64)
+        # Negative floats: flip magnitude bits (reverses their order, keeps
+        # sign); positives: unchanged. Signed compare == float total order.
+        return bits ^ ((bits >> 63) & jnp.int64(0x7FFFFFFFFFFFFFFF))
+    return x.astype(jnp.int64)
+
+
+def _split_hi_lo(k: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(hi, lo) int32 pair whose lexicographic signed compare == int64 compare."""
+    hi = (k >> 32).astype(jnp.int32)
+    lo = ((k & jnp.int64(0xFFFFFFFF)) - jnp.int64(0x80000000)).astype(jnp.int32)
+    return hi, lo
+
+
+def _probe_kernel(lh_ref, ll_ref, rht_ref, rlt_ref, lo_ref, hi_ref):
+    """One (bucket, left-tile, right-tile) step: accumulate lt/le counts.
+
+    The right side arrives TRANSPOSED ([cap_r, B] arrays, (TR, 1) blocks) so
+    the broadcast compare is [TR, 1] x [1, TL] -> [TR, TL] and the sublane
+    reduction lands directly in the (1, TL) output block — no in-kernel
+    reshapes/relayouts for Mosaic to choke on."""
+    lh = lh_ref[...]  # [1, TL]
+    ll = ll_ref[...]
+    rh = rht_ref[...]  # [TR, 1]
+    rl = rlt_ref[...]
+    # r < key  /  r <= key, 64-bit order via the (hi, lo) int32 pair.
+    r_lt_k = (rh < lh) | ((rh == lh) & (rl < ll))
+    r_eq_k = (rh == lh) & (rl == ll)
+    lt_counts = jnp.sum(r_lt_k, axis=0, keepdims=True, dtype=jnp.int32)
+    le_counts = lt_counts + jnp.sum(r_eq_k, axis=0, keepdims=True, dtype=jnp.int32)
+
+    @pl.when(pl.program_id(2) == 0)
+    def _init():
+        lo_ref[...] = jnp.zeros_like(lo_ref)
+        hi_ref[...] = jnp.zeros_like(hi_ref)
+
+    lo_ref[...] += lt_counts
+    hi_ref[...] += le_counts
+
+
+@partial(jax.jit, static_argnums=(4,))
+def _probe_pallas_call(lh, ll, rh, rl, interpret: bool):
+    B, cap_l = lh.shape
+    cap_r = rh.shape[1]
+    TL = min(cap_l, 256)
+    TR = min(cap_r, 1024)
+    grid = (B, cap_l // TL, cap_r // TR)
+    rht = rh.T  # [cap_r, B]; one fused XLA transpose outside the kernel
+    rlt = rl.T
+    left_spec = pl.BlockSpec((1, TL), lambda b, i, j: (b, i))
+    right_spec = pl.BlockSpec((TR, 1), lambda b, i, j: (j, b))
+    out_spec = pl.BlockSpec((1, TL), lambda b, i, j: (b, i))
+    lo, hi = pl.pallas_call(
+        _probe_kernel,
+        grid=grid,
+        in_specs=[left_spec, left_spec, right_spec, right_spec],
+        out_specs=[out_spec, out_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, cap_l), jnp.int32),
+            jax.ShapeDtypeStruct((B, cap_l), jnp.int32),
+        ],
+        interpret=interpret,
+    )(lh, ll, rht, rlt)
+    return lo, hi
+
+
+def probe_pallas(ls, rs, l_len, r_len) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Drop-in replacement for `bucket_join._probe`: (lo, counts) int32, with
+    ranges clamped to each right bucket's valid length and counts zeroed for
+    left pad slots."""
+    lk = _sortable_i64(jnp.asarray(ls))
+    rk = _sortable_i64(jnp.asarray(rs))
+    lh, ll = _split_hi_lo(lk)
+    rh, rl = _split_hi_lo(rk)
+    interpret = jax.default_backend() != "tpu"
+    lo, hi = _probe_pallas_call(lh, ll, rh, rl, interpret)
+    r_len_b = jnp.asarray(r_len)[:, None]
+    lo = jnp.minimum(lo, r_len_b).astype(jnp.int32)
+    hi = jnp.minimum(hi, r_len_b)
+    valid_left = jnp.arange(ls.shape[1])[None, :] < jnp.asarray(l_len)[:, None]
+    counts = jnp.where(valid_left, hi - lo, 0).astype(jnp.int32)
+    return lo, counts
+
+
+def pallas_probe_wanted(cap_l: int, cap_r: int) -> bool:
+    """Dispatch decision for `probe_ranges`: forced on/off by env, else on-TPU
+    with a capacity-product bound (the quadratic-compare budget)."""
+    if _pallas_broken:
+        return False
+    mode = _pallas_mode()
+    if mode == "0":
+        return False
+    if mode == "1":
+        return True
+    return (
+        jax.default_backend() == "tpu" and cap_l * cap_r <= _AUTO_MAX_PRODUCT
+    )
+
+
+def record_pallas_failure(exc: BaseException) -> None:
+    import logging
+
+    _pallas_broken.append(f"{type(exc).__name__}: {exc}")
+    logging.getLogger("hyperspace_tpu.ops").warning(
+        "pallas probe failed; falling back to the XLA probe permanently: %s",
+        _pallas_broken[-1],
+    )

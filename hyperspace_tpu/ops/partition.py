@@ -54,7 +54,9 @@ def bucketize_table(
     cols = [table.column(c) for c in bucket_columns]
     arrs = [jnp.asarray(c.data) for c in cols]
     b = bucket_id(cols, arrs, num_buckets)
-    if jax.default_backend() == "cpu":
+    from .backend import use_device_path
+
+    if not use_device_path():
         # Backend-adaptive: XLA's CPU variadic sort is single-threaded and ~3x
         # slower than numpy's lexsort at index-build sizes; the one-device-sort
         # design is for the TPU, where lax.sort is the right primitive. The

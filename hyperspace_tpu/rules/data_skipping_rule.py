@@ -25,6 +25,7 @@ from ..index.dataskipping import (
 )
 from ..telemetry.event_logging import EventLoggerFactory
 from ..telemetry.events import HyperspaceIndexUsageEvent
+from ..util.resolver_utils import resolution_key
 from .rule_utils import get_candidate_indexes, log_rule_failure
 
 
@@ -78,7 +79,7 @@ class DataSkippingFilterRule:
             cs = session.hs_conf.case_sensitive
 
             def nkey(n: str) -> str:
-                return n if cs else n.lower()
+                return resolution_key(n, cs)
 
             def sketch_data(entry):
                 key = (entry.name, tuple(entry.content.files()))

@@ -25,12 +25,13 @@ from ..engine.logical import JoinNode, LogicalPlan, ScanNode, find_single_relati
 from ..index.log_entry import IndexLogEntry
 from ..telemetry.event_logging import EventLoggerFactory
 from ..telemetry.events import HyperspaceIndexUsageEvent
+from ..util.resolver_utils import resolution_key
 from .rule_utils import get_candidate_indexes, log_rule_failure
 
 
 def _nkey(name: str, cs: bool) -> str:
     """Resolution key for one name under the session's case-sensitivity conf."""
-    return name if cs else name.lower()
+    return resolution_key(name, cs)
 
 
 def _norm(names, cs: bool) -> List[str]:

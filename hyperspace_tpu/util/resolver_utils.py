@@ -31,3 +31,10 @@ def resolve_all(
             return None
         out.append(m)
     return out
+
+
+def resolution_key(name: str, case_sensitive: bool = False):
+    """The canonical comparison key for one column name under the session's
+    case-sensitivity conf — the ONE home of the `name if cs else name.lower()`
+    rule, shared by the rewrite rules and planner pruning."""
+    return name if case_sensitive else name.lower()

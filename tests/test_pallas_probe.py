@@ -1,0 +1,137 @@
+"""Equivalence: the Pallas tiled-compare probe kernel == the XLA searchsorted
+probe, across key dtypes, duplicates, empty buckets, and pad slots.
+
+Off-TPU the kernel runs in Pallas interpret mode (same program, interpreted),
+which is how CI certifies the kernel the TPU lowers via Mosaic. The per-bucket
+merge under test is the reference's SortMergeJoinExec-over-co-bucketed-scans
+equivalent (`JoinIndexRule.scala:137-162`).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from hyperspace_tpu.ops.bucket_join import _PAD, _probe, probe_ranges
+from hyperspace_tpu.ops.pallas_probe import probe_pallas
+
+
+def _padded_from_lists(buckets, cap, dtype, pad):
+    B = len(buckets)
+    mat = np.full((B, cap), pad, dtype=dtype)
+    lens = np.zeros(B, np.int64)
+    for i, b in enumerate(buckets):
+        b = np.sort(np.asarray(b, dtype=dtype))
+        mat[i, : len(b)] = b
+        lens[i] = len(b)
+    return jnp.asarray(mat), jnp.asarray(lens)
+
+
+def _assert_equiv(ls, rs, l_len, r_len):
+    lo_x, cnt_x = _probe(ls, rs, l_len, r_len)
+    lo_p, cnt_p = probe_pallas(ls, rs, l_len, r_len)
+    # Counts must agree everywhere; lo must agree wherever a match exists
+    # (lo is meaningless where count==0, but the XLA path still clamps it —
+    # compare under the same clamp).
+    np.testing.assert_array_equal(np.asarray(cnt_x), np.asarray(cnt_p))
+    mask = np.asarray(cnt_x) > 0
+    np.testing.assert_array_equal(np.asarray(lo_x)[mask], np.asarray(lo_p)[mask])
+
+
+def test_int64_hash_keys_with_duplicates():
+    rng = np.random.RandomState(0)
+    buckets_l = [rng.randint(-(2**62), 2**62, size=n) for n in (5, 0, 17, 32)]
+    # Force cross-side duplicates: reuse some left keys on the right.
+    buckets_r = [
+        np.concatenate([rng.choice(bl, size=min(3, len(bl)), replace=True), rng.randint(-(2**62), 2**62, size=m)])
+        if len(bl)
+        else rng.randint(-(2**62), 2**62, size=m)
+        for bl, m in zip(buckets_l, (7, 4, 0, 61))
+    ]
+    ls, llen = _padded_from_lists(buckets_l, 32, np.int64, _PAD)
+    rs, rlen = _padded_from_lists(buckets_r, 64, np.int64, _PAD)
+    _assert_equiv(ls, rs, llen, rlen)
+
+
+def test_int64_value_keys_small_range():
+    # Small key range → lots of equal runs on both sides.
+    rng = np.random.RandomState(1)
+    buckets_l = [rng.randint(0, 5, size=n) for n in (16, 16, 16, 16)]
+    buckets_r = [rng.randint(0, 5, size=n) for n in (16, 16, 16, 16)]
+    ls, llen = _padded_from_lists(buckets_l, 16, np.int64, np.iinfo(np.int64).max)
+    rs, rlen = _padded_from_lists(buckets_r, 16, np.int64, np.iinfo(np.int64).max)
+    _assert_equiv(ls, rs, llen, rlen)
+
+
+def test_float64_value_keys_including_zero_signs():
+    rng = np.random.RandomState(2)
+    vals = np.concatenate([rng.randn(20), [-0.0, 0.0, 0.0, -1.5, 1e300, -1e300]])
+    buckets_l = [vals[:13], vals[13:]]
+    buckets_r = [vals[5:20], vals[:6]]
+    pad = np.finfo(np.float64).max
+    ls, llen = _padded_from_lists(buckets_l, 16, np.float64, pad)
+    rs, rlen = _padded_from_lists(buckets_r, 16, np.float64, pad)
+    _assert_equiv(ls, rs, llen, rlen)
+
+
+def test_int32_value_keys():
+    rng = np.random.RandomState(3)
+    buckets = [rng.randint(-100, 100, size=9) for _ in range(3)]
+    ls, llen = _padded_from_lists(buckets, 16, np.int32, np.iinfo(np.int32).max)
+    rs, rlen = _padded_from_lists(buckets[::-1], 16, np.int32, np.iinfo(np.int32).max)
+    _assert_equiv(ls, rs, llen, rlen)
+
+
+def test_large_caps_exercise_tiling():
+    # cap_l > TL(256) and cap_r > TR(1024): multiple grid tiles + accumulation.
+    rng = np.random.RandomState(4)
+    B, cap_l, cap_r = 3, 512, 2048
+    buckets_l = [rng.randint(0, 1000, size=rng.randint(1, cap_l)) for _ in range(B)]
+    buckets_r = [rng.randint(0, 1000, size=rng.randint(1, cap_r)) for _ in range(B)]
+    ls, llen = _padded_from_lists(buckets_l, cap_l, np.int64, _PAD)
+    rs, rlen = _padded_from_lists(buckets_r, cap_r, np.int64, _PAD)
+    _assert_equiv(ls, rs, llen, rlen)
+
+
+def test_probe_ranges_dispatches_to_pallas(monkeypatch):
+    monkeypatch.setenv("HYPERSPACE_PALLAS_PROBE", "1")
+    calls = []
+    import hyperspace_tpu.ops.bucket_join as bj
+    import hyperspace_tpu.ops.pallas_probe as pp
+
+    real = pp.probe_pallas
+
+    def spy(*a, **k):
+        calls.append(1)
+        return real(*a, **k)
+
+    monkeypatch.setattr(pp, "probe_pallas", spy)
+    rng = np.random.RandomState(5)
+    buckets = [rng.randint(0, 50, size=10) for _ in range(2)]
+    ls, llen = _padded_from_lists(buckets, 16, np.int64, _PAD)
+    rs, rlen = _padded_from_lists(buckets, 16, np.int64, _PAD)
+    lo, cnt = bj.probe_ranges(ls, rs, llen, rlen)
+    assert calls, "pallas probe not dispatched under HYPERSPACE_PALLAS_PROBE=1"
+    lo_x, cnt_x = _probe(ls, rs, llen, rlen)
+    np.testing.assert_array_equal(np.asarray(cnt), np.asarray(cnt_x))
+
+
+def test_pallas_failure_falls_back(monkeypatch):
+    monkeypatch.setenv("HYPERSPACE_PALLAS_PROBE", "1")
+    import hyperspace_tpu.ops.bucket_join as bj
+    import hyperspace_tpu.ops.pallas_probe as pp
+
+    def boom(*a, **k):
+        raise RuntimeError("mosaic lowering failed")
+
+    monkeypatch.setattr(pp, "probe_pallas", boom)
+    monkeypatch.setattr(pp, "_pallas_broken", [])
+    rng = np.random.RandomState(6)
+    buckets = [rng.randint(0, 50, size=10) for _ in range(2)]
+    ls, llen = _padded_from_lists(buckets, 16, np.int64, _PAD)
+    rs, rlen = _padded_from_lists(buckets, 16, np.int64, _PAD)
+    lo, cnt = bj.probe_ranges(ls, rs, llen, rlen)  # must not raise
+    lo_x, cnt_x = _probe(ls, rs, llen, rlen)
+    np.testing.assert_array_equal(np.asarray(cnt), np.asarray(cnt_x))
+    assert pp._pallas_broken  # failure recorded
+    assert not pp.pallas_probe_wanted(16, 16)  # permanent fallback
