@@ -705,9 +705,27 @@ _CACHE_TAGS = {id(_key64_cache): "k64", id(_padded_cache): "pad"}
 # bytes per join-key set) independent of the host-table scan caches, so they get
 # their own byte bound: least-recently-used TABLE entries are dropped when the
 # total crosses the budget (re-derivable at the cost of one re-pad).
-_DEVICE_CACHE_BUDGET_BYTES = 2 << 30
+# Env-tunable so the bench can stress the eviction machinery deliberately.
+_DEVICE_CACHE_BUDGET_BYTES = int(
+    os.environ.get("HYPERSPACE_DEVICE_CACHE_BUDGET", 2 << 30)
+)
 _device_cache_bytes = 0
 _device_cache_evictions = 0
+
+
+def device_cache_stats() -> Dict[str, int]:
+    """Live device-memo accounting (bytes pinned, lifetime evictions) — consumed
+    by the bench artifact so cache pressure is measured, not modeled."""
+    return {
+        "bytes": _device_cache_bytes,
+        "evictions": _device_cache_evictions,
+        "budget": _DEVICE_CACHE_BUDGET_BYTES,
+    }
+
+
+def set_device_cache_budget(n_bytes: int) -> None:
+    global _DEVICE_CACHE_BUDGET_BYTES
+    _DEVICE_CACHE_BUDGET_BYTES = int(n_bytes)
 
 # Missing-vs-cached-None discriminator: build_dist_blocks legitimately returns
 # None (empty side), and that negative result must be a cache hit too.

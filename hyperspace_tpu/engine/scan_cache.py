@@ -38,6 +38,29 @@ class ScanCache:
         self._bytes = 0
         self.hits = 0
         self.misses = 0
+        self.evictions = 0
+
+    def stats(self) -> dict:
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "evictions": self.evictions,
+            "bytes": self._bytes,
+            "budget": self._capacity,
+        }
+
+    def _evict_to_capacity_locked(self) -> None:
+        """LRU-evict until under budget; caller holds the lock. Size is the
+        LAST element of each entry tuple (shared with BucketedConcatCache)."""
+        while self._bytes > self._capacity and self._entries:
+            _, ent = self._entries.popitem(last=False)
+            self._bytes -= ent[-1]
+            self.evictions += 1
+
+    def set_capacity(self, capacity_bytes: int) -> None:
+        with self._lock:
+            self._capacity = int(capacity_bytes)
+            self._evict_to_capacity_locked()
 
     def _key(self, path: str, columns: Optional[List[str]]):
         try:
@@ -73,9 +96,7 @@ class ScanCache:
                 return
             self._entries[key] = (table, size)
             self._bytes += size
-            while self._bytes > self._capacity and self._entries:
-                _, (_, evicted) = self._entries.popitem(last=False)
-                self._bytes -= evicted
+            self._evict_to_capacity_locked()
 
     def clear(self) -> None:
         with self._lock:
@@ -105,13 +126,34 @@ class BucketedConcatCache:
         self._lock = threading.Lock()
         self._entries: "OrderedDict[tuple, Tuple[Table, object, int]]" = OrderedDict()
         self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def stats(self) -> dict:
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "evictions": self.evictions,
+            "bytes": self._bytes,
+            "budget": self._capacity,
+        }
+
+    _evict_to_capacity_locked = ScanCache._evict_to_capacity_locked
+
+    def set_capacity(self, capacity_bytes: int) -> None:
+        with self._lock:
+            self._capacity = int(capacity_bytes)
+            self._evict_to_capacity_locked()
 
     def get(self, key) -> Optional[Tuple[Table, object]]:
         with self._lock:
             hit = self._entries.get(key)
             if hit is None:
+                self.misses += 1
                 return None
             self._entries.move_to_end(key)
+            self.hits += 1
             return hit[0], hit[1]
 
     def put(self, key, table: Table, starts) -> None:
@@ -123,9 +165,7 @@ class BucketedConcatCache:
                 return
             self._entries[key] = (table, starts, size)
             self._bytes += size
-            while self._bytes > self._capacity and self._entries:
-                _, (_, _, evicted) = self._entries.popitem(last=False)
-                self._bytes -= evicted
+            self._evict_to_capacity_locked()
 
 
 _BUCKETED = BucketedConcatCache()

@@ -96,6 +96,9 @@ def _probe_pallas_call(lh, ll, rh, rl, interpret: bool):
     cap_r = rh.shape[1]
     TL = min(cap_l, 256)
     TR = min(cap_r, 1024)
+    # Caps reaching this kernel are _cap_pow2-shaped; guard loudly so a future
+    # non-multiple cap cannot silently skip tail tiles (unwritten output blocks).
+    assert cap_l % TL == 0 and cap_r % TR == 0, (cap_l, cap_r, TL, TR)
     grid = (B, cap_l // TL, cap_r // TR)
     rht = rh.T  # [cap_r, B]; one fused XLA transpose outside the kernel
     rlt = rl.T
