@@ -304,3 +304,33 @@ def test_device_sort_perm_matches_lexsort():
     host_rows = list(zip(bucket[perm_host], key[perm_host], key2[perm_host]))
     assert dev_rows == host_rows
     assert np.array_equal(np.asarray(sorted_b_dev), bucket[perm_host])
+
+
+def test_scan_cache_stats_and_capacity_clamp(tmp_path):
+    """stats()/set_capacity: eviction counters move when the budget clamps below
+    the held bytes, and the cache stays correct afterwards (bench relies on
+    these counters for its eviction-stress section)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from hyperspace_tpu.engine.scan_cache import ScanCache
+
+    c = ScanCache(capacity_bytes=1 << 30)
+    from hyperspace_tpu.engine.table import Table
+
+    paths = []
+    for i in range(4):
+        p = str(tmp_path / f"f{i}.parquet")
+        pq.write_table(pa.table({"x": pa.array(range(1000), type=pa.int64())}), p)
+        paths.append(p)
+        t = Table.from_pydict({"x": list(range(1000))})
+        c.put(p, None, t)
+    s0 = c.stats()
+    assert s0["evictions"] == 0 and s0["bytes"] > 0
+    c.set_capacity(s0["bytes"] // 2)
+    s1 = c.stats()
+    assert s1["evictions"] > 0
+    assert s1["bytes"] <= s0["bytes"] // 2
+    # survivors still readable
+    hits = sum(1 for p in paths if c.get(p, None) is not None)
+    assert 0 < hits < 4
