@@ -569,16 +569,38 @@ def _device_section(s, base, col, runs, backend) -> dict:
             times.append(_now() - t0)
     out["device_time_s"] = round(float(np.percentile(times, 50)), 5)
 
-    # (c) the Pallas tiled-compare probe — real Mosaic kernel on tpu.
+    # (c) the Pallas tiled-compare probe vs the XLA probe, apples-to-apples at
+    # a BOUNDED sub-shape: the tiled compare is quadratic per bucket, so at
+    # full bench caps it would run for minutes; a sorted-prefix slice of both
+    # sides keeps the comparison honest (prefixes of sorted rows stay sorted,
+    # lengths clamp) inside the kernel's win region.
     if backend == "tpu" or os.environ.get("HYPERSPACE_PALLAS_PROBE") == "1":
         try:
-            from hyperspace_tpu.ops.pallas_probe import probe_pallas
+            import jax.numpy as jnp
+
+            from hyperspace_tpu.ops.pallas_probe import probe_pallas, shape_supported
+
+            cap_l2 = min(int(lk.shape[1]), 4096)
+            cap_r2 = min(int(rk.shape[1]), 512)
+            if not shape_supported(int(lk.shape[0]), cap_l2, cap_r2):
+                raise ValueError(
+                    f"unsupported pallas shape B={int(lk.shape[0])}"
+                )
+            lk2, rk2 = lk[:, :cap_l2], rk[:, :cap_r2]
+            al2 = jnp.minimum(a.lengths, cap_l2)
+            bl2 = jnp.minimum(b.lengths, cap_r2)
 
             def pl_probe():
-                jax.block_until_ready(probe_pallas(lk, rk, a.lengths, b.lengths))
+                jax.block_until_ready(probe_pallas(lk2, rk2, al2, bl2))
+
+            def xla_probe_sub():
+                jax.block_until_ready(_probe(lk2, rk2, al2, bl2))
 
             pl_probe()  # compile
-            out["pallas_probe_p50_s"] = round(timed_p50(pl_probe, runs), 5)
+            xla_probe_sub()
+            out["pallas_probe_sub_p50_s"] = round(timed_p50(pl_probe, runs), 5)
+            out["xla_probe_sub_p50_s"] = round(timed_p50(xla_probe_sub, runs), 5)
+            out["probe_sub_shape"] = [int(lk.shape[0]), cap_l2, cap_r2]
         except Exception as e:
             out["pallas_probe_error"] = f"{type(e).__name__}: {e}"[:300]
 

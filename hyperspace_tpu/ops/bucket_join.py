@@ -199,7 +199,9 @@ def probe_ranges(ls, rs, l_len, r_len):
     back permanently — an index problem must never break a query."""
     from .pallas_probe import pallas_probe_wanted, probe_pallas, record_pallas_failure
 
-    if pallas_probe_wanted(int(ls.shape[1]), int(rs.shape[1])):
+    if pallas_probe_wanted(
+        int(ls.shape[1]), int(rs.shape[1]), int(ls.shape[0]), ls.dtype
+    ):
         try:
             return probe_pallas(ls, rs, l_len, r_len)
         except Exception as e:  # Mosaic lowering/runtime problems

@@ -6,12 +6,14 @@ axis. This kernel recasts the probe as the VPU-friendly identity
     searchsorted(sorted_row, key, 'left')  == count(row <  key)
     searchsorted(sorted_row, key, 'right') == count(row <= key)
 
-computed as tiled broadcast-compare + reductions: grid (bucket, left-tile,
-right-tile), each step compares a [TL] slice of left keys against a [TR] slice
-of the right bucket and accumulates the two counts. No gathers, no dynamic
-shapes — exactly the shape of work Mosaic schedules well. The per-bucket merge
-this implements is what the reference gets from SortMergeJoinExec over
-co-bucketed index scans (`JoinIndexRule.scala:137-162`).
+computed as tiled broadcast-compare + reductions: grid (bucket-group,
+left-tile, right-tile), each step compares [TB, TL] left keys against [TB, TR]
+right keys as a 3D broadcast and accumulates the two counts. No gathers, no
+dynamic shapes — exactly the shape of work Mosaic schedules well; the block
+shapes honor Mosaic's (x8, x128)-or-equal-to-dim tiling rule (validated on a
+real TPU v5 lite this round — see TPU_EVIDENCE.md). The per-bucket merge this
+implements is what the reference gets from SortMergeJoinExec over co-bucketed
+index scans (`JoinIndexRule.scala:137-162`).
 
 Key dtype: 64-bit keys (hash mode is int64; value mode is promoted) do not
 exist on the TPU VPU, so keys are pre-split OUTSIDE the kernel into a
@@ -37,8 +39,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 _ENV_KEY = "HYPERSPACE_PALLAS_PROBE"
-# Above this cap_l*cap_r the quadratic compare loses to XLA's log-probe.
-_AUTO_MAX_PRODUCT = 1 << 22
+# Auto-dispatch budget on TOTAL compare ops (B * cap_l * cap_r): the tiled
+# compare is quadratic per bucket, so it wins below this (measured on v5e:
+# 64x4096x512 = 2^27 ops -> 91 ms Pallas vs 176 ms XLA probe) and loses to
+# XLA's log-probe above it; 2^28 gives the measured win point 2x headroom
+# without admitting shapes whose linear scaling clearly loses.
+_AUTO_MAX_OPS = 1 << 28
 _pallas_broken: list = []  # first failure recorded; falls back permanently
 
 
@@ -64,22 +70,24 @@ def _split_hi_lo(k: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     return hi, lo
 
 
-def _probe_kernel(lh_ref, ll_ref, rht_ref, rlt_ref, lo_ref, hi_ref):
-    """One (bucket, left-tile, right-tile) step: accumulate lt/le counts.
+def _probe_kernel(lh_ref, ll_ref, rh_ref, rl_ref, lo_ref, hi_ref):
+    """One (bucket-group, left-tile, right-tile) step: accumulate lt/le counts.
 
-    The right side arrives TRANSPOSED ([cap_r, B] arrays, (TR, 1) blocks) so
-    the broadcast compare is [TR, 1] x [1, TL] -> [TR, TL] and the sublane
-    reduction lands directly in the (1, TL) output block — no in-kernel
-    reshapes/relayouts for Mosaic to choke on."""
-    lh = lh_ref[...]  # [1, TL]
-    ll = ll_ref[...]
-    rh = rht_ref[...]  # [TR, 1]
-    rl = rlt_ref[...]
+    Blocks carry TB buckets at once — Mosaic requires the last two block dims
+    to be (x8, x128)-divisible or equal to the array dims, so per-bucket
+    (1, TL) blocks are illegal; (TB, TL) blocks with the bucket axis widened
+    to TB=8 (or the whole axis when B<8) satisfy it. The compare runs as a 3D
+    broadcast [TB, TL, 1] x [TB, 1, TR] with a lane-axis reduction.
+    VALIDATED ON REAL MOSAIC (TPU v5 lite, round 4): matches the XLA probe."""
+    lhv = lh_ref[...][:, :, None]  # [TB, TL, 1]
+    llv = ll_ref[...][:, :, None]
+    rhv = rh_ref[...][:, None, :]  # [TB, 1, TR]
+    rlv = rl_ref[...][:, None, :]
     # r < key  /  r <= key, 64-bit order via the (hi, lo) int32 pair.
-    r_lt_k = (rh < lh) | ((rh == lh) & (rl < ll))
-    r_eq_k = (rh == lh) & (rl == ll)
-    lt_counts = jnp.sum(r_lt_k, axis=0, keepdims=True, dtype=jnp.int32)
-    le_counts = lt_counts + jnp.sum(r_eq_k, axis=0, keepdims=True, dtype=jnp.int32)
+    r_lt_k = (rhv < lhv) | ((rhv == lhv) & (rlv < llv))
+    r_eq_k = (rhv == lhv) & (rlv == llv)
+    lt_counts = jnp.sum(r_lt_k, axis=2, dtype=jnp.int32)  # [TB, TL]
+    le_counts = lt_counts + jnp.sum(r_eq_k, axis=2, dtype=jnp.int32)
 
     @pl.when(pl.program_id(2) == 0)
     def _init():
@@ -90,21 +98,44 @@ def _probe_kernel(lh_ref, ll_ref, rht_ref, rlt_ref, lo_ref, hi_ref):
     hi_ref[...] += le_counts
 
 
+def _bucket_tile(B: int) -> int:
+    """Bucket-axis block size: 8 when divisible (the min int32 sublane tile),
+    else the whole axis (equal-to-dimension is the other legal shape)."""
+    return 8 if B % 8 == 0 else B
+
+
+def _tiles(cap_l: int, cap_r: int):
+    """The (TL, TR) tile sizes — ONE home, shared by shape_supported and the
+    pallas_call so they cannot drift."""
+    return min(cap_l, 256), min(cap_r, 512)
+
+
+def shape_supported(B: int, cap_l: int, cap_r: int) -> bool:
+    """Shapes this kernel can lower: bucket axis tileable, caps tile-multiples
+    (guaranteed for _cap_pow2 caps), and a bounded VMEM compare block."""
+    if B <= 0:
+        return False
+    tb = _bucket_tile(B)
+    if tb > 8 and B > 8:  # non-multiple-of-8 bucket count > 8: whole-axis
+        # block would blow VMEM; let the XLA path take it.
+        return False
+    tl, tr = _tiles(cap_l, cap_r)
+    return cap_l % tl == 0 and cap_r % tr == 0
+
+
 @partial(jax.jit, static_argnums=(4,))
 def _probe_pallas_call(lh, ll, rh, rl, interpret: bool):
     B, cap_l = lh.shape
     cap_r = rh.shape[1]
-    TL = min(cap_l, 256)
-    TR = min(cap_r, 1024)
+    TB = _bucket_tile(B)
+    TL, TR = _tiles(cap_l, cap_r)
     # Caps reaching this kernel are _cap_pow2-shaped; guard loudly so a future
     # non-multiple cap cannot silently skip tail tiles (unwritten output blocks).
-    assert cap_l % TL == 0 and cap_r % TR == 0, (cap_l, cap_r, TL, TR)
-    grid = (B, cap_l // TL, cap_r // TR)
-    rht = rh.T  # [cap_r, B]; one fused XLA transpose outside the kernel
-    rlt = rl.T
-    left_spec = pl.BlockSpec((1, TL), lambda b, i, j: (b, i))
-    right_spec = pl.BlockSpec((TR, 1), lambda b, i, j: (j, b))
-    out_spec = pl.BlockSpec((1, TL), lambda b, i, j: (b, i))
+    assert B % TB == 0 and cap_l % TL == 0 and cap_r % TR == 0, (B, cap_l, cap_r)
+    grid = (B // TB, cap_l // TL, cap_r // TR)
+    left_spec = pl.BlockSpec((TB, TL), lambda b, i, j: (b, i))
+    right_spec = pl.BlockSpec((TB, TR), lambda b, i, j: (b, j))
+    out_spec = pl.BlockSpec((TB, TL), lambda b, i, j: (b, i))
     lo, hi = pl.pallas_call(
         _probe_kernel,
         grid=grid,
@@ -115,7 +146,7 @@ def _probe_pallas_call(lh, ll, rh, rl, interpret: bool):
             jax.ShapeDtypeStruct((B, cap_l), jnp.int32),
         ],
         interpret=interpret,
-    )(lh, ll, rht, rlt)
+    )(lh, ll, rh, rl)
     return lo, hi
 
 
@@ -137,18 +168,32 @@ def probe_pallas(ls, rs, l_len, r_len) -> Tuple[jnp.ndarray, jnp.ndarray]:
     return lo, counts
 
 
-def pallas_probe_wanted(cap_l: int, cap_r: int) -> bool:
+def pallas_probe_wanted(
+    cap_l: int, cap_r: int, num_buckets: int, dtype=None
+) -> bool:
     """Dispatch decision for `probe_ranges`: forced on/off by env, else on-TPU
-    with a capacity-product bound (the quadratic-compare budget)."""
+    with a capacity-product bound (the quadratic-compare budget). Shapes the
+    kernel cannot lower (see `shape_supported`) always take the XLA path, as
+    do FLOAT value-mode keys on the auto path: their order-preserving
+    transform needs a 64-bit bitcast that the axon terminal's X64-elimination
+    rewrite cannot handle (observed HTTP-500 remote-compile failure, round 4);
+    integer keys — including the common int64 hash mode — are VALIDATED on
+    real Mosaic. Forced mode ("1") still admits floats for the interpret-mode
+    CI equivalence tests."""
     if _pallas_broken:
         return False
     mode = _pallas_mode()
     if mode == "0":
         return False
+    if not shape_supported(num_buckets, cap_l, cap_r):
+        return False
     if mode == "1":
         return True
+    if dtype is not None and jnp.issubdtype(dtype, jnp.floating):
+        return False
     return (
-        jax.default_backend() == "tpu" and cap_l * cap_r <= _AUTO_MAX_PRODUCT
+        jax.default_backend() == "tpu"
+        and num_buckets * cap_l * cap_r <= _AUTO_MAX_OPS
     )
 
 

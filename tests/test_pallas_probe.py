@@ -83,7 +83,7 @@ def test_int32_value_keys():
 
 
 def test_large_caps_exercise_tiling():
-    # cap_l > TL(256) and cap_r > TR(1024): multiple grid tiles + accumulation.
+    # cap_l > TL(256) and cap_r > TR(512): multiple grid tiles + accumulation.
     rng = np.random.RandomState(4)
     B, cap_l, cap_r = 3, 512, 2048
     buckets_l = [rng.randint(0, 1000, size=rng.randint(1, cap_l)) for _ in range(B)]
@@ -134,4 +134,4 @@ def test_pallas_failure_falls_back(monkeypatch):
     lo_x, cnt_x = _probe(ls, rs, llen, rlen)
     np.testing.assert_array_equal(np.asarray(cnt), np.asarray(cnt_x))
     assert pp._pallas_broken  # failure recorded
-    assert not pp.pallas_probe_wanted(16, 16)  # permanent fallback
+    assert not pp.pallas_probe_wanted(16, 16, 2)  # permanent fallback
