@@ -35,31 +35,50 @@ def stable_argsort(x: jnp.ndarray) -> jnp.ndarray:
     return jnp.argsort(x)
 
 
+@jax.jit
+def _merge_phase_a(l_key64, r_key64):
+    """Sort both sides + range-probe in ONE compiled program (each eager op is
+    a dispatch, and on the axon relay every dispatch is a round-trip)."""
+    l_order = jnp.argsort(l_key64)
+    r_order = jnp.argsort(r_key64)
+    ls = l_key64[l_order]
+    rs = r_key64[r_order]
+    lo = jnp.searchsorted(rs, ls, side="left")
+    hi = jnp.searchsorted(rs, ls, side="right")
+    counts = hi - lo
+    return l_order, r_order, lo, counts, counts.sum()
+
+
 def merge_join_pairs(l_key64, r_key64) -> Tuple[np.ndarray, np.ndarray]:
     """All (left_index, right_index) pairs with equal keys, as host numpy arrays.
 
     Works on unsorted inputs: sorts both sides internally and maps positions back to
     the original row order."""
+    from .backend import use_device_path
+
     l_key64 = jnp.asarray(l_key64)
     r_key64 = jnp.asarray(r_key64)
     if l_key64.shape[0] == 0 or r_key64.shape[0] == 0:
         return np.empty(0, np.int64), np.empty(0, np.int64)
 
-    l_order = stable_argsort(l_key64)
-    r_order = stable_argsort(r_key64)
-    ls = l_key64[l_order]
-    rs = r_key64[r_order]
-
-    lo = jnp.searchsorted(rs, ls, side="left")
-    hi = jnp.searchsorted(rs, ls, side="right")
-    counts = hi - lo
-    total = int(counts.sum())  # the one scalar sync (dynamic output size)
+    if use_device_path():
+        l_order, r_order, lo, counts, total_dev = _merge_phase_a(l_key64, r_key64)
+        total = int(total_dev)  # the one scalar sync (dynamic output size)
+    else:
+        l_order = stable_argsort(l_key64)
+        r_order = stable_argsort(r_key64)
+        ls = l_key64[l_order]
+        rs = r_key64[r_order]
+        lo = jnp.searchsorted(rs, ls, side="left")
+        hi = jnp.searchsorted(rs, ls, side="right")
+        counts = hi - lo
+        total = int(counts.sum())
     if total == 0:
         return np.empty(0, np.int64), np.empty(0, np.int64)
 
     starts = jnp.cumsum(counts) - counts  # exclusive prefix sum
     l_pos = jnp.repeat(
-        jnp.arange(ls.shape[0]), counts, total_repeat_length=total
+        jnp.arange(l_key64.shape[0]), counts, total_repeat_length=total
     )
     offset = jnp.arange(total) - starts[l_pos]
     r_pos = lo[l_pos] + offset

@@ -174,12 +174,12 @@ def pallas_probe_wanted(
     """Dispatch decision for `probe_ranges`: forced on/off by env, else on-TPU
     with a capacity-product bound (the quadratic-compare budget). Shapes the
     kernel cannot lower (see `shape_supported`) always take the XLA path, as
-    do FLOAT value-mode keys on the auto path: their order-preserving
-    transform needs a 64-bit bitcast that the axon terminal's X64-elimination
-    rewrite cannot handle (observed HTTP-500 remote-compile failure, round 4);
-    integer keys — including the common int64 hash mode — are VALIDATED on
-    real Mosaic. Forced mode ("1") still admits floats for the interpret-mode
-    CI equivalence tests."""
+    do FLOAT value-mode keys on a REAL TPU backend (forced or not): their
+    order-preserving transform needs a 64-bit bitcast that the axon
+    terminal's X64-elimination rewrite cannot handle (observed HTTP-500
+    remote-compile failure, round 4). Integer keys — including the common
+    int64 hash mode — are VALIDATED on real Mosaic; interpret mode (non-TPU)
+    runs floats for the CI equivalence tests."""
     if _pallas_broken:
         return False
     mode = _pallas_mode()
@@ -187,10 +187,18 @@ def pallas_probe_wanted(
         return False
     if not shape_supported(num_buckets, cap_l, cap_r):
         return False
+    if (
+        dtype is not None
+        and jnp.issubdtype(dtype, jnp.floating)
+        and jax.default_backend() == "tpu"
+    ):
+        # Real-Mosaic float keys are known-broken (X64-elimination rejects the
+        # f64 bitcast); admitting them — even forced — would trip the
+        # permanent _pallas_broken latch and disable the validated integer
+        # path too. Interpret mode (non-TPU) still runs floats for CI.
+        return False
     if mode == "1":
         return True
-    if dtype is not None and jnp.issubdtype(dtype, jnp.floating):
-        return False
     return (
         jax.default_backend() == "tpu"
         and num_buckets * cap_l * cap_r <= _AUTO_MAX_OPS
