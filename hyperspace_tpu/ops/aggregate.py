@@ -38,6 +38,40 @@ from .join import stable_argsort
 #: (out_name, fn, column|None) — column is None only for count(*).
 AggTriple = Tuple[str, str, Optional[str]]
 
+from functools import partial as _partial
+
+
+def _group_ids_body(has_valid: tuple, perm, flat):
+    """Boundary detection + group ids from a given sort permutation — the ONE
+    home of the adjacent-value (+validity) semantics, used traced (fused
+    device program) and eagerly (CPU path). `has_valid[i]` tells whether key
+    column i contributes a validity lane in `flat`."""
+    n = perm.shape[0]
+    eq = jnp.ones(max(n - 1, 0), bool)
+    i = 0
+    for hv in has_valid:
+        a = flat[i]
+        i += 1
+        sa = a[perm]
+        col_eq = sa[1:] == sa[:-1]
+        if hv:
+            sv = flat[i][perm]
+            i += 1
+            both_null = (~sv[1:]) & (~sv[:-1])
+            col_eq = (col_eq & (sv[1:] == sv[:-1])) | both_null
+        eq = eq & col_eq
+    boundary = jnp.concatenate([jnp.ones(1, bool), ~eq])
+    gid = jnp.cumsum(boundary.astype(jnp.int64)) - 1
+    return boundary, gid
+
+
+@_partial(jax.jit, static_argnums=(0,))
+def _group_ids_fused(has_valid: tuple, k64, *flat):
+    """Device path of the group-id pipeline as ONE compiled program."""
+    perm = jnp.argsort(k64)  # stable by default
+    boundary, gid = _group_ids_body(has_valid, perm, flat)
+    return perm, boundary, gid
+
 _NUMERIC = (INT32, INT64, FLOAT32, FLOAT64, BOOL)
 
 
@@ -160,6 +194,39 @@ def _global_aggregate(table: Table, aggs: Sequence[AggTriple]) -> Table:
     return Table(out)
 
 
+@_partial(jax.jit, static_argnums=(0, 1, 2))
+def _seg_reduce_jit(fn: str, n_groups: int, has_valid: bool, gid, perm, x, valid=None):
+    """One aggregate's whole device pipeline (permute + mask + segment reduce)
+    as a single compiled program, keyed on (fn, n_groups, validity presence,
+    shapes/dtypes). Returns (values, n_valid)."""
+    n = x.shape[0]
+    v = valid[perm] if has_valid else jnp.ones(n, bool)
+    n_valid = jax.ops.segment_sum(v.astype(jnp.int64), gid, num_segments=n_groups)
+    if fn == "count":
+        return n_valid, n_valid
+    xs = x[perm]
+    if fn in ("sum", "avg"):
+        acc = xs.astype(
+            jnp.float64 if jnp.issubdtype(xs.dtype, jnp.floating) else jnp.int64
+        )
+        s = jax.ops.segment_sum(jnp.where(v, acc, 0), gid, num_segments=n_groups)
+        if fn == "sum":
+            return s, n_valid
+        c = jnp.maximum(n_valid, 1)
+        return s.astype(jnp.float64) / c.astype(jnp.float64), n_valid
+    # min/max: mask nulls to the opposite extreme; all-null groups are invalid.
+    if xs.dtype == jnp.bool_:
+        xs = xs.astype(jnp.int32)  # segment_min/iinfo don't take bools
+    if jnp.issubdtype(xs.dtype, jnp.floating):
+        fill = jnp.array(np.inf if fn == "min" else -np.inf, dtype=xs.dtype)
+    else:
+        info = np.iinfo(np.dtype(xs.dtype))
+        fill = jnp.array(info.max if fn == "min" else info.min, dtype=xs.dtype)
+    masked = jnp.where(v, xs, fill)
+    reduce = jax.ops.segment_min if fn == "min" else jax.ops.segment_max
+    return reduce(masked, gid, num_segments=n_groups), n_valid
+
+
 def _segment_reduce(
     fn: str,
     col: Optional[Column],
@@ -172,33 +239,15 @@ def _segment_reduce(
     if fn == "count" and col is None:
         return np.asarray(seg_rows), None
     assert col is not None
-    n = len(col.data)
-    valid = (
-        jnp.asarray(col.validity)[perm] if col.validity is not None else jnp.ones(n, bool)
-    )
-    n_valid = jax.ops.segment_sum(valid.astype(jnp.int64), gid, num_segments=n_groups)
+    has_valid = col.validity is not None
+    args = (jnp.asarray(col.data),)
+    if has_valid:
+        args = args + (jnp.asarray(col.validity),)
+    vals, n_valid = _seg_reduce_jit(fn, int(n_groups), has_valid, gid, perm, *args)
     if fn == "count":
         return np.asarray(n_valid), None
     any_valid = np.asarray(n_valid) > 0
-    x = jnp.asarray(col.data)[perm]
-    if fn in ("sum", "avg"):
-        acc = x.astype(jnp.float64 if jnp.issubdtype(x.dtype, jnp.floating) else jnp.int64)
-        s = jax.ops.segment_sum(jnp.where(valid, acc, 0), gid, num_segments=n_groups)
-        if fn == "sum":
-            return np.asarray(s), any_valid
-        c = jnp.maximum(n_valid, 1)
-        return np.asarray(s.astype(jnp.float64) / c.astype(jnp.float64)), any_valid
-    # min/max: mask nulls to the opposite extreme; all-null groups are invalid.
-    if x.dtype == jnp.bool_:
-        x = x.astype(jnp.int32)  # segment_min/iinfo don't take bools
-    if jnp.issubdtype(x.dtype, jnp.floating):
-        fill = jnp.array(np.inf if fn == "min" else -np.inf, dtype=x.dtype)
-    else:
-        info = np.iinfo(np.dtype(x.dtype))
-        fill = jnp.array(info.max if fn == "min" else info.min, dtype=x.dtype)
-    masked = jnp.where(valid, x, fill)
-    reduce = jax.ops.segment_min if fn == "min" else jax.ops.segment_max
-    return np.asarray(reduce(masked, gid, num_segments=n_groups)), any_valid
+    return np.asarray(vals), any_valid
 
 
 def _key_records(table: Table, group_keys) -> np.ndarray:
@@ -283,20 +332,24 @@ def hash_aggregate(table: Table, group_keys, aggs: Sequence[AggTriple]) -> Table
     n = table.num_rows
     arrs = [jnp.asarray(c.data) for c in key_cols]
     k64 = key64(key_cols, arrs)
-    perm = stable_argsort(k64)
 
     # Group boundaries from ADJACENT ACTUAL VALUES (+ validity), never the hash.
-    eq = jnp.ones(n - 1, bool) if n > 1 else jnp.zeros(0, bool)
+    from .backend import use_device_path
+
+    flat = []
+    has_valid = []
     for c, a in zip(key_cols, arrs):
-        sa = a[perm]
-        col_eq = sa[1:] == sa[:-1]
+        flat.append(a)
+        has_valid.append(c.validity is not None)
         if c.validity is not None:
-            sv = jnp.asarray(c.validity)[perm]
-            both_null = (~sv[1:]) & (~sv[:-1])
-            col_eq = (col_eq & (sv[1:] == sv[:-1])) | both_null
-        eq = eq & col_eq
-    boundary = jnp.concatenate([jnp.ones(1, bool), ~eq])
-    gid = jnp.cumsum(boundary.astype(jnp.int64)) - 1
+            flat.append(jnp.asarray(c.validity))
+    if use_device_path():
+        # One fused program for sort + boundary detection + group ids: each
+        # eager op is a dispatch, and on the axon relay a round-trip.
+        perm, boundary, gid = _group_ids_fused(tuple(has_valid), k64, *flat)
+    else:
+        perm = stable_argsort(k64)  # host argsort beats XLA-CPU's sort
+        boundary, gid = _group_ids_body(tuple(has_valid), perm, flat)
     n_groups = int(gid[-1]) + 1
 
     seg_rows = jax.ops.segment_sum(jnp.ones(n, jnp.int64), gid, num_segments=n_groups)

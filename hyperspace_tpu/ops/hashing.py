@@ -79,14 +79,39 @@ def hash_device_values(arr, seed: np.uint32):
     return fmix32(h)
 
 
-def host_hash_dictionary(dictionary: np.ndarray, seed: int) -> np.ndarray:
-    """Stable uint32 hash per unique string (host side, once per dictionary entry)."""
+# Dictionary-hash memo: one blake2b pass per (dictionary identity, seed), not
+# per query — weakref-keyed so entries die with their dictionaries (the same
+# id-reuse-safe pattern as the engine's device memo caches).
+_dict_hash_cache: dict = {}
+
+
+def host_hash_dictionary(dictionary: np.ndarray, seed: int):
+    """Stable uint32 hash per unique string, as a DEVICE array (host blake2b
+    once per dictionary entry; hash + upload both memoized per dictionary
+    object + seed, so repeat queries are transfer-free on the relay)."""
+    import weakref
+
+    key = (id(dictionary), int(seed))
+    ent = _dict_hash_cache.get(key)
+    if ent is not None and ent[0]() is dictionary:
+        return ent[1]
     out = np.empty(len(dictionary), dtype=np.uint32)
     seed_bytes = int(seed).to_bytes(4, "little")
     for i, s in enumerate(dictionary):
         d = hashlib.blake2b(str(s).encode("utf-8"), digest_size=4, salt=seed_bytes).digest()
         out[i] = np.frombuffer(d, dtype=np.uint32)[0]
-    return out
+    dev = jnp.asarray(out)
+
+    def _evict(wr, key=key):
+        ent_now = _dict_hash_cache.get(key)
+        if ent_now is not None and ent_now[0] is wr:
+            _dict_hash_cache.pop(key, None)
+
+    try:
+        _dict_hash_cache[key] = (weakref.ref(dictionary, _evict), dev)
+    except TypeError:
+        pass  # non-weakref-able dictionary container: skip memoization
+    return dev
 
 
 def column_hash_u32(column: Column, device_data, seed: np.uint32):
@@ -94,8 +119,7 @@ def column_hash_u32(column: Column, device_data, seed: np.uint32):
 
     ``device_data`` is the column's device representation (codes for strings)."""
     if column.is_string:
-        dict_hashes = jnp.asarray(host_hash_dictionary(column.dictionary, int(seed)))
-        return dict_hashes[device_data]
+        return host_hash_dictionary(column.dictionary, int(seed))[device_data]
     return hash_device_values(device_data, seed)
 
 
@@ -160,7 +184,7 @@ def _flat_inputs(columns, device_arrays, seeds):
             kinds.append("str")
             flat.append(arr)
             for s in seeds:
-                flat.append(jnp.asarray(host_hash_dictionary(col.dictionary, int(s))))
+                flat.append(host_hash_dictionary(col.dictionary, int(s)))
         else:
             kinds.append("num")
             flat.append(arr)

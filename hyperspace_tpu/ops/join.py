@@ -35,17 +35,23 @@ def stable_argsort(x: jnp.ndarray) -> jnp.ndarray:
     return jnp.argsort(x)
 
 
+def _range_probe_body(l_key64, r_key64, l_order, r_order):
+    """Range probe of sorted views — the ONE home of the lo/hi/count
+    semantics, used traced (fused device program) and eagerly (CPU path)."""
+    ls = l_key64[l_order]
+    rs = r_key64[r_order]
+    lo = jnp.searchsorted(rs, ls, side="left")
+    hi = jnp.searchsorted(rs, ls, side="right")
+    return lo, hi - lo
+
+
 @jax.jit
 def _merge_phase_a(l_key64, r_key64):
     """Sort both sides + range-probe in ONE compiled program (each eager op is
     a dispatch, and on the axon relay every dispatch is a round-trip)."""
     l_order = jnp.argsort(l_key64)
     r_order = jnp.argsort(r_key64)
-    ls = l_key64[l_order]
-    rs = r_key64[r_order]
-    lo = jnp.searchsorted(rs, ls, side="left")
-    hi = jnp.searchsorted(rs, ls, side="right")
-    counts = hi - lo
+    lo, counts = _range_probe_body(l_key64, r_key64, l_order, r_order)
     return l_order, r_order, lo, counts, counts.sum()
 
 
@@ -65,13 +71,9 @@ def merge_join_pairs(l_key64, r_key64) -> Tuple[np.ndarray, np.ndarray]:
         l_order, r_order, lo, counts, total_dev = _merge_phase_a(l_key64, r_key64)
         total = int(total_dev)  # the one scalar sync (dynamic output size)
     else:
-        l_order = stable_argsort(l_key64)
+        l_order = stable_argsort(l_key64)  # host argsort beats XLA-CPU's sort
         r_order = stable_argsort(r_key64)
-        ls = l_key64[l_order]
-        rs = r_key64[r_order]
-        lo = jnp.searchsorted(rs, ls, side="left")
-        hi = jnp.searchsorted(rs, ls, side="right")
-        counts = hi - lo
+        lo, counts = _range_probe_body(l_key64, r_key64, l_order, r_order)
         total = int(counts.sum())
     if total == 0:
         return np.empty(0, np.int64), np.empty(0, np.int64)
