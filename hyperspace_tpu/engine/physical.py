@@ -310,6 +310,44 @@ class FilterExec(PhysicalNode):
         mask = evaluate_predicate(self.condition, t)
         return t.take(nonzero_indices(mask))
 
+    def execute_concat(self, ctx) -> Tuple[Table, np.ndarray]:
+        """Filtered bucketed scan, with bucket structure PRESERVED: a filter
+        never moves a row across buckets and compaction keeps in-bucket order,
+        so the co-bucketed join stays sound over the filtered table — the
+        engine analogue of Spark propagating outputPartitioning through
+        FilterExec (which is what lets the reference's bucketed index joins
+        keep their no-shuffle property under side filters). Steady-state
+        cached beside the bucketed concats, keyed by the underlying scan's
+        file-inventory key + the condition."""
+        child = self.child
+        if not isinstance(child, BucketedIndexScanExec):
+            raise HyperspaceException(
+                "execute_concat requires a bucketed scan child"
+            )
+        from .scan_cache import global_filtered_cache
+
+        base_key = child._concat_cache_key()
+        key = (
+            None
+            if base_key is None
+            else ("filtered", base_key, repr(self.condition))
+        )
+        if key is not None:
+            hit = global_filtered_cache().get(key)
+            if hit is not None:
+                return hit
+        table, starts = child.execute_concat(ctx)
+        if table.num_rows:
+            mask = evaluate_predicate(self.condition, table)
+            keep = nonzero_indices(mask)  # ascending → in-bucket order kept
+            # Kept rows before each original bucket boundary = new boundary.
+            new_starts = np.searchsorted(keep, np.asarray(starts))
+            table = table.take(keep)
+            starts = new_starts
+        if key is not None:
+            global_filtered_cache().put(key, table, starts)
+        return table, starts
+
     def simple_string(self):
         return f"Filter {self.condition!r}"
 
@@ -1031,8 +1069,8 @@ class SortMergeJoinExec(PhysicalNode):
         (both sides hash-partitioned with the same function and bucket count), so all
         bucket pairs join independently — executed as ONE device program over padded
         [num_buckets, cap] matrices (`ops.bucket_join`), with no data exchange."""
-        assert isinstance(self.left, BucketedIndexScanExec)
-        assert isinstance(self.right, BucketedIndexScanExec)
+        assert isinstance(self.left, (BucketedIndexScanExec, FilterExec))
+        assert isinstance(self.right, (BucketedIndexScanExec, FilterExec))
         from ..ops.bucket_join import probe_padded
 
         left, l_starts = self.left.execute_concat(ctx)
@@ -1221,18 +1259,27 @@ def plan_physical(
         lphys = plan_physical(logical.left, lreq, case_sensitive)
         rphys = plan_physical(logical.right, rreq, case_sensitive)
 
-        # Bucketed fast path: both sides are bucketed index scans, partitioned on
-        # exactly the join keys, listing bucket columns in the same order under the
-        # L→R key mapping, with equal bucket counts → no exchange needed. (This is
-        # the planner-side re-check of the join rule's compatibility condition;
-        # the rule only rewrites inner joins, but guard anyway.)
-        if (
-            how == "inner"
-            and isinstance(lphys, BucketedIndexScanExec)
-            and isinstance(rphys, BucketedIndexScanExec)
-        ):
-            lspec = lphys.relation.bucket_spec
-            rspec = rphys.relation.bucket_spec
+        # Bucketed fast path: both sides are bucketed index scans — possibly
+        # under a filter, which preserves bucket membership and in-bucket
+        # order (`FilterExec.execute_concat`) — partitioned on exactly the
+        # join keys, listing bucket columns in the same order under the L→R
+        # key mapping, with equal bucket counts → no exchange needed. (This
+        # is the planner-side re-check of the join rule's compatibility
+        # condition; the rule only rewrites inner joins, but guard anyway.)
+        def _as_bucketed(phys: PhysicalNode) -> Optional[BucketedIndexScanExec]:
+            if isinstance(phys, BucketedIndexScanExec):
+                return phys
+            if isinstance(phys, FilterExec) and isinstance(
+                phys.child, BucketedIndexScanExec
+            ):
+                return phys.child
+            return None
+
+        lbucket = _as_bucketed(lphys)
+        rbucket = _as_bucketed(rphys)
+        if how == "inner" and lbucket is not None and rbucket is not None:
+            lspec = lbucket.relation.bucket_spec
+            rspec = rbucket.relation.bucket_spec
             # A left key equated to two different right keys (l.a==r.x AND l.a==r.y)
             # cannot ride the bucketed path: bucketing covers only one of the pairs.
             pair_map: Dict[str, str] = {}

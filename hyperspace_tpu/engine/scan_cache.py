@@ -182,3 +182,13 @@ _CONCAT = BucketedConcatCache()
 
 def global_concat_cache() -> BucketedConcatCache:
     return _CONCAT
+
+
+# Filtered bucketed-concat derivatives get their OWN budget so parameterized
+# filter churn (a different literal each query) can never evict the base
+# bucketed-join entries above — same isolation rationale as _CONCAT.
+_FILTERED = BucketedConcatCache()
+
+
+def global_filtered_cache() -> BucketedConcatCache:
+    return _FILTERED

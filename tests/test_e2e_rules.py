@@ -559,3 +559,98 @@ class TestViews:
         assert session.drop_view("v1") is False
         with pytest.raises(HyperspaceException, match="not found"):
             session.read.view("v1")
+
+
+class TestBucketPreservingFilters:
+    """A filter between the index scan and the join preserves bucket structure
+    (rows never change buckets; compaction keeps in-bucket order), so the
+    co-bucketed no-shuffle join still applies — the analogue of Spark
+    propagating outputPartitioning through FilterExec, which is what keeps
+    the reference's bucketed index joins shuffle-free under side filters."""
+
+    def test_filtered_join_rides_bucketed_path(self, session, tmp_path):
+        from hyperspace_tpu.engine.physical import SortMergeJoinExec
+
+        n = 3000
+        rng = np.random.RandomState(21)
+        session.write_parquet(
+            {
+                "okey": rng.randint(0, 200, n).tolist(),
+                "qty": rng.randint(1, 9, n).tolist(),
+                "ship": rng.randint(0, 100, n).tolist(),
+            },
+            str(tmp_path / "li"),
+        )
+        session.write_parquet(
+            {
+                "okey2": list(range(200)),
+                "cust": (np.arange(200) % 17).tolist(),
+            },
+            str(tmp_path / "ord"),
+        )
+        hs = Hyperspace(session)
+        hs.create_index(
+            session.read.parquet(str(tmp_path / "li")),
+            IndexConfig("bpfLi", ["okey"], ["qty", "ship"]),
+        )
+        hs.create_index(
+            session.read.parquet(str(tmp_path / "ord")),
+            IndexConfig("bpfOrd", ["okey2"], ["cust"]),
+        )
+
+        def q():
+            l = session.read.parquet(str(tmp_path / "li"))
+            o = session.read.parquet(str(tmp_path / "ord"))
+            return (
+                l.filter((col("ship") >= 20) & (col("ship") < 45))
+                .join(o, col("okey") == col("okey2"))
+                .select("qty", "cust")
+            )
+
+        verify_index_usage(session, q, ["bpfLi", "bpfOrd"])
+        joins = [
+            nde
+            for nde in q().physical_plan().collect_nodes()
+            if isinstance(nde, SortMergeJoinExec)
+        ]
+        assert joins and joins[0].bucketed, q().physical_plan().tree_string()
+        # Repeat run exercises the filtered-concat cache.
+        c1 = q().count()
+        c2 = q().count()
+        assert c1 == c2 > 0
+
+    def test_filters_on_both_sides_still_bucketed(self, session, tmp_path):
+        from hyperspace_tpu.engine.physical import SortMergeJoinExec
+
+        session.write_parquet(
+            {"k": [1, 2, 3, 4, 5, 6] * 50, "v": list(range(300))},
+            str(tmp_path / "a"),
+        )
+        session.write_parquet(
+            {"k2": [1, 2, 3, 4, 5, 6] * 20, "w": list(range(120))},
+            str(tmp_path / "b"),
+        )
+        hs = Hyperspace(session)
+        hs.create_index(
+            session.read.parquet(str(tmp_path / "a")), IndexConfig("bpA", ["k"], ["v"])
+        )
+        hs.create_index(
+            session.read.parquet(str(tmp_path / "b")), IndexConfig("bpB", ["k2"], ["w"])
+        )
+
+        def q():
+            a = session.read.parquet(str(tmp_path / "a"))
+            b = session.read.parquet(str(tmp_path / "b"))
+            return (
+                a.filter(col("v") > 10)
+                .join(b.filter(col("w") < 100), col("k") == col("k2"))
+                .select("v", "w")
+            )
+
+        verify_index_usage(session, q, ["bpA", "bpB"])
+        joins = [
+            nde
+            for nde in q().physical_plan().collect_nodes()
+            if isinstance(nde, SortMergeJoinExec)
+        ]
+        assert joins and joins[0].bucketed
