@@ -790,9 +790,14 @@ def main():
         t_err.start()
 
         # Two-stage budget: a wedged terminal claim hangs backend init forever,
-        # so INIT gets a short deadline; after init reports, the full budget
-        # covers compile + the bench itself.
-        init_timeout = int(os.environ.get("BENCH_TPU_INIT_TIMEOUT_S", 150))
+        # so INIT gets a bounded deadline; after init reports, the full budget
+        # covers compile + the bench itself. The deadline is generous (300 s)
+        # because a terminal RECYCLING a just-released claim can legitimately
+        # delay the grant — and killing an init-stuck client is itself the
+        # wedge trigger, so the kill must only fire when the terminal is
+        # genuinely gone (round-4 observation: a fresh claim 2 min after a
+        # heavy clean release timed out at 150 s).
+        init_timeout = int(os.environ.get("BENCH_TPU_INIT_TIMEOUT_S", 300))
         deadline = _now() + init_timeout
         while not init_ok.is_set() and p.poll() is None and _now() < deadline:
             init_ok.wait(timeout=1)
