@@ -654,3 +654,53 @@ class TestBucketPreservingFilters:
             if isinstance(nde, SortMergeJoinExec)
         ]
         assert joins and joins[0].bucketed
+
+    def test_filtered_join_with_hybrid_append(self, session, tmp_path):
+        """Side filter + hybrid scan together: appended rows are bucketized on
+        the fly AND the filter applies over the merged concat (uncacheable —
+        hybrid concats depend on query-time source state)."""
+        from hyperspace_tpu.engine import io as eio
+        from hyperspace_tpu.engine.physical import SortMergeJoinExec
+        from hyperspace_tpu.engine.table import Table
+
+        session.conf.set(IndexConstants.INDEX_HYBRID_SCAN_ENABLED, True)
+        session.write_parquet(
+            {"k": [1, 2, 3, 4] * 30, "s": list(range(120))}, str(tmp_path / "hl")
+        )
+        session.write_parquet(
+            {"k2": [1, 2, 3, 4], "w": [10, 20, 30, 40]}, str(tmp_path / "hr")
+        )
+        hs = Hyperspace(session)
+        hs.create_index(
+            session.read.parquet(str(tmp_path / "hl")), IndexConfig("hfL", ["k"], ["s"])
+        )
+        hs.create_index(
+            session.read.parquet(str(tmp_path / "hr")), IndexConfig("hfR", ["k2"], ["w"])
+        )
+        # Append AFTER the build: hybrid scan must pick these up.
+        eio.write_parquet(
+            Table.from_pydict({"k": [1, 2], "s": [500, 501]}),
+            str(tmp_path / "hl" / "part-00001.parquet"),
+        )
+
+        def q():
+            l = session.read.parquet(str(tmp_path / "hl"))
+            r = session.read.parquet(str(tmp_path / "hr"))
+            return (
+                l.filter(col("s") >= 100)
+                .join(r, col("k") == col("k2"))
+                .select("s", "w")
+            )
+
+        disable_hyperspace(session)
+        off = q().sorted_rows()
+        enable_hyperspace(session)
+        on = q().sorted_rows()
+        assert on == off
+        assert any(r[0] == 500 for r in on)  # appended row passed the filter
+        joins = [
+            nde
+            for nde in q().physical_plan().collect_nodes()
+            if isinstance(nde, SortMergeJoinExec)
+        ]
+        assert joins and joins[0].bucketed

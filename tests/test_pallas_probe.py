@@ -135,3 +135,19 @@ def test_pallas_failure_falls_back(monkeypatch):
     np.testing.assert_array_equal(np.asarray(cnt), np.asarray(cnt_x))
     assert pp._pallas_broken  # failure recorded
     assert not pp.pallas_probe_wanted(16, 16, 2)  # permanent fallback
+
+
+def test_shape_gate_refuses_unlowerable_bucket_counts(monkeypatch):
+    """Bucket counts that are neither <=8 nor a multiple of 8 cannot lower
+    (whole-axis blocks would blow VMEM); the dispatcher must refuse them even
+    when forced, instead of tripping the permanent failure latch."""
+    import hyperspace_tpu.ops.pallas_probe as pp
+
+    monkeypatch.setenv("HYPERSPACE_PALLAS_PROBE", "1")
+    monkeypatch.setattr(pp, "_pallas_broken", [])
+    assert pp.shape_supported(8, 256, 512)
+    assert pp.shape_supported(64, 256, 512)
+    assert pp.shape_supported(3, 64, 64)
+    assert not pp.shape_supported(20, 256, 512)  # >8, not a multiple of 8
+    assert not pp.pallas_probe_wanted(256, 512, 20)
+    assert not pp._pallas_broken  # refusal is not a failure
