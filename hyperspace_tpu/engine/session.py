@@ -288,7 +288,11 @@ class DataFrameReader:
 class HyperspaceSession:
     """One session = conf + filesystem + optimizer rules + warehouse location."""
 
+    # Active-session context: thread-local first (the reference's
+    # Hyperspace.getContext is per-thread, `Hyperspace.scala:108-120`), with
+    # the last globally-created session as the cross-thread fallback.
     _active: Optional["HyperspaceSession"] = None
+    _active_local = None  # threading.local, created lazily
 
     def __init__(
         self,
@@ -304,10 +308,21 @@ class HyperspaceSession:
         self.extra_optimizations: List = []
         self._mesh = None
         self._views: Dict[str, LogicalPlan] = {}
+        import threading
+
+        if HyperspaceSession._active_local is None:
+            HyperspaceSession._active_local = threading.local()
+        HyperspaceSession._active_local.session = self
         HyperspaceSession._active = self
 
     @classmethod
     def active(cls) -> "HyperspaceSession":
+        """The calling thread's most recent session, else the process-wide
+        most recent one (the reference's thread-local getContext semantics
+        with its global fallback)."""
+        local = getattr(cls._active_local, "session", None) if cls._active_local else None
+        if local is not None:
+            return local
         if cls._active is None:
             raise HyperspaceException("No active HyperspaceSession.")
         return cls._active

@@ -316,3 +316,38 @@ class TestConf:
         conf.set(IndexConstants.INDEX_HYBRID_SCAN_ENABLED, "true")
         assert h.num_buckets == 8
         assert h.hybrid_scan_enabled
+
+
+def test_active_session_is_thread_local(tmp_path):
+    """Per-thread active sessions (reference Hyperspace.scala:108-120): a
+    session created on another thread becomes THAT thread's context without
+    stealing this thread's, and threads without their own fall back to the
+    most recent global one."""
+    import threading
+
+    from hyperspace_tpu.engine import HyperspaceSession
+
+    main_s = HyperspaceSession(warehouse=str(tmp_path / "main"))
+    assert HyperspaceSession.active() is main_s
+
+    seen = {}
+
+    def worker():
+        other = HyperspaceSession(warehouse=str(tmp_path / "other"))
+        seen["worker_active"] = HyperspaceSession.active() is other
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    assert seen["worker_active"]
+    # This thread's context is untouched by the worker's session.
+    assert HyperspaceSession.active() is main_s
+
+    def fresh_thread():
+        # No session created on this thread: falls back to the global latest.
+        seen["fallback"] = HyperspaceSession.active()
+
+    t2 = threading.Thread(target=fresh_thread)
+    t2.start()
+    t2.join()
+    assert seen["fallback"] is not None
