@@ -268,3 +268,46 @@ def test_steady_state_probes_without_rebuilding_blocks(dist_session):
         _join_query(s, base).count()
     assert DIST_JOIN_STATS["block_builds"] == b0  # no re-upload
     assert DIST_JOIN_STATS["probes"] == p0 + 3
+
+
+def test_filtered_bucketed_join_on_mesh(dist_session):
+    """A side filter over the bucketed index scan still rides the sharded
+    co-bucketed probe on the mesh (bucket structure survives filtering), with
+    single-device execution as the oracle."""
+    from hyperspace_tpu.engine.physical import SortMergeJoinExec
+
+    s, base = dist_session
+    hs = Hyperspace(s)
+    hs.create_index(
+        s.read.parquet(os.path.join(base, "dept")),
+        IndexConfig("dfDept", ["deptId"], ["deptName", "score"]),
+    )
+    hs.create_index(
+        s.read.parquet(os.path.join(base, "emp")),
+        IndexConfig("dfEmp", ["empDept"], ["empId"]),
+    )
+
+    def q():
+        d = s.read.parquet(os.path.join(base, "dept"))
+        e = s.read.parquet(os.path.join(base, "emp"))
+        return (
+            d.filter(col("score") > 0.5)
+            .join(e, col("deptId") == col("empDept"))
+            .select("deptName", "empId")
+        )
+
+    enable_hyperspace(s)
+    plan = q().physical_plan()
+    joins = [n for n in plan.collect_nodes() if isinstance(n, SortMergeJoinExec)]
+    assert joins and joins[0].bucketed, plan.tree_string()
+    dist_rows = q().sorted_rows()
+
+    # Oracle 1: same plan, single-device execution.
+    s.conf.set(IndexConstants.DISTRIBUTED_MIN_ROWS, 10**9)
+    single_rows = q().sorted_rows()
+    assert dist_rows == single_rows and len(dist_rows) > 0
+    # Oracle 2: non-indexed path.
+    disable_hyperspace(s)
+    scan_rows = q().sorted_rows()
+    assert dist_rows == scan_rows
+    s.conf.set(IndexConstants.DISTRIBUTED_MIN_ROWS, 0)
