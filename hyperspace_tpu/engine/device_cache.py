@@ -26,8 +26,12 @@ def device_array(host: np.ndarray):
 
     dev = jnp.asarray(host)
 
-    def _evict(_, key=key):
-        _cache.pop(key, None)
+    def _evict(wr, key=key):
+        # Only drop the entry this weakref installed: a dead array's id can be
+        # reused by a new array before the deferred callback runs.
+        ent_now = _cache.get(key)
+        if ent_now is not None and ent_now[0] is wr:
+            _cache.pop(key, None)
 
     try:
         ref = weakref.ref(host, _evict)

@@ -22,6 +22,7 @@ import numpy as np
 
 from ..exceptions import HyperspaceException
 from .expr import BinaryOp, Col, Expr, IsIn, IsNull, Lit, Not
+from .device_cache import device_array
 from .table import Column, Table, align_dictionaries
 
 
@@ -49,7 +50,7 @@ def _and_valid(a, b):
 
 def _device(table: Table, devcols: Dict[str, jnp.ndarray], name: str):
     if name not in devcols:
-        devcols[name] = jnp.asarray(table.column(name).data)
+        devcols[name] = device_array(table.column(name).data)
     return devcols[name]
 
 
@@ -59,7 +60,7 @@ def _col_valid(table: Table, devcols: Dict[str, jnp.ndarray], name: str):
         return None
     key = f"__valid__{name}"
     if key not in devcols:
-        devcols[key] = jnp.asarray(col.validity)
+        devcols[key] = device_array(col.validity)
     return devcols[key]
 
 

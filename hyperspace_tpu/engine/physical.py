@@ -29,6 +29,7 @@ from ..exceptions import HyperspaceException
 from ..ops.hashing import key64
 from ..ops.join import merge_join_pairs, nonzero_indices
 from . import io as engine_io
+from .device_cache import device_array
 from .evaluate import evaluate_predicate
 from .expr import Col, Expr, extract_equi_join_keys
 from .logical import (
@@ -909,7 +910,7 @@ def _padded_rep(table: Table, starts: np.ndarray, keys: List[str], force_hash: b
                 and c.data.dtype != np.bool_
                 and getattr(c, "validity", None) is None
             ):
-                rep = pad_buckets_by_value(jnp.asarray(c.data), starts)
+                rep = pad_buckets_by_value(device_array(c.data), starts)
                 if rep is not None:
                     return rep
         return pad_buckets_by_hash(_table_key64(table, list(keys)), starts)
@@ -947,7 +948,7 @@ def _table_key64(table: Table, keys: List[str]):
 
     def compute():
         cols = [table.column(k) for k in keys]
-        return key64(cols, [jnp.asarray(c.data) for c in cols])
+        return key64(cols, [device_array(c.data) for c in cols])
 
     return _cached_by_table(
         _key64_cache, table, tuple(k.lower() for k in keys), compute

@@ -32,6 +32,7 @@ import numpy as np
 from ..engine.schema import BOOL, FLOAT32, FLOAT64, INT32, INT64, STRING
 from ..engine.table import Column, Table
 from ..exceptions import HyperspaceException
+from ..engine.device_cache import device_array
 from .hashing import key64
 from .join import stable_argsort
 
@@ -240,9 +241,9 @@ def _segment_reduce(
         return np.asarray(seg_rows), None
     assert col is not None
     has_valid = col.validity is not None
-    args = (jnp.asarray(col.data),)
+    args = (device_array(col.data),)
     if has_valid:
-        args = args + (jnp.asarray(col.validity),)
+        args = args + (device_array(col.validity),)
     vals, n_valid = _seg_reduce_jit(fn, int(n_groups), has_valid, gid, perm, *args)
     if fn == "count":
         return np.asarray(n_valid), None
@@ -330,7 +331,7 @@ def hash_aggregate(table: Table, group_keys, aggs: Sequence[AggTriple]) -> Table
         return _empty_result(table, group_keys, aggs)
 
     n = table.num_rows
-    arrs = [jnp.asarray(c.data) for c in key_cols]
+    arrs = [device_array(c.data) for c in key_cols]
     k64 = key64(key_cols, arrs)
 
     # Group boundaries from ADJACENT ACTUAL VALUES (+ validity), never the hash.
@@ -342,7 +343,7 @@ def hash_aggregate(table: Table, group_keys, aggs: Sequence[AggTriple]) -> Table
         flat.append(a)
         has_valid.append(c.validity is not None)
         if c.validity is not None:
-            flat.append(jnp.asarray(c.validity))
+            flat.append(device_array(c.validity))
     if use_device_path():
         # One fused program for sort + boundary detection + group ids: each
         # eager op is a dispatch, and on the axon relay a round-trip.

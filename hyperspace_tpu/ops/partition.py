@@ -52,7 +52,9 @@ def bucketize_table(
     columns within each bucket. Returns (reordered table, bucket start offsets of
     length num_buckets+1): bucket b = rows[starts[b]:starts[b+1]]."""
     cols = [table.column(c) for c in bucket_columns]
-    arrs = [jnp.asarray(c.data) for c in cols]
+    from ..engine.device_cache import device_array
+
+    arrs = [device_array(c.data) for c in cols]
     b = bucket_id(cols, arrs, num_buckets)
     from .backend import use_device_path
 
