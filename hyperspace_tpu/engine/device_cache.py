@@ -7,21 +7,40 @@ host arrays — which are themselves owned by the scan cache)."""
 
 from __future__ import annotations
 
+import os
 import weakref
 
 import jax.numpy as jnp
 import numpy as np
 
-_cache: dict = {}
+_cache: dict = {}  # id(host) -> (weakref, device_array); insertion order = LRU
+# Device copies are pinned until their host arrays die (the scan cache bounds
+# hosts at 4 GiB); this byte budget additionally bounds DEVICE memory so the
+# memo can never approach HBM capacity on its own.
+_BUDGET = int(os.environ.get("HYPERSPACE_UPLOAD_CACHE_BUDGET", 4 << 30))
+_bytes = 0
+
+
+def _evict_over_budget(protect_key) -> None:
+    global _bytes
+    while _bytes > _BUDGET:
+        victim = next((k for k in _cache if k != protect_key), None)
+        if victim is None:
+            return
+        dropped = _cache.pop(victim, None)
+        if dropped is not None:
+            _bytes -= int(dropped[1].nbytes)
 
 
 def device_array(host: np.ndarray):
     """jnp view of a host numpy array, cached by identity."""
+    global _bytes
     if not isinstance(host, np.ndarray):
         return jnp.asarray(host)
     key = id(host)
     hit = _cache.get(key)
     if hit is not None and hit[0]() is host:
+        _cache[key] = _cache.pop(key)  # LRU refresh
         return hit[1]
 
     dev = jnp.asarray(host)
@@ -29,13 +48,19 @@ def device_array(host: np.ndarray):
     def _evict(wr, key=key):
         # Only drop the entry this weakref installed: a dead array's id can be
         # reused by a new array before the deferred callback runs.
+        global _bytes
         ent_now = _cache.get(key)
         if ent_now is not None and ent_now[0] is wr:
             _cache.pop(key, None)
+            _bytes -= int(ent_now[1].nbytes)
 
     try:
         ref = weakref.ref(host, _evict)
     except TypeError:
         return dev  # non-weakref-able subclass: skip caching
+    if hit is not None:
+        _bytes -= int(hit[1].nbytes)  # displaced stale entry leaves accounting
     _cache[key] = (ref, dev)
+    _bytes += int(dev.nbytes)
+    _evict_over_budget(key)
     return dev

@@ -334,3 +334,32 @@ def test_scan_cache_stats_and_capacity_clamp(tmp_path):
     # survivors still readable
     hits = sum(1 for p in paths if c.get(p, None) is not None)
     assert 0 < hits < 4
+
+
+def test_device_array_memo_budget_and_identity():
+    """Upload memo: identity hits, LRU byte budget, id-reuse-safe eviction."""
+    import numpy as np
+
+    import hyperspace_tpu.engine.device_cache as dc
+
+    saved_budget, saved_bytes = dc._BUDGET, dc._bytes
+    saved_cache = dict(dc._cache)
+    dc._cache.clear()
+    dc._bytes = 0
+    try:
+        a = np.arange(1000, dtype=np.int64)
+        d1 = dc.device_array(a)
+        d2 = dc.device_array(a)
+        assert d1 is d2  # identity hit
+        dc._BUDGET = 3 * a.nbytes
+        keep = [np.arange(1000, dtype=np.int64) + i for i in range(5)]
+        for arr in keep:
+            dc.device_array(arr)
+        assert dc._bytes <= dc._BUDGET
+        # Most-recent entries survive; the result is still correct either way.
+        d_last = dc.device_array(keep[-1])
+        assert (np.asarray(d_last) == keep[-1]).all()
+    finally:
+        dc._BUDGET, dc._bytes = saved_budget, saved_bytes
+        dc._cache.clear()
+        dc._cache.update(saved_cache)
