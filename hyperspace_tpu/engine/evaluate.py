@@ -295,12 +295,158 @@ def evaluate_column(expr: Expr, table: Table) -> Column:
     return Column(dtype_from_numpy(arr.dtype), arr, None, valid)
 
 
-def evaluate_predicate(expr: Expr, table: Table) -> jnp.ndarray:
-    """Evaluate a boolean expression over a table → device mask. A row survives
-    only when the predicate is TRUE and KNOWN (SQL WHERE drops unknowns)."""
+def _collect_col_spellings(expr: Expr) -> list:
+    """Distinct column spellings as WRITTEN in the expression (evaluate() keys
+    devcols by the expression's own spelling, so the compiled path must too)."""
+    out = []
+
+    def walk(e):
+        if isinstance(e, Col):
+            if e.name not in out:
+                out.append(e.name)
+        elif isinstance(e, BinaryOp):
+            walk(e.left)
+            walk(e.right)
+        elif isinstance(e, (Not, IsNull, IsIn)):
+            walk(e.child)
+
+    walk(expr)
+    return sorted(out)
+
+
+class _PredColMeta:
+    """The column METADATA evaluate() reads at trace time — everything except
+    the arrays themselves (those arrive as traced arguments)."""
+
+    __slots__ = ("is_string", "dictionary", "validity")
+
+    def __init__(self, is_string, dictionary, has_validity):
+        self.is_string = is_string
+        self.dictionary = dictionary
+        self.validity = True if has_validity else None  # presence marker only
+
+
+class _PredTableFacade:
+    def __init__(self, num_rows: int, cols: dict):
+        self.num_rows = num_rows
+        self._cols = cols
+
+    def column(self, name: str):
+        return self._cols[name]
+
+
+# Compiled predicates, LRU-capped. Key pins EVERYTHING the trace depends on:
+# the expression (repr is structural + literal-valued), the row count, and per
+# referenced spelling the dtype / stringness / dictionary identity / validity
+# presence; dictionary liveness is re-verified by weakref on every hit.
+from collections import OrderedDict as _OrderedDict
+
+_PRED_CACHE: "_OrderedDict[tuple, tuple]" = _OrderedDict()
+_PRED_CACHE_MAX = 256
+_PRED_UNCACHEABLE: set = set()  # expr reprs whose trace failed (e.g. str-str compare)
+
+
+def _evaluate_predicate_eager(expr: Expr, table: Table) -> jnp.ndarray:
     v = evaluate(expr, table, {})
     if v.kind != "num" or v.arr.dtype != jnp.bool_:
         raise HyperspaceException(f"Not a boolean predicate: {expr!r}")
     if v.valid is None:
         return v.arr
     return jnp.logical_and(v.arr, v.valid)
+
+
+def _build_pred_fn(expr: Expr, facade: _PredTableFacade, spellings: list):
+    import jax
+
+    def fn(*flat):
+        devcols = {}
+        i = 0
+        for sp, has_valid in spellings:
+            devcols[sp] = flat[i]
+            i += 1
+            if has_valid:
+                devcols[f"__valid__{sp}"] = flat[i]
+                i += 1
+        v = evaluate(expr, facade, devcols)
+        if v.kind != "num" or v.arr.dtype != jnp.bool_:
+            raise HyperspaceException(f"Not a boolean predicate: {expr!r}")
+        if v.valid is None:
+            return v.arr
+        return jnp.logical_and(v.arr, v.valid)
+
+    return jax.jit(fn)
+
+
+def evaluate_predicate(expr: Expr, table: Table) -> jnp.ndarray:
+    """Evaluate a boolean expression over a table → device mask. A row survives
+    only when the predicate is TRUE and KNOWN (SQL WHERE drops unknowns).
+
+    Runs as ONE compiled program per (expression, table signature): eager
+    evaluation issues one dispatch per operator, and on a remote PJRT
+    transport each dispatch is a round-trip. Expressions whose evaluation
+    needs host access to the data (cross-column string compares) fall back to
+    the eager path permanently."""
+    import weakref
+
+    r = repr(expr)
+    if r in _PRED_UNCACHEABLE:
+        return _evaluate_predicate_eager(expr, table)
+    try:
+        spellings = _collect_col_spellings(expr)
+        sig = []
+        metas = {}
+        dict_refs = []
+        for sp in spellings:
+            col = table.column(sp)
+            has_valid = col.validity is not None
+            is_str = col.is_string
+            sig.append(
+                (
+                    sp,
+                    str(np.asarray(col.data).dtype),
+                    is_str,
+                    id(col.dictionary) if is_str else None,
+                    has_valid,
+                )
+            )
+            metas[sp] = _PredColMeta(is_str, col.dictionary, has_valid)
+            if is_str:
+                dict_refs.append((sp, weakref.ref(col.dictionary)))
+        key = (r, table.num_rows, tuple(sig))
+    except Exception:
+        return _evaluate_predicate_eager(expr, table)
+
+    ent = _PRED_CACHE.get(key)
+    if ent is not None:
+        fn, refs, sp_flags = ent
+        if all(wr() is table.column(sp).dictionary for sp, wr in refs):
+            _PRED_CACHE.move_to_end(key)
+        else:
+            _PRED_CACHE.pop(key, None)
+            ent = None
+    if ent is None:
+        facade = _PredTableFacade(table.num_rows, metas)
+        sp_flags = [(sp, metas[sp].validity is not None) for sp in spellings]
+        fn = _build_pred_fn(expr, facade, sp_flags)
+        _PRED_CACHE[key] = (fn, dict_refs, sp_flags)
+        while len(_PRED_CACHE) > _PRED_CACHE_MAX:
+            _PRED_CACHE.popitem(last=False)
+    else:
+        fn, _, sp_flags = ent
+
+    from .device_cache import device_array
+
+    flat = []
+    for sp, has_valid in sp_flags:
+        col = table.column(sp)
+        flat.append(device_array(col.data))
+        if has_valid:
+            flat.append(device_array(col.validity))
+    try:
+        return fn(*flat)
+    except Exception:
+        # Trace-time host access (e.g. str-str column compare) or any other
+        # jit failure: permanent eager fallback for this expression shape.
+        _PRED_UNCACHEABLE.add(r)
+        _PRED_CACHE.pop(key, None)
+        return _evaluate_predicate_eager(expr, table)
