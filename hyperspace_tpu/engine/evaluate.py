@@ -258,6 +258,15 @@ def evaluate_column(expr: Expr, table: Table) -> Column:
     executor). Invalid slots are re-filled with the canonical zero so downstream
     hashing/grouping over computed columns keeps the nulls-cluster invariant."""
     n = table.num_rows
+    out = _compiled_eval(expr, table, "value")
+    if out is not None:
+        arr = np.asarray(out["arr"])
+        valid = None if "valid" not in out else np.asarray(out["valid"], dtype=bool)
+        if valid is not None and not valid.all():
+            arr = np.where(valid, arr, np.zeros((), dtype=arr.dtype))
+        from .schema import dtype_from_numpy
+
+        return Column(dtype_from_numpy(arr.dtype), arr, None, valid)
     v = evaluate(expr, table, {})
     if v.kind == "str":
         codes = np.asarray(v.arr, dtype=np.int32)
@@ -355,7 +364,10 @@ def _evaluate_predicate_eager(expr: Expr, table: Table) -> jnp.ndarray:
     return jnp.logical_and(v.arr, v.valid)
 
 
-def _build_pred_fn(expr: Expr, facade: _PredTableFacade, spellings: list):
+def _build_compiled_fn(expr: Expr, facade: _PredTableFacade, spellings: list, mode: str):
+    """mode="pred": boolean mask with unknowns dropped. mode="value": the raw
+    numeric result as {"arr": ..., ["valid": ...]} (structure is deterministic
+    per cache key, so callers can branch on membership)."""
     import jax
 
     def fn(*flat):
@@ -368,29 +380,33 @@ def _build_pred_fn(expr: Expr, facade: _PredTableFacade, spellings: list):
                 devcols[f"__valid__{sp}"] = flat[i]
                 i += 1
         v = evaluate(expr, facade, devcols)
-        if v.kind != "num" or v.arr.dtype != jnp.bool_:
-            raise HyperspaceException(f"Not a boolean predicate: {expr!r}")
-        if v.valid is None:
-            return v.arr
-        return jnp.logical_and(v.arr, v.valid)
+        if mode == "pred":
+            if v.kind != "num" or v.arr.dtype != jnp.bool_:
+                raise HyperspaceException(f"Not a boolean predicate: {expr!r}")
+            if v.valid is None:
+                return v.arr
+            return jnp.logical_and(v.arr, v.valid)
+        if v.kind != "num" or v.arr.ndim == 0:
+            # String/literal results (host packaging) stay on the eager path.
+            raise HyperspaceException("uncompilable value expression")
+        out = {"arr": v.arr}
+        if v.valid is not None:
+            out["valid"] = v.valid
+        return out
 
     return jax.jit(fn)
 
 
-def evaluate_predicate(expr: Expr, table: Table) -> jnp.ndarray:
-    """Evaluate a boolean expression over a table → device mask. A row survives
-    only when the predicate is TRUE and KNOWN (SQL WHERE drops unknowns).
-
-    Runs as ONE compiled program per (expression, table signature): eager
-    evaluation issues one dispatch per operator, and on a remote PJRT
-    transport each dispatch is a round-trip. Expressions whose evaluation
-    needs host access to the data (cross-column string compares) fall back to
-    the eager path permanently."""
+def _compiled_eval(expr: Expr, table: Table, mode: str):
+    """Run `expr` over `table` as ONE compiled program per (mode, expression,
+    table signature); None when this expression shape must stay eager (e.g.
+    host access during trace: cross-column string compares, string/literal
+    value results)."""
     import weakref
 
-    r = repr(expr)
+    r = (mode, repr(expr))
     if r in _PRED_UNCACHEABLE:
-        return _evaluate_predicate_eager(expr, table)
+        return None
     try:
         spellings = _collect_col_spellings(expr)
         sig = []
@@ -414,7 +430,7 @@ def evaluate_predicate(expr: Expr, table: Table) -> jnp.ndarray:
                 dict_refs.append((sp, weakref.ref(col.dictionary)))
         key = (r, table.num_rows, tuple(sig))
     except Exception:
-        return _evaluate_predicate_eager(expr, table)
+        return None
 
     ent = _PRED_CACHE.get(key)
     if ent is not None:
@@ -427,7 +443,7 @@ def evaluate_predicate(expr: Expr, table: Table) -> jnp.ndarray:
     if ent is None:
         facade = _PredTableFacade(table.num_rows, metas)
         sp_flags = [(sp, metas[sp].validity is not None) for sp in spellings]
-        fn = _build_pred_fn(expr, facade, sp_flags)
+        fn = _build_compiled_fn(expr, facade, sp_flags, mode)
         _PRED_CACHE[key] = (fn, dict_refs, sp_flags)
         while len(_PRED_CACHE) > _PRED_CACHE_MAX:
             _PRED_CACHE.popitem(last=False)
@@ -445,8 +461,21 @@ def evaluate_predicate(expr: Expr, table: Table) -> jnp.ndarray:
     try:
         return fn(*flat)
     except Exception:
-        # Trace-time host access (e.g. str-str column compare) or any other
-        # jit failure: permanent eager fallback for this expression shape.
+        # Trace-time host access or any other jit failure: permanent eager
+        # fallback for this (mode, expression) shape.
         _PRED_UNCACHEABLE.add(r)
         _PRED_CACHE.pop(key, None)
-        return _evaluate_predicate_eager(expr, table)
+        return None
+
+
+def evaluate_predicate(expr: Expr, table: Table) -> jnp.ndarray:
+    """Evaluate a boolean expression over a table → device mask. A row survives
+    only when the predicate is TRUE and KNOWN (SQL WHERE drops unknowns).
+
+    Runs as ONE compiled program per (expression, table signature): eager
+    evaluation issues one dispatch per operator, and on a remote PJRT
+    transport each dispatch is a round-trip."""
+    out = _compiled_eval(expr, table, "pred")
+    if out is not None:
+        return out
+    return _evaluate_predicate_eager(expr, table)
