@@ -363,3 +363,37 @@ def test_device_array_memo_budget_and_identity():
         dc._BUDGET, dc._bytes = saved_budget, saved_bytes
         dc._cache.clear()
         dc._cache.update(saved_cache)
+
+
+def test_compiled_predicate_cache_hits_and_str_fallback(tmp_path):
+    """evaluate_predicate compiles one program per expression shape, hits the
+    cache on repeats, and permanently falls back for trace-unsafe shapes
+    (cross-column string compares) without breaking correctness."""
+    import numpy as np
+
+    import hyperspace_tpu.engine.evaluate as ev
+    from hyperspace_tpu.engine import HyperspaceSession, col
+
+    s = HyperspaceSession(warehouse=str(tmp_path))
+    s.write_parquet(
+        {
+            "a": np.arange(500, dtype=np.int64),
+            "s1": np.array([f"x{i % 5}" for i in range(500)]),
+            "s2": np.array([f"x{i % 3}" for i in range(500)]),
+        },
+        str(tmp_path / "t"),
+    )
+    df = s.read.parquet(str(tmp_path / "t"))
+    n0 = len(ev._PRED_CACHE)
+    q = df.filter((col("a") > 100) & (col("a") < 400))
+    assert q.count() == 299
+    assert len(ev._PRED_CACHE) == n0 + 1
+    assert q.count() == 299  # second run: cache hit, no new entry
+    assert len(ev._PRED_CACHE) == n0 + 1
+
+    # Cross-column string compare: permanent eager fallback, correct result.
+    u0 = len(ev._PRED_UNCACHEABLE)
+    got = df.filter(col("s1") == col("s2")).count()
+    oracle = sum(1 for i in range(500) if f"x{i % 5}" == f"x{i % 3}")
+    assert got == oracle
+    assert len(ev._PRED_UNCACHEABLE) > u0
