@@ -620,10 +620,43 @@ class LimitExec(PhysicalNode):
         return (self.child,)
 
     def execute(self, ctx) -> Table:
-        t = self.child.execute(ctx)
+        t = self._scan_prefix(ctx)
+        if t is None:
+            t = self.child.execute(ctx)
         if t.num_rows <= self.n:
             return t
         return t.take(np.arange(self.n))
+
+    def _scan_prefix(self, ctx) -> Optional[Table]:
+        """Limit directly over a plain multi-file scan: stop reading files once
+        `n` rows are in hand (parquet footers give per-file counts for free) —
+        the interactive `show()`/head path must not decode a whole table."""
+        child = self.child
+        if not isinstance(child, ScanExec):
+            return None
+        rel = child.relation
+        if (
+            rel.hybrid_append is not None
+            or rel.bucket_spec is not None
+            or rel.partition_spec is not None
+            or rel.file_format not in ("parquet", "delta")
+            or len(rel.files) <= 1
+        ):
+            return None
+        picked, total = [], 0
+        for f in rel.files:
+            picked.append(f)
+            cnt = _footer_row_count([f], rel.file_format)
+            if cnt is None:
+                return None  # unreadable footer: take the generic path
+            total += cnt
+            if total >= self.n:
+                break
+        if len(picked) == len(rel.files):
+            return None  # needs every file anyway
+        return engine_io.read_files(
+            [f.path for f in picked], rel.file_format, child.columns
+        )
 
     def execute_count(self, ctx) -> int:
         return min(self.n, self.child.execute_count(ctx))

@@ -397,3 +397,35 @@ def test_compiled_predicate_cache_hits_and_str_fallback(tmp_path):
     oracle = sum(1 for i in range(500) if f"x{i % 5}" == f"x{i % 3}")
     assert got == oracle
     assert len(ev._PRED_UNCACHEABLE) > u0
+
+
+def test_limit_over_multifile_scan_reads_prefix_only(tmp_path):
+    """Limit directly over a plain multi-file parquet scan stops reading files
+    once n rows are in hand (footer counts), and results match the full path."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from hyperspace_tpu.engine import HyperspaceSession
+    from hyperspace_tpu.engine.scan_cache import global_scan_cache
+
+    s = HyperspaceSession(warehouse=str(tmp_path))
+    d = tmp_path / "t"
+    d.mkdir()
+    for i in range(6):
+        pq.write_table(
+            pa.table({"x": pa.array(range(i * 100, i * 100 + 100), type=pa.int64())}),
+            str(d / f"part-{i:05d}.parquet"),
+        )
+    df = s.read.parquet(str(d))
+    sc = global_scan_cache()
+    m0 = sc.misses
+    t = df.limit(150).collect()
+    assert t.num_rows == 150
+    assert [r[0] for r in t.rows()][:3] == [0, 1, 2]
+    # Only the first two files were decoded (2 misses), not all six.
+    assert sc.misses - m0 <= 2, sc.misses - m0
+    # Full read still fine and larger.
+    assert df.count() == 600
+    # limit >= total: generic path, all rows.
+    assert df.limit(10_000).collect().num_rows == 600
