@@ -628,10 +628,15 @@ class LimitExec(PhysicalNode):
         return t.take(np.arange(self.n))
 
     def _scan_prefix(self, ctx) -> Optional[Table]:
-        """Limit directly over a plain multi-file scan: stop reading files once
-        `n` rows are in hand (parquet footers give per-file counts for free) —
-        the interactive `show()`/head path must not decode a whole table."""
+        """Limit directly over a plain multi-file scan (optionally through a
+        projection — it preserves row count): stop reading files once `n` rows
+        are in hand (parquet footers give per-file counts for free) — the
+        interactive `show()`/head path must not decode a whole table."""
         child = self.child
+        project = None
+        if isinstance(child, ProjectExec):
+            project = child
+            child = child.child
         if not isinstance(child, ScanExec):
             return None
         rel = child.relation
@@ -654,9 +659,10 @@ class LimitExec(PhysicalNode):
                 break
         if len(picked) == len(rel.files):
             return None  # needs every file anyway
-        return engine_io.read_files(
+        t = engine_io.read_files(
             [f.path for f in picked], rel.file_format, child.columns
         )
+        return t.select(project.column_names) if project is not None else t
 
     def execute_count(self, ctx) -> int:
         return min(self.n, self.child.execute_count(ctx))

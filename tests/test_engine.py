@@ -429,3 +429,31 @@ def test_limit_over_multifile_scan_reads_prefix_only(tmp_path):
     assert df.count() == 600
     # limit >= total: generic path, all rows.
     assert df.limit(10_000).collect().num_rows == 600
+
+
+def test_limit_prefix_through_projection(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from hyperspace_tpu.engine import HyperspaceSession
+    from hyperspace_tpu.engine.scan_cache import global_scan_cache
+
+    s = HyperspaceSession(warehouse=str(tmp_path))
+    d = tmp_path / "t"
+    d.mkdir()
+    for i in range(5):
+        pq.write_table(
+            pa.table(
+                {
+                    "x": pa.array(range(i * 50, i * 50 + 50), type=pa.int64()),
+                    "y": pa.array([i] * 50, type=pa.int64()),
+                }
+            ),
+            str(d / f"part-{i:05d}.parquet"),
+        )
+    df = s.read.parquet(str(d)).select("y", "x")
+    m0 = global_scan_cache().misses
+    t = df.limit(60).collect()
+    assert t.num_rows == 60
+    assert t.column_names == ["y", "x"]  # projection order preserved
+    assert global_scan_cache().misses - m0 <= 2
