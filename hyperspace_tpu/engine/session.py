@@ -93,6 +93,24 @@ class DataFrame:
     def limit(self, n: int) -> "DataFrame":
         return DataFrame(self.session, LimitNode(n, self.plan))
 
+    def union(self, other: "DataFrame") -> "DataFrame":
+        """Row union (UNION ALL) of same-schema frames — the same UnionNode the
+        Hybrid Scan merge uses; use `.distinct()` after for set-union."""
+        from .logical import UnionNode
+
+        return DataFrame(self.session, UnionNode([self.plan, other.plan]))
+
+    unionAll = union
+
+    def drop(self, *columns: str) -> "DataFrame":
+        """Project away the named columns (missing names are ignored, like
+        Spark's drop)."""
+        gone = {c.lower() for c in columns}
+        keep = [n for n in self.plan.output_schema.names if n.lower() not in gone]
+        if not keep:
+            raise HyperspaceException("drop() would remove every column")
+        return self.select(keep)
+
     def distinct(self) -> "DataFrame":
         """Row dedup = GROUP BY every column with no aggregates (rides the same
         device hash-sort/segment kernel as aggregation)."""

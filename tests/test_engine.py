@@ -457,3 +457,41 @@ def test_limit_prefix_through_projection(tmp_path):
     assert t.num_rows == 60
     assert t.column_names == ["y", "x"]  # projection order preserved
     assert global_scan_cache().misses - m0 <= 2
+
+
+def test_dataframe_union_and_drop(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from hyperspace_tpu.engine import HyperspaceSession, col
+    from hyperspace_tpu.exceptions import HyperspaceException
+    import pytest as _pytest
+
+    s = HyperspaceSession(warehouse=str(tmp_path))
+    for name, lo in (("a", 0), ("b", 100)):
+        d = tmp_path / name
+        d.mkdir()
+        pq.write_table(
+            pa.table(
+                {
+                    "k": pa.array(range(lo, lo + 10), type=pa.int64()),
+                    "v": pa.array([name] * 10),
+                }
+            ),
+            str(d / "part-0.parquet"),
+        )
+    da = s.read.parquet(str(tmp_path / "a"))
+    db = s.read.parquet(str(tmp_path / "b"))
+    u = da.union(db)
+    assert u.count() == 20
+    assert sorted(r[0] for r in u.select("k").collect().rows()) == list(range(10)) + list(range(100, 110))
+    # union + filter + distinct compose
+    assert da.union(da).distinct().count() == 10
+    # drop
+    assert da.drop("v").schema.names == ["k"]
+    assert da.drop("nosuch").schema.names == ["k", "v"]  # missing ignored
+    with _pytest.raises(HyperspaceException):
+        da.drop("k", "v")
+    # mismatched schemas refuse
+    with _pytest.raises(Exception):
+        da.union(db.select("k"))
