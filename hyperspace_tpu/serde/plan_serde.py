@@ -22,6 +22,7 @@ from ..engine.logical import (
     LimitNode,
     LogicalPlan,
     OrderByNode,
+    UnionNode,
     ProjectNode,
     ScanNode,
     SourceRelation,
@@ -173,6 +174,11 @@ def plan_to_dict(plan: LogicalPlan) -> Dict[str, Any]:
             "expr": expr_to_dict(plan.expr),
             "child": plan_to_dict(plan.child),
         }
+    if isinstance(plan, UnionNode):
+        return {
+            "t": "union",
+            "children": [plan_to_dict(c) for c in plan.children()],
+        }
     raise HyperspaceException(f"Cannot serialize plan node: {plan.simple_string()}")
 
 
@@ -205,6 +211,8 @@ def plan_from_dict(d: Dict[str, Any]) -> LogicalPlan:
         return WithColumnNode(
             d["name"], expr_from_dict(d["expr"]), plan_from_dict(d["child"])
         )
+    if t == "union":
+        return UnionNode([plan_from_dict(c) for c in d["children"]])
     raise HyperspaceException(f"Cannot deserialize plan tag: {t}")
 
 

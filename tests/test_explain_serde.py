@@ -239,3 +239,24 @@ class TestCacheFactory:
 
         with pytest.raises(HyperspaceException, match="cache type"):
             IndexCacheFactory.create("NOPE", session)
+
+
+def test_union_plan_round_trip(tmp_path):
+    """UnionNode serde (publicly reachable via df.union)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from hyperspace_tpu.engine import HyperspaceSession, col
+    from hyperspace_tpu.serde.plan_serde import deserialize_plan, serialize_plan
+
+    s = HyperspaceSession(warehouse=str(tmp_path))
+    d = tmp_path / "t"
+    d.mkdir()
+    pq.write_table(
+        pa.table({"k": pa.array([1, 2, 3], type=pa.int64())}),
+        str(d / "part-0.parquet"),
+    )
+    df = s.read.parquet(str(d))
+    plan = df.filter(col("k") > 1).union(df).plan
+    rt = deserialize_plan(serialize_plan(plan))
+    assert rt.tree_string() == plan.tree_string()
