@@ -8,6 +8,7 @@ host arrays — which are themselves owned by the scan cache)."""
 from __future__ import annotations
 
 import os
+import threading
 import weakref
 
 import jax.numpy as jnp
@@ -19,6 +20,9 @@ _cache: dict = {}  # id(host) -> (weakref, device_array); insertion order = LRU
 # memo can never approach HBM capacity on its own.
 _BUDGET = int(os.environ.get("HYPERSPACE_UPLOAD_CACHE_BUDGET", 4 << 30))
 _bytes = 0
+# Concurrent queries (thread-local active sessions) interleave on this memo;
+# RLock because weakref eviction callbacks can fire inside guarded sections.
+_lock = threading.RLock()
 
 
 def _evict_over_budget(protect_key) -> None:
@@ -38,10 +42,11 @@ def device_array(host: np.ndarray):
     if not isinstance(host, np.ndarray):
         return jnp.asarray(host)
     key = id(host)
-    hit = _cache.get(key)
-    if hit is not None and hit[0]() is host:
-        _cache[key] = _cache.pop(key)  # LRU refresh
-        return hit[1]
+    with _lock:
+        hit = _cache.get(key)
+        if hit is not None and hit[0]() is host:
+            _cache[key] = _cache.pop(key)  # LRU refresh
+            return hit[1]
 
     dev = jnp.asarray(host)
 
@@ -49,18 +54,23 @@ def device_array(host: np.ndarray):
         # Only drop the entry this weakref installed: a dead array's id can be
         # reused by a new array before the deferred callback runs.
         global _bytes
-        ent_now = _cache.get(key)
-        if ent_now is not None and ent_now[0] is wr:
-            _cache.pop(key, None)
-            _bytes -= int(ent_now[1].nbytes)
+        with _lock:
+            ent_now = _cache.get(key)
+            if ent_now is not None and ent_now[0] is wr:
+                _cache.pop(key, None)
+                _bytes -= int(ent_now[1].nbytes)
 
     try:
         ref = weakref.ref(host, _evict)
     except TypeError:
         return dev  # non-weakref-able subclass: skip caching
-    if hit is not None:
-        _bytes -= int(hit[1].nbytes)  # displaced stale entry leaves accounting
-    _cache[key] = (ref, dev)
-    _bytes += int(dev.nbytes)
-    _evict_over_budget(key)
+    with _lock:
+        hit = _cache.get(key)  # re-read: another thread may have inserted
+        if hit is not None:
+            if hit[0]() is host:
+                return hit[1]  # raced: reuse the first upload, drop ours
+            _bytes -= int(hit[1].nbytes)  # displaced stale entry leaves accounting
+        _cache[key] = (ref, dev)
+        _bytes += int(dev.nbytes)
+        _evict_over_budget(key)
     return dev

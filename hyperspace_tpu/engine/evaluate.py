@@ -349,10 +349,13 @@ class _PredTableFacade:
 # referenced spelling the dtype / stringness / dictionary identity / validity
 # presence; dictionary liveness is re-verified by weakref on every hit.
 from collections import OrderedDict as _OrderedDict
+import threading as _threading
 
 _PRED_CACHE: "_OrderedDict[tuple, tuple]" = _OrderedDict()
 _PRED_CACHE_MAX = 256
 _PRED_UNCACHEABLE: set = set()  # expr reprs whose trace failed (e.g. str-str compare)
+_PRED_UNCACHEABLE_MAX = 1024  # bounded: a workload of one-off exprs must not grow it forever
+_pred_lock = _threading.RLock()  # concurrent queries share the compiled-predicate memo
 
 
 def _evaluate_predicate_eager(expr: Expr, table: Table) -> jnp.ndarray:
@@ -405,8 +408,9 @@ def _compiled_eval(expr: Expr, table: Table, mode: str):
     import weakref
 
     r = (mode, repr(expr))
-    if r in _PRED_UNCACHEABLE:
-        return None
+    with _pred_lock:
+        if r in _PRED_UNCACHEABLE:
+            return None
     try:
         spellings = _collect_col_spellings(expr)
         sig = []
@@ -432,23 +436,24 @@ def _compiled_eval(expr: Expr, table: Table, mode: str):
     except Exception:
         return None
 
-    ent = _PRED_CACHE.get(key)
-    if ent is not None:
-        fn, refs, sp_flags = ent
-        if all(wr() is table.column(sp).dictionary for sp, wr in refs):
-            _PRED_CACHE.move_to_end(key)
+    with _pred_lock:
+        ent = _PRED_CACHE.get(key)
+        if ent is not None:
+            fn, refs, sp_flags = ent
+            if all(wr() is table.column(sp).dictionary for sp, wr in refs):
+                _PRED_CACHE.move_to_end(key)
+            else:
+                _PRED_CACHE.pop(key, None)
+                ent = None
+        if ent is None:
+            facade = _PredTableFacade(table.num_rows, metas)
+            sp_flags = [(sp, metas[sp].validity is not None) for sp in spellings]
+            fn = _build_compiled_fn(expr, facade, sp_flags, mode)
+            _PRED_CACHE[key] = (fn, dict_refs, sp_flags)
+            while len(_PRED_CACHE) > _PRED_CACHE_MAX:
+                _PRED_CACHE.popitem(last=False)
         else:
-            _PRED_CACHE.pop(key, None)
-            ent = None
-    if ent is None:
-        facade = _PredTableFacade(table.num_rows, metas)
-        sp_flags = [(sp, metas[sp].validity is not None) for sp in spellings]
-        fn = _build_compiled_fn(expr, facade, sp_flags, mode)
-        _PRED_CACHE[key] = (fn, dict_refs, sp_flags)
-        while len(_PRED_CACHE) > _PRED_CACHE_MAX:
-            _PRED_CACHE.popitem(last=False)
-    else:
-        fn, _, sp_flags = ent
+            fn, _, sp_flags = ent
 
     from .device_cache import device_array
 
@@ -460,11 +465,32 @@ def _compiled_eval(expr: Expr, table: Table, mode: str):
             flat.append(device_array(col.validity))
     try:
         return fn(*flat)
-    except Exception:
-        # Trace-time host access or any other jit failure: permanent eager
-        # fallback for this (mode, expression) shape.
-        _PRED_UNCACHEABLE.add(r)
-        _PRED_CACHE.pop(key, None)
+    except Exception as e:
+        # Fall back to the eager path for THIS call; permanently blacklist the
+        # (mode, expression) shape only for trace-time failures (host access
+        # during trace: TracerError/concretization). A transient device/relay
+        # error must not disable compilation for the shape forever.
+        import jax
+
+        trace_time = isinstance(
+            e,
+            (
+                jax.errors.TracerArrayConversionError,
+                jax.errors.ConcretizationTypeError,
+                jax.errors.TracerBoolConversionError,
+                HyperspaceException,
+                TypeError,
+            ),
+        )
+        with _pred_lock:
+            _PRED_CACHE.pop(key, None)
+            if trace_time:
+                if len(_PRED_UNCACHEABLE) >= _PRED_UNCACHEABLE_MAX:
+                    # Bounded: evict an arbitrary old entry rather than refuse
+                    # the new one (a refused shape would re-trace and re-fail
+                    # on every call — the exact cost the blacklist avoids).
+                    _PRED_UNCACHEABLE.pop()
+                _PRED_UNCACHEABLE.add(r)
         return None
 
 

@@ -174,20 +174,48 @@ class UnionNode(LogicalPlan):
     appended source files)."""
 
     def __init__(self, children: Sequence[LogicalPlan]):
+        import numpy as _np
+
+        from .schema import Field, Schema, dtype_from_numpy
+
         self._children = list(children)
-        first = self._children[0].output_schema.names
+        first = self._children[0].output_schema
+        numeric = {"int32", "int64", "float32", "float64", "bool"}
+        dtypes = [f.dtype for f in first.fields]
         for c in self._children[1:]:
-            if [n.lower() for n in c.output_schema.names] != [n.lower() for n in first]:
+            sch = c.output_schema
+            if [n.lower() for n in sch.names] != [n.lower() for n in first.names]:
                 raise ValueError(
-                    f"Union children schemas differ: {first} vs {c.output_schema.names}"
+                    f"Union children schemas differ: {first.names} vs {sch.names}"
                 )
+            for i, (fa, fb) in enumerate(zip(first.fields, sch.fields)):
+                # Same-name columns must be type-compatible: numeric widths may
+                # differ (concat promotes — the declared schema promotes with
+                # them), but string-vs-numeric is a schema error here, not an
+                # obscure concat failure later.
+                if fa.dtype != fb.dtype:
+                    if not (fa.dtype in numeric and fb.dtype in numeric):
+                        raise ValueError(
+                            f"Union column {fa.name!r} type mismatch: "
+                            f"{fa.dtype} vs {fb.dtype}"
+                        )
+                    dtypes[i] = dtype_from_numpy(
+                        _np.promote_types(
+                            _np.dtype(dtypes[i]), _np.dtype(fb.dtype)
+                        )
+                    )
+        self._schema = Schema(
+            [Field(f.name, d) for f, d in zip(first.fields, dtypes)]
+        )
 
     def children(self):
         return tuple(self._children)
 
     @property
     def output_schema(self) -> Schema:
-        return self._children[0].output_schema
+        # Numeric widths promote across children (concat promotes the data, so
+        # the declared schema must agree).
+        return self._schema
 
     def with_children(self, children):
         return UnionNode(children)

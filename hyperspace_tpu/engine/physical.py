@@ -779,6 +779,14 @@ _verify_cache: Dict[tuple, tuple] = {}
 _CACHES = {"k64": _key64_cache, "pad": _padded_cache, "ver": _verify_cache}
 _CACHE_TAGS = {id(_key64_cache): "k64", id(_padded_cache): "pad"}
 
+# Concurrent queries (thread-local active sessions) share these memos; the
+# byte accounting is read-modify-write and eviction iterates the recency dict,
+# so every mutation runs under one lock. RLock: weakref eviction callbacks can
+# fire re-entrantly inside guarded sections (e.g. during an insert).
+import threading as _threading
+
+_cache_lock = _threading.RLock()
+
 # Device-resident memo budget. The padded/key64 reps pin device memory (~2x key
 # bytes per join-key set) independent of the host-table scan caches, so they get
 # their own byte bound: least-recently-used TABLE entries are dropped when the
@@ -794,16 +802,18 @@ _device_cache_evictions = 0
 def device_cache_stats() -> Dict[str, int]:
     """Live device-memo accounting (bytes pinned, lifetime evictions) — consumed
     by the bench artifact so cache pressure is measured, not modeled."""
-    return {
-        "bytes": _device_cache_bytes,
-        "evictions": _device_cache_evictions,
-        "budget": _DEVICE_CACHE_BUDGET_BYTES,
-    }
+    with _cache_lock:
+        return {
+            "bytes": _device_cache_bytes,
+            "evictions": _device_cache_evictions,
+            "budget": _DEVICE_CACHE_BUDGET_BYTES,
+        }
 
 
 def set_device_cache_budget(n_bytes: int) -> None:
     global _DEVICE_CACHE_BUDGET_BYTES
-    _DEVICE_CACHE_BUDGET_BYTES = int(n_bytes)
+    with _cache_lock:
+        _DEVICE_CACHE_BUDGET_BYTES = int(n_bytes)
 
 # Missing-vs-cached-None discriminator: build_dist_blocks legitimately returns
 # None (empty side), and that negative result must be a cache hit too.
@@ -815,8 +825,9 @@ _recency: Dict[tuple, None] = {}
 
 
 def _touch(tag, key) -> None:
-    _recency.pop((tag, key), None)
-    _recency[(tag, key)] = None
+    with _cache_lock:
+        _recency.pop((tag, key), None)
+        _recency[(tag, key)] = None
 
 
 def _entry_nbytes(tag: str, ent) -> int:
@@ -827,22 +838,24 @@ def _entry_nbytes(tag: str, ent) -> int:
 
 def _drop_entry(tag: str, key) -> None:
     global _device_cache_bytes
-    _recency.pop((tag, key), None)
-    dropped = _CACHES[tag].pop(key, None)
-    if dropped is not None:
-        _device_cache_bytes -= _entry_nbytes(tag, dropped)
+    with _cache_lock:
+        _recency.pop((tag, key), None)
+        dropped = _CACHES[tag].pop(key, None)
+        if dropped is not None:
+            _device_cache_bytes -= _entry_nbytes(tag, dropped)
 
 
 def _evict_over_budget(protect: tuple) -> None:
     """Evict the least-recently-used entry across ALL device caches until under
     budget, never evicting the entry just inserted (`protect`)."""
     global _device_cache_evictions
-    while _device_cache_bytes > _DEVICE_CACHE_BUDGET_BYTES:
-        victim = next((rk for rk in _recency if rk != protect), None)
-        if victim is None:
-            return
-        _drop_entry(*victim)
-        _device_cache_evictions += 1
+    with _cache_lock:
+        while _device_cache_bytes > _DEVICE_CACHE_BUDGET_BYTES:
+            victim = next((rk for rk in _recency if rk != protect), None)
+            if victim is None:
+                return
+            _drop_entry(*victim)
+            _device_cache_evictions += 1
 
 
 def _val_nbytes(val) -> int:
@@ -867,34 +880,40 @@ def _cached_by_table(cache: Dict[int, tuple], table: Table, subkey, compute):
     global _device_cache_bytes
     tag = _CACHE_TAGS[id(cache)]
     key = id(table)
-    ent = cache.get(key)
-    if ent is not None and ent[0]() is table:
-        hit = ent[1].get(subkey, _MISS)
-        if hit is not _MISS:
-            _touch(tag, key)
-            return hit
-    val = compute()
+    with _cache_lock:
+        ent = cache.get(key)
+        if ent is not None and ent[0]() is table:
+            hit = ent[1].get(subkey, _MISS)
+            if hit is not _MISS:
+                _touch(tag, key)
+                return hit
+    val = compute()  # outside the lock: device work must not serialize queries
     nbytes = _val_nbytes(val)
-    if ent is None or ent[0]() is not table:
-        if ent is not None:
-            # Stale id(table) reuse before the old weakref callback ran: the
-            # displaced entry's bytes must leave the accounting.
-            _device_cache_bytes -= _entry_nbytes(tag, ent)
+    with _cache_lock:
+        ent = cache.get(key)  # re-read: another thread may have raced compute()
+        if ent is None or ent[0]() is not table:
+            if ent is not None:
+                # Stale id(table) reuse before the old weakref callback ran: the
+                # displaced entry's bytes must leave the accounting.
+                _device_cache_bytes -= _entry_nbytes(tag, ent)
 
-        def _evict(wr, tag=tag, key=key):
-            # Only drop the entry this weakref installed: a dead table's id can
-            # be reused by a NEW table before this deferred callback runs, and
-            # the replacement entry must survive it.
-            ent_now = _CACHES[tag].get(key)
-            if ent_now is not None and ent_now[0] is wr:
-                _drop_entry(tag, key)
+            def _evict(wr, tag=tag, key=key):
+                # Only drop the entry this weakref installed: a dead table's id
+                # can be reused by a NEW table before this deferred callback
+                # runs, and the replacement entry must survive it.
+                ent_now = _CACHES[tag].get(key)
+                if ent_now is not None and ent_now[0] is wr:
+                    _drop_entry(tag, key)
 
-        cache[key] = (weakref.ref(table, _evict), {subkey: val})
-    else:
-        ent[1][subkey] = val
-    _device_cache_bytes += nbytes
-    _touch(tag, key)
-    _evict_over_budget((tag, key))
+            cache[key] = (weakref.ref(table, _evict), {subkey: val})
+            _device_cache_bytes += nbytes
+        elif subkey not in ent[1]:
+            ent[1][subkey] = val
+            _device_cache_bytes += nbytes
+        else:
+            val = ent[1][subkey]  # raced: keep the first insert's accounting
+        _touch(tag, key)
+        _evict_over_budget((tag, key))
     return val
 
 
@@ -907,10 +926,11 @@ def _aligned_key_codes(left: Table, right: Table, lkey: str, rkey: str):
 
     global _device_cache_bytes
     key = (id(left), id(right), lkey.lower(), rkey.lower())
-    ent = _verify_cache.get(key)
-    if ent is not None and ent[0]() is left and ent[1]() is right:
-        _touch("ver", key)
-        return ent[2]
+    with _cache_lock:
+        ent = _verify_cache.get(key)
+        if ent is not None and ent[0]() is left and ent[1]() is right:
+            _touch("ver", key)
+            return ent[2]
     lc, rc = align_dictionaries(left.column(lkey), right.column(rkey))
     la, ra = lc.data, rc.data
 
@@ -921,12 +941,19 @@ def _aligned_key_codes(left: Table, right: Table, lkey: str, rkey: str):
         if ent_now is not None and (ent_now[0] is wr or ent_now[1] is wr):
             _drop_entry("ver", key)
 
-    if ent is not None:
-        _device_cache_bytes -= _val_nbytes(ent[2])
-    _verify_cache[key] = (weakref.ref(left, _evict), weakref.ref(right, _evict), (la, ra))
-    _device_cache_bytes += _val_nbytes((la, ra))
-    _touch("ver", key)
-    _evict_over_budget(("ver", key))
+    with _cache_lock:
+        ent = _verify_cache.get(key)  # re-read under the lock
+        if ent is not None:
+            if ent[0]() is left and ent[1]() is right:
+                _touch("ver", key)
+                return ent[2]
+            _device_cache_bytes -= _val_nbytes(ent[2])
+        _verify_cache[key] = (
+            weakref.ref(left, _evict), weakref.ref(right, _evict), (la, ra)
+        )
+        _device_cache_bytes += _val_nbytes((la, ra))
+        _touch("ver", key)
+        _evict_over_budget(("ver", key))
     return la, ra
 
 
@@ -1168,21 +1195,34 @@ class SortMergeJoinExec(PhysicalNode):
 def _orient_join_keys(
     pairs: List[Tuple[str, str]], left_schema: Schema, right_schema: Schema
 ) -> Tuple[List[str], List[str]]:
+    """Orient each (a, b) condition pair as (left_col, right_col). A name
+    resolving on BOTH sides is refused loudly — the same rule as the join
+    rewrite's `_orient_pairs` (a silent left-to-right guess could join on the
+    wrong columns; the reference requires every condition attribute to resolve
+    to exactly one base relation, `JoinIndexRule.scala:287-326`)."""
     lkeys, rkeys = [], []
     for a, b in pairs:
         a_in_l, a_in_r = a in left_schema, a in right_schema
         b_in_l, b_in_r = b in left_schema, b in right_schema
-        if a_in_l and b_in_r and not (a_in_r and b_in_l):
+        if a.lower() == b.lower() and a_in_l and b_in_r:
+            # Same name on both operands: any orientation means
+            # left.name == right.name — unambiguous by construction.
             lkeys.append(a)
             rkeys.append(b)
-        elif a_in_r and b_in_l and not (a_in_l and b_in_r):
+        elif a_in_l and b_in_r and not (a_in_r or b_in_l):
+            lkeys.append(a)
+            rkeys.append(b)
+        elif a_in_r and b_in_l and not (a_in_l or b_in_r):
             lkeys.append(b)
             rkeys.append(a)
-        elif a_in_l and b_in_r:
-            # Ambiguous (name exists on both sides): default left-to-right.
-            lkeys.append(a)
-            rkeys.append(b)
+        elif (a_in_l and a_in_r) or (b_in_l and b_in_r):
+            raise HyperspaceException(
+                f"Ambiguous join condition column(s) {a!r}/{b!r}: a name "
+                "resolves on both sides; qualify by renaming before the join"
+            )
         else:
+            # Unresolvable, or both columns live on the same single side —
+            # the condition does not span the join.
             raise HyperspaceException(
                 f"Cannot resolve join condition column(s) {a!r}/{b!r}"
             )
@@ -1322,26 +1362,29 @@ def plan_physical(
             rspec = rbucket.relation.bucket_spec
             # A left key equated to two different right keys (l.a==r.x AND l.a==r.y)
             # cannot ride the bucketed path: bucketing covers only one of the pairs.
+            # Name matching honors the session's resolution mode via key()
+            # (in case-sensitive mode, columns differing only by case must
+            # not be conflated when deciding the no-shuffle path).
             pair_map: Dict[str, str] = {}
             consistent = True
             for l, r in zip(lkeys, rkeys):
-                if pair_map.get(l.lower(), r).lower() != r.lower():
+                if key(pair_map.get(key(l), r)) != key(r):
                     consistent = False
                     break
-                pair_map[l.lower()] = r
+                pair_map[key(l)] = r
             lbc = list(lspec.bucket_columns)
             rbc = list(rspec.bucket_columns)
             if (
                 consistent
-                and len(set(k.lower() for k in lkeys)) == len(lkeys)
+                and len(set(key(k) for k in lkeys)) == len(lkeys)
                 and lspec.num_buckets == rspec.num_buckets
-                and {c.lower() for c in lbc} == {k.lower() for k in lkeys}
-                and [pair_map.get(c.lower(), "").lower() for c in lbc]
-                == [c.lower() for c in rbc]
+                and {key(c) for c in lbc} == {key(k) for k in lkeys}
+                and [key(pair_map.get(key(c), "")) for c in lbc]
+                == [key(c) for c in rbc]
             ):
                 # Join keys in bucket-column order so per-bucket key hashing pairs up.
                 jl = lbc
-                jr = [pair_map[c.lower()] for c in lbc]
+                jr = [pair_map[key(c)] for c in lbc]
                 return SortMergeJoinExec(lphys, rphys, jl, jr, bucketed=True)
 
         # General path: exchange + sort both sides.

@@ -104,9 +104,17 @@ class DataFrame:
 
     def drop(self, *columns: str) -> "DataFrame":
         """Project away the named columns (missing names are ignored, like
-        Spark's drop)."""
-        gone = {c.lower() for c in columns}
-        keep = [n for n in self.plan.output_schema.names if n.lower() not in gone]
+        Spark's drop). Name matching honors `hyperspace.resolution.caseSensitive`
+        like the planner does."""
+        from ..util.resolver_utils import resolution_key
+
+        cs = self.session.hs_conf.case_sensitive
+        gone = {resolution_key(c, cs) for c in columns}
+        keep = [
+            n
+            for n in self.plan.output_schema.names
+            if resolution_key(n, cs) not in gone
+        ]
         if not keep:
             raise HyperspaceException("drop() would remove every column")
         return self.select(keep)

@@ -213,9 +213,10 @@ class Table:
                 codes = np.concatenate([_remap_codes(c, union) for c in cols])
                 out[n] = Column(STRING, codes, union, validity)
             else:
-                out[n] = Column(
-                    cols[0].dtype, np.concatenate([c.data for c in cols]), None, validity
-                )
+                data = np.concatenate([c.data for c in cols])
+                # Mixed numeric widths promote in the concatenate; the dtype
+                # label must describe the promoted data, not the first child.
+                out[n] = Column(dtype_from_numpy(data.dtype), data, None, validity)
         return Table(out)
 
     def __repr__(self):

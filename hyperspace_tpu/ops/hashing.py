@@ -83,6 +83,9 @@ def hash_device_values(arr, seed: np.uint32):
 # per query — weakref-keyed so entries die with their dictionaries (the same
 # id-reuse-safe pattern as the engine's device memo caches).
 _dict_hash_cache: dict = {}
+import threading as _threading
+
+_dict_hash_lock = _threading.RLock()  # concurrent queries share the memo
 
 
 def host_hash_dictionary(dictionary: np.ndarray, seed: int):
@@ -92,9 +95,10 @@ def host_hash_dictionary(dictionary: np.ndarray, seed: int):
     import weakref
 
     key = (id(dictionary), int(seed))
-    ent = _dict_hash_cache.get(key)
-    if ent is not None and ent[0]() is dictionary:
-        return ent[1]
+    with _dict_hash_lock:
+        ent = _dict_hash_cache.get(key)
+        if ent is not None and ent[0]() is dictionary:
+            return ent[1]
     out = np.empty(len(dictionary), dtype=np.uint32)
     seed_bytes = int(seed).to_bytes(4, "little")
     for i, s in enumerate(dictionary):
@@ -103,12 +107,14 @@ def host_hash_dictionary(dictionary: np.ndarray, seed: int):
     dev = jnp.asarray(out)
 
     def _evict(wr, key=key):
-        ent_now = _dict_hash_cache.get(key)
-        if ent_now is not None and ent_now[0] is wr:
-            _dict_hash_cache.pop(key, None)
+        with _dict_hash_lock:
+            ent_now = _dict_hash_cache.get(key)
+            if ent_now is not None and ent_now[0] is wr:
+                _dict_hash_cache.pop(key, None)
 
     try:
-        _dict_hash_cache[key] = (weakref.ref(dictionary, _evict), dev)
+        with _dict_hash_lock:
+            _dict_hash_cache[key] = (weakref.ref(dictionary, _evict), dev)
     except TypeError:
         pass  # non-weakref-able dictionary container: skip memoization
     return dev

@@ -495,3 +495,38 @@ def test_dataframe_union_and_drop(tmp_path):
     # mismatched schemas refuse
     with _pytest.raises(Exception):
         da.union(db.select("k"))
+
+
+def test_union_dtype_mismatch_raises(session, tmp_path):
+    """Same-named union columns with incompatible types fail loudly at plan
+    construction (the reference validates union schema compatibility), not with
+    an obscure concat error at execution."""
+    session.write_parquet({"k": np.arange(3, dtype=np.int64)}, str(tmp_path / "n"))
+    session.write_parquet({"k": np.array(["a", "b"])}, str(tmp_path / "s"))
+    dn = session.read.parquet(str(tmp_path / "n"))
+    ds = session.read.parquet(str(tmp_path / "s"))
+    with pytest.raises(ValueError, match="type mismatch"):
+        dn.union(ds)
+    # Numeric width differences still union (concat promotes).
+    session.write_parquet({"k": np.arange(3, dtype=np.int32)}, str(tmp_path / "n32"))
+    d32 = session.read.parquet(str(tmp_path / "n32"))
+    assert dn.union(d32).count() == 6
+
+
+def test_ambiguous_join_orientation_refused(session, tmp_path):
+    """DIFFERENT condition names that each resolve on both sides are refused
+    loudly (never silently oriented left-to-right); the SAME name on both
+    operands stays legal — left.name == right.name is unambiguous."""
+    session.write_parquet(
+        {"k": np.arange(4, dtype=np.int64), "x": np.arange(4, dtype=np.int64)},
+        str(tmp_path / "l"),
+    )
+    session.write_parquet(
+        {"k": np.arange(4, dtype=np.int64), "x": np.arange(4, dtype=np.int64)},
+        str(tmp_path / "r"),
+    )
+    dl = session.read.parquet(str(tmp_path / "l"))
+    dr = session.read.parquet(str(tmp_path / "r"))
+    with pytest.raises(HyperspaceException, match="Ambiguous"):
+        dl.join(dr, col("k") == col("x")).count()
+    assert dl.join(dr, col("k") == col("k")).count() == 4
