@@ -321,6 +321,101 @@ def _host_aggregate(table: Table, group_keys, aggs: Sequence[AggTriple]) -> Tabl
     return Table(out)
 
 
+class DevCol:
+    """A device-resident virtual column for the fused join→aggregate pipeline:
+    jnp value array (codes for strings), host dictionary, optional jnp validity
+    lane. Duck-types the attrs `key64`/`_out_column` read from `Column`."""
+
+    __slots__ = ("dtype", "arr", "dictionary", "validity")
+
+    def __init__(self, dtype: str, arr, dictionary=None, validity=None):
+        self.dtype = dtype
+        self.arr = arr
+        self.dictionary = dictionary
+        self.validity = validity
+
+    @property
+    def is_string(self) -> bool:
+        return self.dtype == STRING
+
+
+def hash_aggregate_device(
+    cols: dict,
+    row_valid,
+    group_keys: Sequence[str],
+    aggs: Sequence[AggTriple],
+) -> Optional[Table]:
+    """GROUP BY over DEVICE-resident virtual columns (`DevCol`) — the aggregate
+    core of the fused bucketed-join→aggregate path. Same pipeline as
+    `hash_aggregate` (hash-sort, adjacent-ACTUAL-value boundaries, segment
+    reductions) but the input never materializes as a host table: only the
+    per-group results and representative key rows are pulled (n_groups-sized).
+
+    `row_valid` is an optional global validity lane: the fused join pads its
+    compacted pair arrays by REPEATING a real pair to keep shapes static, and
+    those pad slots must contribute to no aggregate (they can never form a
+    spurious group — they duplicate real key values). Returns None on the
+    astronomically-rare 64-bit hash collision split (caller recomputes exactly)."""
+    group_keys = list(group_keys)
+    key_cols = [cols[k] for k in group_keys]
+    k64 = key64(key_cols, [c.arr for c in key_cols])
+
+    flat = []
+    has_valid = []
+    for c in key_cols:
+        flat.append(c.arr)
+        has_valid.append(c.validity is not None)
+        if c.validity is not None:
+            flat.append(c.validity)
+    perm, boundary, gid = _group_ids_fused(tuple(has_valid), k64, *flat)
+    n_groups = int(gid[-1]) + 1
+
+    # Representative rows: one device compaction + tiny gathers, pulled host-side
+    # at n_groups size (never the full pair count).
+    rep_rows = perm[jnp.nonzero(boundary, size=n_groups)[0]]
+    rep_cols = {}
+    for k, c in zip(group_keys, key_cols):
+        data = np.asarray(c.arr[rep_rows])
+        v = None if c.validity is None else np.asarray(c.validity[rep_rows], bool)
+        if c.is_string:
+            codes = data.astype(np.int32)
+            if v is not None:
+                codes = np.where(v, codes, 0).astype(np.int32)
+            rep_cols[k] = Column(STRING, codes, c.dictionary, v)
+        else:
+            if v is not None:
+                data = np.where(v, data, np.zeros((), dtype=data.dtype))
+            rep_cols[k] = Column(c.dtype, data.astype(np.dtype(c.dtype)), None, v)
+    rep_table = Table(rep_cols)
+    if len(np.unique(_key_records(rep_table, group_keys))) != n_groups:
+        return None  # collision split: caller takes the exact path
+
+    out = dict(rep_cols)
+    for out_name, fn, col_name in aggs:
+        c = cols[col_name] if col_name is not None else None
+        dtype = result_dtype(fn, None if c is None else c.dtype)
+        if fn == "count" and c is None:
+            # count(*) counts surviving rows: the row_valid lane IS the data.
+            x = row_valid if row_valid is not None else k64
+            args = (x,) + ((row_valid,) if row_valid is not None else ())
+            _, n_valid = _seg_reduce_jit(
+                "count", n_groups, row_valid is not None, gid, perm, *args
+            )
+            out[out_name] = _out_column(fn, None, dtype, np.asarray(n_valid), None)
+            continue
+        v = c.validity
+        if row_valid is not None:
+            v = row_valid if v is None else (v & row_valid)
+        args = (c.arr,) + ((v,) if v is not None else ())
+        vals, n_valid = _seg_reduce_jit(fn, n_groups, v is not None, gid, perm, *args)
+        if fn == "count":
+            out[out_name] = _out_column(fn, None, dtype, np.asarray(n_valid), None)
+            continue
+        any_valid = np.asarray(n_valid) > 0
+        out[out_name] = _out_column(fn, c, dtype, np.asarray(vals), any_valid)
+    return Table(out)
+
+
 def hash_aggregate(table: Table, group_keys, aggs: Sequence[AggTriple]) -> Table:
     """GROUP BY `group_keys` computing `aggs` = [(out_name, fn, column|None)]."""
     group_keys = list(group_keys)

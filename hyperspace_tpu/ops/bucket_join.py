@@ -95,6 +95,66 @@ def _expand_np(
     return l_starts[b] + l_slot, r_starts[b] + r_slot
 
 
+@partial(jax.jit, static_argnums=(0, 1))
+def _expand_pairs_dev(
+    out_cap: int,
+    has_order: bool,
+    lo,
+    counts,
+    a_starts,
+    b_starts,
+    a_order,
+    b_order,
+):
+    """ON-DEVICE expansion of probe count ranges into global (a_row, b_row)
+    index pairs, padded to a static `out_cap` (pow2-quantized so repeat queries
+    reuse the compiled program). The host variant (`_expand_np`) materializes
+    the ranges with numpy; on a TPU the gathered pairs feed DEVICE consumers
+    (count, fused join+aggregate), so expanding on device avoids the
+    device->host->device round trip of the probe matrices entirely.
+
+    Standard searchsorted expansion: output position j belongs to the flat left
+    slot whose inclusive count prefix first exceeds j. Slots past `total` carry
+    garbage and are masked by the returned validity lane (gathers clamp)."""
+    cap_l = counts.shape[1]
+    counts_flat = counts.reshape(-1).astype(jnp.int64)
+    e = jnp.cumsum(counts_flat)  # inclusive prefix
+    total = e[-1]
+    j = jnp.arange(out_cap, dtype=jnp.int64)
+    src = jnp.searchsorted(e, j, side="right")
+    src = jnp.minimum(src, counts_flat.shape[0] - 1)
+    offset = j - (e[src] - counts_flat[src])
+    bkt = src // cap_l
+    a_slot = src % cap_l
+    b_slot = lo.reshape(-1).astype(jnp.int64)[src] + offset
+    if has_order:
+        a_slot = a_order[bkt, a_slot]
+        b_slot = b_order[bkt, jnp.clip(b_slot, 0, b_order.shape[1] - 1)]
+    ai = a_starts[bkt] + a_slot
+    bi = b_starts[bkt] + b_slot
+    return ai, bi, j < total
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _compact_pairs_dev(out_cap2: int, ai, bi, keep):
+    """Stream-compact verified pairs to a static pow2 size. Pad slots repeat
+    the FIRST kept pair (a real, verified pair), so downstream group detection
+    over gathered values cannot invent spurious groups — pad contributions are
+    masked out of every reduction by the `j < n_keep` lane the caller builds."""
+    pos = jnp.cumsum(keep.astype(jnp.int64)) - 1
+    idx = jnp.where(keep, pos, out_cap2)  # dropped -> out-of-bounds
+    a2 = jnp.zeros(out_cap2, ai.dtype).at[idx].set(ai, mode="drop")
+    b2 = jnp.zeros(out_cap2, bi.dtype).at[idx].set(bi, mode="drop")
+    a2 = jnp.where(jnp.arange(out_cap2) < pos[-1] + 1, a2, a2[0])
+    b2 = jnp.where(jnp.arange(out_cap2) < pos[-1] + 1, b2, b2[0])
+    return a2, b2
+
+
+@jax.jit
+def _counts_total(counts):
+    return counts.sum(dtype=jnp.int64)
+
+
 @partial(jax.jit, static_argnums=(2, 3))
 def _pad_only(vals, starts, num_buckets: int, cap: int, pad_value):
     """Scatter per-row values (concatenated in bucket order) into a padded [B, cap]

@@ -1,0 +1,296 @@
+"""Device-side count fast path + fused bucketed-join→aggregate pipeline.
+
+These pin the round-5 performance paths against the engine's own oracle (the
+reference's E2E contract: identical results with indexing on vs off,
+`E2EHyperspaceRulesTests.scala:454-470`). HYPERSPACE_FORCE_DEVICE_OPS=1 forces
+the device kernels on the CPU backend so CI certifies the exact programs a TPU
+runs (`ops/backend.py`)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import IndexConfig, IndexConstants
+from hyperspace_tpu.engine import HyperspaceSession, col
+from hyperspace_tpu.hyperspace import (
+    Hyperspace,
+    disable_hyperspace,
+    enable_hyperspace,
+)
+
+
+@pytest.fixture()
+def dev_session(tmp_path, monkeypatch):
+    monkeypatch.setenv("HYPERSPACE_FORCE_DEVICE_OPS", "1")
+    s = HyperspaceSession(warehouse=str(tmp_path))
+    s.conf.set(IndexConstants.INDEX_SYSTEM_PATH, str(tmp_path / "indexes"))
+    s.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 8)
+    return s
+
+
+def _rows_close(a, b, tol=1e-6):
+    assert len(a) == len(b), (len(a), len(b))
+    for ra, rb in zip(a, b):
+        for x, y in zip(ra, rb):
+            if isinstance(x, float):
+                assert abs(x - y) <= tol * max(1.0, abs(x)), (ra, rb)
+            else:
+                assert x == y, (ra, rb)
+
+
+def _fact_dim(s, base, n=20000, with_nulls=False):
+    rng = np.random.RandomState(11)
+    qty = rng.randint(1, 50, n).astype(np.int64)
+    price = rng.rand(n) * 100
+    if with_nulls:
+        price = price.astype(object)
+        price[::97] = None
+    s.write_parquet(
+        {
+            "k": rng.randint(0, 400, n).astype(np.int64),
+            "qty": qty,
+            "price": price,
+        },
+        os.path.join(base, "fact"),
+    )
+    s.write_parquet(
+        {
+            "dk": np.arange(400, dtype=np.int64),
+            "grp": np.array([f"g{i % 13:02d}" for i in range(400)]),
+        },
+        os.path.join(base, "dim"),
+    )
+
+
+def test_value_mode_device_count_matches_oracle(dev_session, tmp_path):
+    s = dev_session
+    base = str(tmp_path)
+    _fact_dim(s, base)
+    hs = Hyperspace(s)
+    hs.create_index(
+        s.read.parquet(os.path.join(base, "fact")), IndexConfig("cf", ["k"], ["qty"])
+    )
+    hs.create_index(
+        s.read.parquet(os.path.join(base, "dim")), IndexConfig("cd", ["dk"], ["grp"])
+    )
+
+    def q():
+        f = s.read.parquet(os.path.join(base, "fact"))
+        d = s.read.parquet(os.path.join(base, "dim"))
+        return f.join(d, col("k") == col("dk")).select("qty", "grp")
+
+    disable_hyperspace(s)
+    expected = q().count()
+    enable_hyperspace(s)
+    assert "cf" in q().explain_string()
+    assert q().count() == expected
+
+
+def test_hash_mode_device_count_string_keys_with_nulls(dev_session, tmp_path):
+    s = dev_session
+    base = str(tmp_path)
+    rng = np.random.RandomState(5)
+    sk = np.array([f"s{i % 60:02d}" for i in range(5000)], dtype=object)
+    sk[::113] = None  # null keys never match (SQL semantics)
+    s.write_parquet(
+        {"sk": sk, "v": rng.randint(0, 9, 5000).astype(np.int64)},
+        os.path.join(base, "ls"),
+    )
+    s.write_parquet(
+        {
+            "sk2": np.array([f"s{i:02d}" for i in range(80)]),
+            "w": np.arange(80, dtype=np.int64),
+        },
+        os.path.join(base, "rs"),
+    )
+    hs = Hyperspace(s)
+    hs.create_index(
+        s.read.parquet(os.path.join(base, "ls")), IndexConfig("hl", ["sk"], ["v"])
+    )
+    hs.create_index(
+        s.read.parquet(os.path.join(base, "rs")), IndexConfig("hr", ["sk2"], ["w"])
+    )
+
+    def q():
+        l = s.read.parquet(os.path.join(base, "ls"))
+        r = s.read.parquet(os.path.join(base, "rs"))
+        return l.join(r, col("sk") == col("sk2")).select("v", "w")
+
+    disable_hyperspace(s)
+    expected = q().count()
+    enable_hyperspace(s)
+    assert q().count() == expected
+    assert expected < 5000  # the null keys really dropped rows
+
+
+def test_fused_join_agg_matches_oracle(dev_session, tmp_path):
+    """Computed column + string group key + sum/count/min/max/avg with NULL
+    aggregate inputs, fused end-to-end on device vs the host oracle."""
+    s = dev_session
+    base = str(tmp_path)
+    _fact_dim(s, base, with_nulls=True)
+    hs = Hyperspace(s)
+    hs.create_index(
+        s.read.parquet(os.path.join(base, "fact")),
+        IndexConfig("af", ["k"], ["qty", "price"]),
+    )
+    hs.create_index(
+        s.read.parquet(os.path.join(base, "dim")), IndexConfig("ad", ["dk"], ["grp"])
+    )
+
+    def q():
+        f = s.read.parquet(os.path.join(base, "fact"))
+        d = s.read.parquet(os.path.join(base, "dim"))
+        return (
+            f.join(d, col("k") == col("dk"))
+            .with_column("rev", col("price") * col("qty"))
+            .group_by("grp")
+            .agg(
+                rev=("rev", "sum"),
+                n=("qty", "count"),
+                np_=("price", "count"),  # null-aware count
+                mn=("price", "min"),
+                mx=("price", "max"),
+                av=("price", "avg"),
+            )
+            .order_by(("grp", True))
+        )
+
+    disable_hyperspace(s)
+    expected = q().collect().sorted_rows()
+    enable_hyperspace(s)
+
+    from hyperspace_tpu.engine import physical as ph
+
+    fired = []
+    orig = ph.HashAggregateExec._try_fused_join_agg
+
+    def spy(self, ctx):
+        r = orig(self, ctx)
+        fired.append(r is not None)
+        return r
+
+    ph.HashAggregateExec._try_fused_join_agg = spy
+    try:
+        got = q().collect().sorted_rows()
+    finally:
+        ph.HashAggregateExec._try_fused_join_agg = orig
+    assert any(fired), "fused join→agg path did not fire"
+    _rows_close(got, expected)
+
+
+def test_fused_agg_group_by_join_key_under_filter(dev_session, tmp_path):
+    """Q14 shape: side filter below the join (bucket-preserving), grouping by a
+    right-side column, fused path vs oracle."""
+    s = dev_session
+    base = str(tmp_path)
+    _fact_dim(s, base)
+    hs = Hyperspace(s)
+    hs.create_index(
+        s.read.parquet(os.path.join(base, "fact")),
+        IndexConfig("qf", ["k"], ["qty", "price"]),
+    )
+    hs.create_index(
+        s.read.parquet(os.path.join(base, "dim")), IndexConfig("qd", ["dk"], ["grp"])
+    )
+
+    def q():
+        f = s.read.parquet(os.path.join(base, "fact"))
+        d = s.read.parquet(os.path.join(base, "dim"))
+        return (
+            f.filter(col("qty") >= 25)
+            .join(d, col("k") == col("dk"))
+            .group_by("grp")
+            .agg(total=("qty", "sum"))
+            .order_by(("grp", True))
+        )
+
+    disable_hyperspace(s)
+    expected = q().collect().sorted_rows()
+    enable_hyperspace(s)
+    _rows_close(q().collect().sorted_rows(), expected)
+
+
+def test_count_distinct_falls_back_correctly(dev_session, tmp_path):
+    """count_distinct is not fused — the fallback must still be correct."""
+    s = dev_session
+    base = str(tmp_path)
+    _fact_dim(s, base)
+    hs = Hyperspace(s)
+    hs.create_index(
+        s.read.parquet(os.path.join(base, "fact")),
+        IndexConfig("df", ["k"], ["qty"]),
+    )
+    hs.create_index(
+        s.read.parquet(os.path.join(base, "dim")), IndexConfig("dd", ["dk"], ["grp"])
+    )
+
+    def q():
+        f = s.read.parquet(os.path.join(base, "fact"))
+        d = s.read.parquet(os.path.join(base, "dim"))
+        return (
+            f.join(d, col("k") == col("dk"))
+            .group_by("grp")
+            .agg(u=("qty", "count_distinct"))
+            .order_by(("grp", True))
+        )
+
+    disable_hyperspace(s)
+    expected = q().collect().sorted_rows()
+    enable_hyperspace(s)
+    assert q().collect().sorted_rows() == expected
+
+
+def test_fused_agg_collision_rename_matches_unfused(dev_session, tmp_path):
+    """Right side has BOTH a colliding column `y` and a literal `y_r`: the fused
+    env must resolve `y_r` exactly like _assemble_join's renaming does (the
+    collision-renamed right.y, not the literal)."""
+    s = dev_session
+    base = str(tmp_path)
+    rng = np.random.RandomState(2)
+    s.write_parquet(
+        {
+            "k": rng.randint(0, 50, 4000).astype(np.int64),
+            "y": rng.randint(0, 5, 4000).astype(np.int64),
+        },
+        os.path.join(base, "lf"),
+    )
+    s.write_parquet(
+        {
+            "k2": np.arange(50, dtype=np.int64),
+            "y": (np.arange(50) % 7 + 100).astype(np.int64),
+            "y_r": (np.arange(50) % 3 + 500).astype(np.int64),
+        },
+        os.path.join(base, "rf"),
+    )
+    hs = Hyperspace(s)
+    hs.create_index(
+        s.read.parquet(os.path.join(base, "lf")), IndexConfig("xl", ["k"], ["y"])
+    )
+    hs.create_index(
+        s.read.parquet(os.path.join(base, "rf")),
+        IndexConfig("xr", ["k2"], ["y", "y_r"]),
+    )
+
+    def q():
+        # Both right.y and the literal right.y_r survive pruning (both names
+        # are referenced), so the join output renames right.y -> y_r and the
+        # literal y_r -> y_r_r. `sum(y_r)` must aggregate right.y (100-106),
+        # not the literal (500-502).
+        l = s.read.parquet(os.path.join(base, "lf"))
+        r = s.read.parquet(os.path.join(base, "rf"))
+        return (
+            l.join(r, col("k") == col("k2"))
+            .group_by("y")
+            .agg(s=("y_r", "sum"), n=("k", "count"))
+            .order_by(("y", True))
+        )
+
+    disable_hyperspace(s)
+    expected = q().collect().sorted_rows()
+    enable_hyperspace(s)
+    got = q().collect().sorted_rows()
+    assert got == expected
+    for y, ssum, n in got:
+        assert 100 * n <= ssum <= 106 * n, (y, ssum, n)  # right.y, not the literal
