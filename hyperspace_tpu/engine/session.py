@@ -311,6 +311,36 @@ class DataFrameReader:
         return DataFrame(self._session, ScanNode(rel))
 
 
+_compile_cache_done = False
+
+
+def _enable_compile_cache_once() -> None:
+    """Opt-in persistent XLA compilation cache (HYPERSPACE_COMPILE_CACHE_DIR):
+    on a remote-compile transport (the axon relay POSTs every distinct program
+    shape) a warm cache erases the dominant index-build cost across processes.
+    Program shapes are pow2-quantized throughout the engine precisely so this
+    warm set stays small. No-op when unset or when the backend cannot
+    serialize executables."""
+    global _compile_cache_done
+    if _compile_cache_done:
+        return
+    _compile_cache_done = True
+    import os
+
+    path = os.environ.get("HYPERSPACE_COMPILE_CACHE_DIR")
+    if not path:
+        return
+    try:
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        pass  # an optimization, never a failure mode
+
+
 class HyperspaceSession:
     """One session = conf + filesystem + optimizer rules + warehouse location."""
 
@@ -334,6 +364,7 @@ class HyperspaceSession:
         self.extra_optimizations: List = []
         self._mesh = None
         self._views: Dict[str, LogicalPlan] = {}
+        _enable_compile_cache_once()
         import threading
 
         if HyperspaceSession._active_local is None:
