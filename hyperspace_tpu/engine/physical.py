@@ -234,15 +234,23 @@ class BucketedIndexScanExec(PhysicalNode):
         )
 
     def _concat_cache_key(self):
-        """Steady-state cache key: the file inventory + pruned columns. Hybrid
-        appends are merged per query (their bucketization depends on query-time
-        source state), so those scans are uncacheable."""
-        if self.relation.hybrid_append is not None:
-            return None
+        """Steady-state cache key: the file inventory + pruned columns. A hybrid
+        append contributes ITS file inventory too — the merged bucketization is
+        a pure function of (index files, appended files, columns), and any
+        change to the appended set (new append, rewrite) changes the key, the
+        same freshness contract every scan cache rides."""
+        ha = self.relation.hybrid_append
+        ha_key = ()
+        if ha is not None:
+            ha_key = (
+                tuple((f.path, f.size, f.modified_time) for f in ha.files),
+                tuple(ha.root_paths),
+            )
         return (
             tuple((f.path, f.size, f.modified_time) for f in self.relation.files),
             # None (all columns) must not share a key with [] (zero columns).
             ("<all>",) if self.columns is None else tuple(self.columns),
+            ha_key,
         )
 
     def execute_concat(self, ctx) -> Tuple[Table, np.ndarray]:

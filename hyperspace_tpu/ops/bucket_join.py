@@ -265,7 +265,7 @@ def probe_ranges(ls, rs, l_len, r_len):
         try:
             return probe_pallas(ls, rs, l_len, r_len)
         except Exception as e:  # Mosaic lowering/runtime problems
-            record_pallas_failure(e)
+            record_pallas_failure(e, ls.dtype)
     return _probe(ls, rs, l_len, r_len)
 
 

@@ -26,7 +26,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..engine.table import Column
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:  # annotation-only: a runtime import would cycle through
+    # engine/__init__ -> session -> physical -> ops.hashing when `ops` is
+    # imported before `engine`.
+    from ..engine.table import Column
 
 _SEED1 = np.uint32(0x9747B28C)
 _SEED2 = np.uint32(0x85EBCA6B)

@@ -45,7 +45,17 @@ _ENV_KEY = "HYPERSPACE_PALLAS_PROBE"
 # XLA's log-probe above it; 2^28 gives the measured win point 2x headroom
 # without admitting shapes whose linear scaling clearly loses.
 _AUTO_MAX_OPS = 1 << 28
-_pallas_broken: list = []  # first failure recorded; falls back permanently
+# Failure latch, scoped PER KEY KIND ("int" | "float"): the int64 path is
+# validated on real Mosaic (round 4, 1.9-2.3x over the XLA probe), while the
+# float path's 32-bit split — designed around the terminal's rejection of
+# `bitcast f64->s64` — has only interpret-mode validation so far. A float
+# lowering failure must disable FLOAT dispatch only, never the proven int
+# path (the round-4 guard existed precisely for this blast radius).
+_pallas_broken: dict = {}  # kind -> first failure message; permanent fallback
+
+
+def _key_kind(dtype) -> str:
+    return "float" if dtype is not None and jnp.issubdtype(dtype, jnp.floating) else "int"
 
 
 def _pallas_mode() -> str:
@@ -67,6 +77,28 @@ def _split_hi_lo(k: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """(hi, lo) int32 pair whose lexicographic signed compare == int64 compare."""
     hi = (k >> 32).astype(jnp.int32)
     lo = ((k & jnp.int64(0xFFFFFFFF)) - jnp.int64(0x80000000)).astype(jnp.int32)
+    return hi, lo
+
+
+def _split_hi_lo_float(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Float keys → the kernel's (hi, lo) int32 pair WITHOUT any 64-bit bitcast.
+
+    The axon terminal's X64-elimination rewrite rejects `bitcast f64->s64`
+    (round-4 HTTP-500, `TPU_EVIDENCE.md`), so the order-preserving transform
+    `bits ^ ((bits >> 63) & 0x7FFF…)` is computed on the two 32-bit words of
+    `bitcast f64->s32[..,2]` instead (word 0 = low bits, word 1 = high bits):
+    the sign mask comes from the high word's arithmetic shift, the magnitude
+    flip applies to the low word in full and to the high word below the sign
+    bit, and the lo word gets the same signed-compare bias `_split_hi_lo`
+    applies. Equivalence with the 64-bit transform is pinned by
+    tests/test_pallas_probe.py."""
+    x = x.astype(jnp.float64) + 0.0  # canonicalize -0.0
+    words = jax.lax.bitcast_convert_type(x, jnp.int32)
+    lo, hi = words[..., 0], words[..., 1]
+    mask = hi >> 31  # all-ones for negative floats, zero otherwise
+    hi = hi ^ (mask & jnp.int32(0x7FFFFFFF))
+    lo = lo ^ mask
+    lo = lo ^ jnp.int32(-0x80000000)  # unsigned->signed bias, as a flip
     return hi, lo
 
 
@@ -154,10 +186,14 @@ def probe_pallas(ls, rs, l_len, r_len) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Drop-in replacement for `bucket_join._probe`: (lo, counts) int32, with
     ranges clamped to each right bucket's valid length and counts zeroed for
     left pad slots."""
-    lk = _sortable_i64(jnp.asarray(ls))
-    rk = _sortable_i64(jnp.asarray(rs))
-    lh, ll = _split_hi_lo(lk)
-    rh, rl = _split_hi_lo(rk)
+    ls, rs = jnp.asarray(ls), jnp.asarray(rs)
+    if jnp.issubdtype(ls.dtype, jnp.floating):
+        # Pure 32-bit split: no 64-bit bitcast for the relay to reject.
+        lh, ll = _split_hi_lo_float(ls)
+        rh, rl = _split_hi_lo_float(rs)
+    else:
+        lh, ll = _split_hi_lo(_sortable_i64(ls))
+        rh, rl = _split_hi_lo(_sortable_i64(rs))
     interpret = jax.default_backend() != "tpu"
     lo, hi = _probe_pallas_call(lh, ll, rh, rl, interpret)
     r_len_b = jnp.asarray(r_len)[:, None]
@@ -173,29 +209,18 @@ def pallas_probe_wanted(
 ) -> bool:
     """Dispatch decision for `probe_ranges`: forced on/off by env, else on-TPU
     with a capacity-product bound (the quadratic-compare budget). Shapes the
-    kernel cannot lower (see `shape_supported`) always take the XLA path, as
-    do FLOAT value-mode keys on a REAL TPU backend (forced or not): their
-    order-preserving transform needs a 64-bit bitcast that the axon
-    terminal's X64-elimination rewrite cannot handle (observed HTTP-500
-    remote-compile failure, round 4). Integer keys — including the common
-    int64 hash mode — are VALIDATED on real Mosaic; interpret mode (non-TPU)
-    runs floats for the CI equivalence tests."""
-    if _pallas_broken:
+    kernel cannot lower (see `shape_supported`) always take the XLA path.
+    Float value-mode keys ride the kernel via the pure-32-bit split
+    (`_split_hi_lo_float`) — the round-4 exclusion existed only because the
+    old transform's `bitcast f64->s64` was rejected by the terminal's
+    X64-elimination rewrite. `dtype` scopes the failure latch: a float-path
+    lowering failure can never disable the Mosaic-validated integer path."""
+    if _key_kind(dtype) in _pallas_broken:
         return False
     mode = _pallas_mode()
     if mode == "0":
         return False
     if not shape_supported(num_buckets, cap_l, cap_r):
-        return False
-    if (
-        dtype is not None
-        and jnp.issubdtype(dtype, jnp.floating)
-        and jax.default_backend() == "tpu"
-    ):
-        # Real-Mosaic float keys are known-broken (X64-elimination rejects the
-        # f64 bitcast); admitting them — even forced — would trip the
-        # permanent _pallas_broken latch and disable the validated integer
-        # path too. Interpret mode (non-TPU) still runs floats for CI.
         return False
     if mode == "1":
         return True
@@ -205,11 +230,14 @@ def pallas_probe_wanted(
     )
 
 
-def record_pallas_failure(exc: BaseException) -> None:
+def record_pallas_failure(exc: BaseException, dtype=None) -> None:
     import logging
 
-    _pallas_broken.append(f"{type(exc).__name__}: {exc}")
+    kind = _key_kind(dtype)
+    _pallas_broken[kind] = f"{type(exc).__name__}: {exc}"
     logging.getLogger("hyperspace_tpu.ops").warning(
-        "pallas probe failed; falling back to the XLA probe permanently: %s",
-        _pallas_broken[-1],
+        "pallas probe failed for %s keys; falling back to the XLA probe "
+        "permanently for that key kind: %s",
+        kind,
+        _pallas_broken[kind],
     )

@@ -95,6 +95,14 @@ class FilterIndexRule:
                 chosen = rank(usable)
                 best = chosen.entry
                 index_child: LogicalPlan = ScanNode(_index_relation(best))
+                if chosen.deleted:
+                    # Delete tolerance: prune rows of vanished source files by
+                    # lineage BEFORE the output projection drops the column.
+                    from .rule_utils import lineage_prune_condition
+
+                    index_child = FilterNode(
+                        lineage_prune_condition(chosen.deleted), index_child
+                    )
                 if chosen.appended:
                     # Hybrid Scan (extension): union the index data with the source
                     # files appended since the build, both projected to the needed
@@ -139,9 +147,9 @@ class FilterIndexRule:
 
 
 def rank(candidates):
-    """FilterIndexRanker: exact-match candidates beat hybrid-scan ones, then first
-    (reference ranking TODO at :202-208)."""
-    return sorted(candidates, key=lambda c: len(c.appended))[0]
+    """FilterIndexRanker: exact-match candidates beat hybrid-scan ones (less
+    source-file drift first), then first (reference ranking TODO at :202-208)."""
+    return sorted(candidates, key=lambda c: len(c.appended) + len(c.deleted))[0]
 
 
 def _index_relation(entry: IndexLogEntry, with_bucket_spec: bool = False) -> SourceRelation:

@@ -141,7 +141,10 @@ def rank_join_pairs(pairs):
         li, ri = lc.entry, rc.entry
         equal = li.num_buckets == ri.num_buckets
         return (
-            len(lc.appended) + len(rc.appended),
+            # Exact-match pairs first: ANY source-file drift (appended to merge
+            # at query time, or deleted to lineage-prune at scan time) costs
+            # per-query work an exact index avoids.
+            len(lc.appended) + len(rc.appended) + len(lc.deleted) + len(rc.deleted),
             0 if equal else 1,
             -(li.num_buckets + ri.num_buckets),
         )
@@ -219,7 +222,8 @@ class JoinIndexRule:
                 li, ri = lc.entry, rc.entry
 
                 def substitute(side: LogicalPlan, scan: ScanNode, cand):
-                    from ..engine.logical import HybridAppend
+                    from ..engine.logical import FilterNode, HybridAppend
+                    from .rule_utils import lineage_prune_condition
 
                     new_rel = _index_relation(cand.entry, with_bucket_spec=True)
                     if cand.appended:
@@ -237,7 +241,17 @@ class JoinIndexRule:
                         if n is scan or (
                             isinstance(n, ScanNode) and n.relation is scan.relation
                         ):
-                            return ScanNode(new_rel)
+                            new_scan: LogicalPlan = ScanNode(new_rel)
+                            if cand.deleted:
+                                # Delete tolerance: prune vanished files' rows by
+                                # lineage. The filter preserves bucket membership
+                                # and in-bucket order, so the co-bucketed
+                                # no-shuffle join stays sound over it (the planner
+                                # unwraps bucket-preserving filters).
+                                new_scan = FilterNode(
+                                    lineage_prune_condition(cand.deleted), new_scan
+                                )
+                            return new_scan
                         return n
 
                     return side.transform_up(replace)

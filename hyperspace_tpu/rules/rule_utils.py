@@ -23,9 +23,12 @@ def get_candidate_indexes(
     """ACTIVE indexes applicable to `plan` (normally a relation node).
 
     Exact applicability = the recorded signature provider recomputes the same
-    signature. With `hybrid_scan` (extension, BASELINE config 3), an index whose
-    recorded source files are a strict SUBSET of the current files is also a
-    candidate, carrying the appended files to merge at execution time."""
+    signature. With `hybrid_scan` (extension, BASELINE config 3), an index
+    whose recorded source inventory DRIFTED is also a candidate when the drift
+    is recoverable: appended files are carried to merge at execution time, and
+    files that vanished are tolerated iff the index records lineage — their
+    rows are pruned at scan time (`hybrid_delta`). A file changed IN PLACE
+    always disqualifies."""
     signature_map: Dict[str, Optional[str]] = {}
 
     def signature_valid(entry: IndexLogEntry) -> bool:
@@ -36,9 +39,15 @@ def get_candidate_indexes(
         computed = signature_map[source_sig.provider]
         return computed is not None and computed == source_sig.value
 
-    def appended_files(entry: IndexLogEntry) -> Optional[List[FileStatus]]:
-        """Current-files minus recorded; None unless recorded ⊊ current with no
-        recorded file missing/changed."""
+    def hybrid_delta(entry: IndexLogEntry):
+        """(appended_files, deleted_paths) between the recorded source inventory
+        and the current one, or None when the index cannot Hybrid-Scan it:
+
+        - a recorded file CHANGED in place (path present, size/mtime differ):
+          its old rows are inseparable from new ones — never scannable;
+        - a recorded file VANISHED: tolerable IFF the index carries lineage
+          (`_data_file_name` per row) — its rows are pruned at scan time by a
+          bucket-preserving filter. Without lineage, not scannable."""
         if not isinstance(plan, ScanNode):
             return None
         recorded = {
@@ -48,12 +57,22 @@ def get_candidate_indexes(
         }
         current = plan.relation.files
         current_keys = {(f.path, f.size, f.modified_time) for f in current}
-        if not recorded <= current_keys:
-            return None  # a recorded file vanished or changed: not hybrid-scannable
+        current_paths = {f.path for f in current}
+        deleted: List[str] = []
+        for name, size, mtime in recorded:
+            if (name, size, mtime) in current_keys:
+                continue
+            if name in current_paths:
+                return None  # changed in place: rows not separable
+            deleted.append(name)
+        if deleted and not _has_lineage(entry):
+            return None
         appended = [
             f for f in current if (f.path, f.size, f.modified_time) not in recorded
         ]
-        return appended if appended else None
+        if not appended and not deleted:
+            return None
+        return appended, sorted(deleted)
 
     out: List[CandidateIndex] = []
     for e in index_manager.get_indexes([states.ACTIVE]):
@@ -62,19 +81,47 @@ def get_candidate_indexes(
         if signature_valid(e):
             out.append(CandidateIndex(e, []))
         elif hybrid_scan:
-            appended = appended_files(e)
-            if appended is not None:
-                out.append(CandidateIndex(e, appended))
+            delta = hybrid_delta(e)
+            if delta is not None:
+                out.append(CandidateIndex(e, delta[0], delta[1]))
     return out
 
 
-class CandidateIndex:
-    """An applicable index + the source files appended since it was built
-    (empty for an exact signature match)."""
+def _has_lineage(entry: IndexLogEntry) -> bool:
+    """Whether the index data carries the per-row source-file lineage column."""
+    from ..config import IndexConstants
+    from ..engine.schema import Schema
 
-    def __init__(self, entry: IndexLogEntry, appended: List[FileStatus]):
+    target = IndexConstants.DATA_FILE_NAME_COLUMN.lower()
+    schema = Schema.from_json_string(entry.schema_json)
+    return any(n.lower() == target for n in schema.names)
+
+
+def lineage_prune_condition(deleted: List[str]):
+    """The bucket-preserving scan-time filter that prunes rows of vanished
+    source files: `NOT (_data_file_name IN deleted)`. Compaction keeps bucket
+    membership and in-bucket order, so co-bucketed joins stay sound over the
+    pruned table (same argument as side filters in `FilterExec.execute_concat`)."""
+    from ..config import IndexConstants
+    from ..engine.expr import Col, IsIn, Not
+
+    return Not(IsIn(Col(IndexConstants.DATA_FILE_NAME_COLUMN), list(deleted)))
+
+
+class CandidateIndex:
+    """An applicable index + the source-file delta since it was built: files
+    appended (merged at execution time) and files deleted (their rows pruned
+    via lineage at scan time). Both empty for an exact signature match."""
+
+    def __init__(
+        self,
+        entry: IndexLogEntry,
+        appended: List[FileStatus],
+        deleted: Optional[List[str]] = None,
+    ):
         self.entry = entry
         self.appended = appended
+        self.deleted = deleted or []
 
 
 def get_scan_node(plan: LogicalPlan) -> Optional[ScanNode]:

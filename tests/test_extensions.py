@@ -362,3 +362,96 @@ class TestDeltaSource:
         assert len(files) == 2
         dlog.remove_file(p, os.path.relpath(files[0].path, p))
         assert session.read.delta(p).sorted_rows() == [(2, "b")]
+
+
+class TestHybridScanDeleteTolerance:
+    """Round-5: a vanished source file no longer disqualifies the index when
+    lineage is recorded — its rows are pruned at scan time by a
+    bucket-preserving `_data_file_name NOT IN deleted` filter."""
+
+    def _write_two_files(self, tmp_path, name, rows_a, rows_b):
+        d = tmp_path / name
+        d.mkdir(exist_ok=True)
+        eio.write_parquet(Table.from_pydict(rows_a), str(d / "part-a.parquet"))
+        eio.write_parquet(Table.from_pydict(rows_b), str(d / "part-b.parquet"))
+        return d
+
+    def test_filter_index_survives_deleted_file(self, session, tmp_path):
+        import os
+
+        session.conf.set(IndexConstants.INDEX_LINEAGE_ENABLED, "true")
+        d = self._write_two_files(
+            tmp_path, "t",
+            {"k": [1, 2, 3], "v": ["a", "b", "c"]},
+            {"k": [1, 4], "v": ["x", "y"]},
+        )
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(str(d)), IndexConfig("dt1", ["k"], ["v"]))
+        os.remove(str(d / "part-b.parquet"))
+
+        q = lambda: session.read.parquet(str(d)).filter(col("k") == 1).select("v")
+        enable_hyperspace(session)
+        session.conf.set(IndexConstants.INDEX_HYBRID_SCAN_ENABLED, "true")
+        assert scanned_index_names(q()) == {"dt1"}
+        assert sorted(q().to_pydict()["v"]) == ["a"]  # "x" (deleted file) pruned
+        disable_hyperspace(session)
+        assert sorted(q().to_pydict()["v"]) == ["a"]  # oracle agrees
+
+    def test_filter_index_without_lineage_not_used_on_delete(self, session, tmp_path):
+        import os
+
+        d = self._write_two_files(
+            tmp_path, "t0",
+            {"k": [1, 2], "v": ["a", "b"]},
+            {"k": [3], "v": ["c"]},
+        )
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(str(d)), IndexConfig("dt0", ["k"], ["v"]))
+        os.remove(str(d / "part-b.parquet"))
+        enable_hyperspace(session)
+        session.conf.set(IndexConstants.INDEX_HYBRID_SCAN_ENABLED, "true")
+        q = lambda: session.read.parquet(str(d)).filter(col("k") == 1).select("v")
+        assert scanned_index_names(q()) == set()  # no lineage -> disqualified
+        assert sorted(q().to_pydict()["v"]) == ["a"]
+
+    def test_join_survives_delete_plus_append(self, session, tmp_path):
+        """Delete one left source file AND append another: the co-bucketed join
+        still fires shuffle-free, results equal the oracle."""
+        import os
+
+        session.conf.set(IndexConstants.INDEX_LINEAGE_ENABLED, "true")
+        dl = self._write_two_files(
+            tmp_path, "l",
+            {"k": [1, 2, 3, 4], "v": [10, 20, 30, 40]},
+            {"k": [5, 6], "v": [50, 60]},
+        )
+        session.write_parquet(
+            {"k2": [1, 2, 3, 4, 5, 6, 7], "w": [100, 200, 300, 400, 500, 600, 700]},
+            str(tmp_path / "r"),
+        )
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(str(dl)), IndexConfig("djl", ["k"], ["v"]))
+        hs.create_index(
+            session.read.parquet(str(tmp_path / "r")), IndexConfig("djr", ["k2"], ["w"])
+        )
+        os.remove(str(dl / "part-b.parquet"))  # k=5,6 rows vanish
+        eio.write_parquet(
+            Table.from_pydict({"k": [7, 7], "v": [70, 71]}),
+            str(dl / "appended.parquet"),
+        )
+
+        def q():
+            l = session.read.parquet(str(dl))
+            r = session.read.parquet(str(tmp_path / "r"))
+            return l.join(r, col("k") == col("k2")).select("v", "w")
+
+        disable_hyperspace(session)
+        expected = q().sorted_rows()
+        assert (70, 700) in expected and (50, 500) not in expected
+
+        enable_hyperspace(session)
+        session.conf.set(IndexConstants.INDEX_HYBRID_SCAN_ENABLED, "true")
+        assert scanned_index_names(q()) == {"djl", "djr"}
+        assert plan_op_names(q()).count("ShuffleExchange") == 0
+        assert q().sorted_rows() == expected
+        assert q().count() == len(expected)  # device count path agrees too

@@ -125,7 +125,7 @@ def test_pallas_failure_falls_back(monkeypatch):
         raise RuntimeError("mosaic lowering failed")
 
     monkeypatch.setattr(pp, "probe_pallas", boom)
-    monkeypatch.setattr(pp, "_pallas_broken", [])
+    monkeypatch.setattr(pp, "_pallas_broken", {})
     rng = np.random.RandomState(6)
     buckets = [rng.randint(0, 50, size=10) for _ in range(2)]
     ls, llen = _padded_from_lists(buckets, 16, np.int64, _PAD)
@@ -134,7 +134,12 @@ def test_pallas_failure_falls_back(monkeypatch):
     lo_x, cnt_x = _probe(ls, rs, llen, rlen)
     np.testing.assert_array_equal(np.asarray(cnt), np.asarray(cnt_x))
     assert pp._pallas_broken  # failure recorded
-    assert not pp.pallas_probe_wanted(16, 16, 2)  # permanent fallback
+    assert not pp.pallas_probe_wanted(16, 16, 2, np.dtype(np.int64))
+    # The latch is SCOPED per key kind: an int failure must not drop the
+    # (independent) float path, and vice versa.
+    assert pp.pallas_probe_wanted(16, 16, 2, np.dtype(np.float64))
+    pp.record_pallas_failure(RuntimeError("float lowering failed"), np.dtype(np.float64))
+    assert not pp.pallas_probe_wanted(16, 16, 2, np.dtype(np.float64))
 
 
 def test_shape_gate_refuses_unlowerable_bucket_counts(monkeypatch):
@@ -144,10 +149,58 @@ def test_shape_gate_refuses_unlowerable_bucket_counts(monkeypatch):
     import hyperspace_tpu.ops.pallas_probe as pp
 
     monkeypatch.setenv("HYPERSPACE_PALLAS_PROBE", "1")
-    monkeypatch.setattr(pp, "_pallas_broken", [])
+    monkeypatch.setattr(pp, "_pallas_broken", {})
     assert pp.shape_supported(8, 256, 512)
     assert pp.shape_supported(64, 256, 512)
     assert pp.shape_supported(3, 64, 64)
     assert not pp.shape_supported(20, 256, 512)  # >8, not a multiple of 8
     assert not pp.pallas_probe_wanted(256, 512, 20)
     assert not pp._pallas_broken  # refusal is not a failure
+
+
+def test_float_split_32bit_matches_64bit_transform():
+    """The pure-32-bit float split (`_split_hi_lo_float`, no 64-bit bitcast —
+    the relay's X64-elimination rejects `bitcast f64->s64`) must reproduce the
+    canonical transform's (hi, lo) pair bit-for-bit, including sign flips,
+    -0.0 canonicalization, denormals, and extreme magnitudes."""
+    import jax.numpy as jnp
+
+    from hyperspace_tpu.ops.pallas_probe import (
+        _sortable_i64,
+        _split_hi_lo,
+        _split_hi_lo_float,
+    )
+
+    rng = np.random.RandomState(17)
+    vals = np.concatenate(
+        [
+            rng.randn(256) * 1e3,
+            # NOTE on denormals (±5e-324): XLA flushes f64 denormals to zero
+            # (measured on XLA-CPU: x + 0.0 == 0.0 and x == 0 is True), so
+            # both the 64-bit and 32-bit transforms map them to the zero key
+            # IDENTICALLY — the bit-equality check below covers them, but the
+            # order-vs-numpy check can't (numpy doesn't flush).
+            np.array([0.0, -0.0, 1e308, -1e308, 1.5, -1.5]),
+            rng.randn(64) * 1e-300,
+        ]
+    )
+    x = jnp.asarray(vals)
+    hi64, lo64 = _split_hi_lo(_sortable_i64(x))
+    hi32, lo32 = _split_hi_lo_float(x)
+    np.testing.assert_array_equal(np.asarray(hi64), np.asarray(hi32))
+    np.testing.assert_array_equal(np.asarray(lo64), np.asarray(lo32))
+    # And the pair really orders like the floats do under the kernel's
+    # lexicographic SIGNED compare (hi first, then the biased lo).
+    order = np.lexsort((np.asarray(lo32), np.asarray(hi32)))
+    np.testing.assert_array_equal(vals[order], np.sort(vals))
+
+
+def test_float_keys_admitted_on_any_backend(monkeypatch):
+    """Round-4 excluded float value-mode keys on TPU (64-bit bitcast rejected
+    by the relay); the 32-bit split lifts that — the dispatcher must admit
+    floats wherever shapes allow."""
+    import hyperspace_tpu.ops.pallas_probe as pp
+
+    monkeypatch.setattr(pp, "_pallas_broken", {})
+    monkeypatch.setenv("HYPERSPACE_PALLAS_PROBE", "1")
+    assert pp.pallas_probe_wanted(256, 512, 8, np.dtype(np.float64))
