@@ -9,7 +9,7 @@ import jax as _jax
 
 _jax.config.update("jax_enable_x64", True)
 
-from .expr import BinaryOp, Col, Expr, IsIn, Lit, Not, col, lit  # noqa: F401,E402
+from .expr import BinaryOp, Col, Expr, IsIn, Lit, Not, Udf, col, lit, udf  # noqa: F401,E402
 from .logical import (  # noqa: F401,E402
     BucketSpec,
     FilterNode,

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..exceptions import HyperspaceException
-from .expr import BinaryOp, Col, Expr, IsIn, IsNull, Lit, Not
+from .expr import BinaryOp, Col, Expr, IsIn, IsNull, Lit, Not, Udf
 from .device_cache import device_array
 from .table import Column, Table, align_dictionaries
 
@@ -149,6 +149,9 @@ def evaluate(expr: Expr, table: Table, devcols: Dict[str, jnp.ndarray]) -> _Val:
             valid = _and_valid(valid, match)
         return _Val("num", match, valid=valid)
 
+    if isinstance(expr, Udf):
+        return _evaluate_udf(expr, table, devcols)
+
     if isinstance(expr, BinaryOp):
         l = evaluate(expr.left, table, devcols)
         r = evaluate(expr.right, table, devcols)
@@ -237,6 +240,63 @@ def evaluate(expr: Expr, table: Table, devcols: Dict[str, jnp.ndarray]) -> _Val:
     raise HyperspaceException(f"Cannot evaluate expression: {expr!r}")
 
 
+def _evaluate_udf(expr: Udf, table, devcols: Dict[str, jnp.ndarray]) -> _Val:
+    """HOST evaluation of a user-defined function (the documented contract of
+    `expr.Udf`): argument values are pulled to the host, strings decoded, null
+    slots delivered as None; the function runs row-wise in Python; the result
+    is packaged back under the DECLARED dtype with None → null."""
+    n = table.num_rows
+    prepared = []
+    for a in expr.args:
+        if isinstance(a, Col) and isinstance(table, Table):
+            # Column args read straight from host storage — round-tripping
+            # them through the device (evaluate's _device upload + the pull
+            # below) would cost two full-column transfers for host-only work.
+            c = table.column(a.name)
+            data = c.dictionary[c.data] if c.is_string else c.data
+            prepared.append(("arr", data, c.validity))
+            continue
+        v = evaluate(a, table, devcols)
+        if v.kind == "lit":
+            prepared.append(("lit", v.value, None))
+            continue
+        valid = None if v.valid is None else np.asarray(v.valid, bool)
+        if v.kind == "str":
+            data = np.asarray(v.dictionary)[np.asarray(v.arr)]
+        else:
+            data = np.asarray(v.arr)
+        prepared.append(("arr", data, valid))
+    out = []
+    for i in range(n):
+        args = []
+        for kind, data, valid in prepared:
+            if kind == "lit":
+                args.append(data)
+            elif valid is not None and not valid[i]:
+                args.append(None)
+            else:
+                x = data[i]
+                args.append(x.item() if hasattr(x, "item") else x)
+        out.append(expr.fn(*args))
+    if expr.dtype == "string":
+        if n == 0:
+            # from_values can't infer stringness from an empty object array.
+            return _Val("str", jnp.empty(0, jnp.int32), np.empty(0, "<U1"))
+        col = Column.from_values(np.asarray(out, dtype=object))
+        return _Val(
+            "str",
+            jnp.asarray(col.data),
+            col.dictionary,
+            valid=None if col.validity is None else jnp.asarray(col.validity),
+        )
+    npdtype = np.dtype(expr.dtype)
+    null_mask = np.fromiter((v is None for v in out), bool, count=n)
+    fill = np.zeros((), npdtype).item()
+    filled = np.asarray([fill if v is None else v for v in out], dtype=npdtype)
+    valid = None if not null_mask.any() else jnp.asarray(~null_mask)
+    return _Val("num", jnp.asarray(filled), valid=valid)
+
+
 def _compare(op: str, a, b):
     if op == "==":
         return a == b
@@ -318,9 +378,18 @@ def _collect_col_spellings(expr: Expr) -> list:
             walk(e.right)
         elif isinstance(e, (Not, IsNull, IsIn)):
             walk(e.child)
+        elif isinstance(e, Udf):
+            for a in e.args:
+                walk(a)
 
     walk(expr)
     return sorted(out)
+
+
+def _contains_udf(expr: Expr) -> bool:
+    if isinstance(expr, Udf):
+        return True
+    return any(_contains_udf(c) for c in expr.children())
 
 
 class _PredColMeta:
@@ -407,6 +476,8 @@ def _compiled_eval(expr: Expr, table: Table, mode: str):
     value results)."""
     import weakref
 
+    if _contains_udf(expr):
+        return None  # UDFs are host-evaluated by contract: never traced
     r = (mode, repr(expr))
     with _pred_lock:
         if r in _PRED_UNCACHEABLE:

@@ -170,6 +170,50 @@ class IsIn(Expr):
         return f"({self.child!r} in {self.values!r})"
 
 
+class Udf(Expr):
+    """A user-defined column function applied row-wise to its argument
+    expressions — the engine's escape hatch for logic the expression IR cannot
+    express (reference: Catalyst `ScalaUDF`, wrapped by the serde at
+    `index/serde/package.scala:59-186`).
+
+    HOST-evaluated by contract: the function runs on decoded Python values on
+    the host, never on device — a UDF column is the one engine surface that
+    opts out of the TPU compute path. Null handling mirrors Spark's
+    reference-type UDFs: null inputs arrive as None; returning None makes the
+    result null. Rewrite rules remain applicable around UDFs (an index still
+    fires when the UDF only consumes columns the index covers)."""
+
+    def __init__(self, fn, dtype: str, args: Sequence["Expr"], name: Optional[str] = None):
+        self.fn = fn
+        self.dtype = dtype
+        self.args = list(args)
+        self.name = name or getattr(fn, "__name__", "udf")
+
+    def children(self) -> Sequence["Expr"]:
+        return tuple(self.args)
+
+    def __repr__(self):
+        args = ", ".join(repr(a) for a in self.args)
+        return f"udf:{self.name}({args})"
+
+
+def udf(fn, dtype: str, name: Optional[str] = None):
+    """Wrap a plain Python function as a column expression factory:
+
+        to_tier = udf(lambda qty: "big" if qty > 25 else "small", "string")
+        df.with_column("tier", to_tier(col("qty")))
+
+    `dtype` declares the result type ("int64", "float64", "bool", "string", …).
+    See `Udf` for the host-evaluation and null contract."""
+
+    def make(*args) -> Udf:
+        return Udf(fn, dtype, [_lit(a) for a in args], name)
+
+    make.fn = fn
+    make.dtype = dtype
+    return make
+
+
 def _lit(v) -> Expr:
     return v if isinstance(v, Expr) else Lit(v)
 

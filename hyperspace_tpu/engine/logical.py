@@ -169,6 +169,25 @@ class ProjectNode(LogicalPlan):
         return f"Project [{', '.join(self.column_names)}]"
 
 
+_NUMERIC_DTYPES = frozenset({"int32", "int64", "float32", "float64", "bool"})
+
+
+def _check_schema_compatible(op: str, a: "Schema", b: "Schema") -> None:
+    """Multi-child operator schema contract (Union/Intersect/Except): names
+    resolve case-insensitively positionally; numeric widths may differ
+    (execution promotes), but string-vs-numeric fails HERE, not as an obscure
+    runtime error later."""
+    if [n.lower() for n in a.names] != [n.lower() for n in b.names]:
+        raise ValueError(f"{op} children schemas differ: {a.names} vs {b.names}")
+    for fa, fb in zip(a.fields, b.fields):
+        if fa.dtype != fb.dtype and not (
+            fa.dtype in _NUMERIC_DTYPES and fb.dtype in _NUMERIC_DTYPES
+        ):
+            raise ValueError(
+                f"{op} column {fa.name!r} type mismatch: {fa.dtype} vs {fb.dtype}"
+            )
+
+
 class UnionNode(LogicalPlan):
     """Row-union of same-schema children (the Hybrid Scan merge shape: index data ∪
     appended source files)."""
@@ -180,25 +199,14 @@ class UnionNode(LogicalPlan):
 
         self._children = list(children)
         first = self._children[0].output_schema
-        numeric = {"int32", "int64", "float32", "float64", "bool"}
         dtypes = [f.dtype for f in first.fields]
         for c in self._children[1:]:
             sch = c.output_schema
-            if [n.lower() for n in sch.names] != [n.lower() for n in first.names]:
-                raise ValueError(
-                    f"Union children schemas differ: {first.names} vs {sch.names}"
-                )
-            for i, (fa, fb) in enumerate(zip(first.fields, sch.fields)):
-                # Same-name columns must be type-compatible: numeric widths may
-                # differ (concat promotes — the declared schema promotes with
-                # them), but string-vs-numeric is a schema error here, not an
-                # obscure concat failure later.
-                if fa.dtype != fb.dtype:
-                    if not (fa.dtype in numeric and fb.dtype in numeric):
-                        raise ValueError(
-                            f"Union column {fa.name!r} type mismatch: "
-                            f"{fa.dtype} vs {fb.dtype}"
-                        )
+            _check_schema_compatible("Union", first, sch)
+            for i, fb in enumerate(sch.fields):
+                if dtypes[i] != fb.dtype:
+                    # Numeric widths may differ (concat promotes — the
+                    # declared schema promotes with them).
                     dtypes[i] = dtype_from_numpy(
                         _np.promote_types(
                             _np.dtype(dtypes[i]), _np.dtype(fb.dtype)
@@ -222,6 +230,48 @@ class UnionNode(LogicalPlan):
 
     def simple_string(self):
         return f"Union ({len(self._children)} children)"
+
+
+class SetOpNode(LogicalPlan):
+    """Base of the DISTINCT set operations INTERSECT / EXCEPT (SQL semantics:
+    output rows are deduplicated; NULLs compare equal to each other — the same
+    null-aware row equality the aggregate's key records implement). Schema
+    compatibility follows UnionNode's contract (names resolve case-insensitively
+    positionally; string-vs-numeric is a schema error here, not a late runtime
+    one). Reference: Catalyst `Intersect`/`Except`, serde-wrapped at
+    `index/serde/package.scala:59-186`."""
+
+    op = ""
+
+    def __init__(self, left: LogicalPlan, right: LogicalPlan):
+        self.left = left
+        self.right = right
+        _check_schema_compatible(self.op, left.output_schema, right.output_schema)
+
+    def children(self):
+        return (self.left, self.right)
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.left.output_schema
+
+    def with_children(self, children):
+        return type(self)(children[0], children[1])
+
+    def simple_string(self):
+        return self.op
+
+
+class IntersectNode(SetOpNode):
+    """Rows present in BOTH children (distinct)."""
+
+    op = "Intersect"
+
+
+class ExceptNode(SetOpNode):
+    """Rows of the left child absent from the right (distinct)."""
+
+    op = "Except"
 
 
 _JOIN_TYPES = {
@@ -303,6 +353,10 @@ def infer_expr_dtype(e: Expr, schema: Schema) -> str:
         raise HyperspaceException(f"Cannot type literal: {v!r}")
     if isinstance(e, (Not, IsNull, IsIn)):
         return "bool"
+    from .expr import Udf
+
+    if isinstance(e, Udf):
+        return e.dtype  # declared by udf(fn, dtype)
     if isinstance(e, BinaryOp):
         if e.op in BinaryOp.COMPARISONS or e.op in BinaryOp.BOOLEAN:
             return "bool"

@@ -35,7 +35,9 @@ from .expr import Col, Expr, extract_equi_join_keys
 from .logical import (
     AggregateNode,
     BucketSpec,
+    ExceptNode,
     FilterNode,
+    IntersectNode,
     JoinNode,
     LimitNode,
     LogicalPlan,
@@ -402,6 +404,54 @@ class UnionExec(PhysicalNode):
 
     def simple_string(self):
         return f"Union ({len(self._children)})"
+
+
+class SetOpExec(PhysicalNode):
+    """INTERSECT / EXCEPT with DISTINCT set semantics over whole rows.
+
+    Row equality is the engine's canonical null-aware record equality (the
+    aggregate path's `_key_records`: data + validity lanes, nulls equal each
+    other), computed over the two sides re-encoded through `Table.concat` so
+    string codes are comparable across tables. Output rows are the left side's
+    first occurrence of each surviving distinct record, in left order."""
+
+    def __init__(self, op: str, left: PhysicalNode, right: PhysicalNode):
+        self.op = op  # "intersect" | "except"
+        self.left = left
+        self.right = right
+
+    @property
+    def name(self):
+        return self.op.capitalize()
+
+    def children(self):
+        return (self.left, self.right)
+
+    def execute(self, ctx) -> Table:
+        from ..ops.aggregate import _key_records
+
+        lt = self.left.execute(ctx)
+        rt = self.right.execute(ctx)
+        names = lt.column_names
+        if rt.num_rows == 0:
+            combined = lt
+        else:
+            # concat re-encodes strings over union dictionaries → codes (and
+            # therefore records) are comparable across the two sides.
+            combined = Table.concat([lt, rt.select(names)])
+        recs = _key_records(combined, names) if combined.num_rows else None
+        if recs is None:
+            return lt
+        l_recs, r_recs = recs[: lt.num_rows], recs[lt.num_rows :]
+        uniq, first_idx = np.unique(l_recs, return_index=True)
+        if self.op == "intersect":
+            keep = np.isin(uniq, np.unique(r_recs)) if len(r_recs) else np.zeros(len(uniq), bool)
+        else:
+            keep = ~np.isin(uniq, np.unique(r_recs)) if len(r_recs) else np.ones(len(uniq), bool)
+        return lt.take(np.sort(first_idx[keep]))
+
+    def simple_string(self):
+        return self.name
 
 
 class ExchangeInfo:
@@ -1646,6 +1696,15 @@ def plan_physical(
 
     if isinstance(logical, UnionNode):
         return UnionExec([plan_physical(c, required, case_sensitive) for c in logical.children()])
+
+    if isinstance(logical, (IntersectNode, ExceptNode)):
+        # Set-op row equality spans EVERY column: children cannot be pruned to
+        # the outer projection (a projection above still prunes the output).
+        return SetOpExec(
+            "intersect" if isinstance(logical, IntersectNode) else "except",
+            plan_physical(logical.left, None, case_sensitive),
+            plan_physical(logical.right, None, case_sensitive),
+        )
 
     if isinstance(logical, WithColumnNode):
         if required is not None and all(

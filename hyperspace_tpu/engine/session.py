@@ -102,6 +102,20 @@ class DataFrame:
 
     unionAll = union
 
+    def intersect(self, other: "DataFrame") -> "DataFrame":
+        """Distinct rows present in BOTH frames (SQL INTERSECT; nulls compare
+        equal to each other, like Spark's)."""
+        from .logical import IntersectNode
+
+        return DataFrame(self.session, IntersectNode(self.plan, other.plan))
+
+    def subtract(self, other: "DataFrame") -> "DataFrame":
+        """Distinct rows of this frame absent from `other` (SQL EXCEPT;
+        Spark's `except`/`subtract`)."""
+        from .logical import ExceptNode
+
+        return DataFrame(self.session, ExceptNode(self.plan, other.plan))
+
     def drop(self, *columns: str) -> "DataFrame":
         """Project away the named columns (missing names are ignored, like
         Spark's drop). Name matching honors `hyperspace.resolution.caseSensitive`

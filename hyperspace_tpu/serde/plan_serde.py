@@ -13,11 +13,13 @@ import base64
 import json
 from typing import Any, Dict
 
-from ..engine.expr import BinaryOp, Col, Expr, IsIn, IsNull, Lit, Not
+from ..engine.expr import BinaryOp, Col, Expr, IsIn, IsNull, Lit, Not, Udf
 from ..engine.logical import (
     AggregateNode,
     BucketSpec,
+    ExceptNode,
     FilterNode,
+    IntersectNode,
     JoinNode,
     LimitNode,
     LogicalPlan,
@@ -60,6 +62,20 @@ def expr_to_dict(e: Expr) -> Dict[str, Any]:
         return {"t": "isin", "child": expr_to_dict(e.child), "values": list(e.values)}
     if isinstance(e, IsNull):
         return {"t": "isnull", "child": expr_to_dict(e.child), "negated": e.negated}
+    if isinstance(e, Udf):
+        # The function itself is not serializable (the same limit the
+        # reference's ScalaUDF wrapper has, `serde/package.scala:59-186`):
+        # record its import path and re-import at deserialize time — a missing
+        # import fails loudly there, never silently at execution.
+        fn = e.fn
+        return {
+            "t": "udf",
+            "name": e.name,
+            "dtype": e.dtype,
+            "module": getattr(fn, "__module__", None),
+            "qualname": getattr(fn, "__qualname__", None),
+            "args": [expr_to_dict(a) for a in e.args],
+        }
     raise HyperspaceException(f"Cannot serialize expression: {e!r}")
 
 
@@ -77,6 +93,28 @@ def expr_from_dict(d: Dict[str, Any]) -> Expr:
         return IsIn(expr_from_dict(d["child"]), d["values"])
     if t == "isnull":
         return IsNull(expr_from_dict(d["child"]), d.get("negated", False))
+    if t == "udf":
+        module, qualname = d.get("module"), d.get("qualname")
+        if not (module and qualname) or "<" in qualname:
+            raise HyperspaceException(
+                f"Cannot deserialize UDF {d.get('name')!r}: lambdas and local "
+                "functions cannot round-trip; define the UDF at module scope"
+            )
+        try:
+            import importlib
+
+            obj = importlib.import_module(module)
+            for part in qualname.split("."):
+                obj = getattr(obj, part)
+            fn = obj
+        except Exception as e:
+            # Chain the real cause — an import-time bug must not masquerade
+            # as a naming problem.
+            raise HyperspaceException(
+                f"Cannot deserialize UDF {d.get('name')!r}: importing "
+                f"{module}.{qualname} failed: {type(e).__name__}: {e}"
+            ) from e
+        return Udf(fn, d["dtype"], [expr_from_dict(a) for a in d["args"]], d.get("name"))
     raise HyperspaceException(f"Cannot deserialize expression tag: {t}")
 
 
@@ -179,6 +217,12 @@ def plan_to_dict(plan: LogicalPlan) -> Dict[str, Any]:
             "t": "union",
             "children": [plan_to_dict(c) for c in plan.children()],
         }
+    if isinstance(plan, (IntersectNode, ExceptNode)):
+        return {
+            "t": "intersect" if isinstance(plan, IntersectNode) else "except",
+            "left": plan_to_dict(plan.left),
+            "right": plan_to_dict(plan.right),
+        }
     raise HyperspaceException(f"Cannot serialize plan node: {plan.simple_string()}")
 
 
@@ -213,6 +257,10 @@ def plan_from_dict(d: Dict[str, Any]) -> LogicalPlan:
         )
     if t == "union":
         return UnionNode([plan_from_dict(c) for c in d["children"]])
+    if t == "intersect":
+        return IntersectNode(plan_from_dict(d["left"]), plan_from_dict(d["right"]))
+    if t == "except":
+        return ExceptNode(plan_from_dict(d["left"]), plan_from_dict(d["right"]))
     raise HyperspaceException(f"Cannot deserialize plan tag: {t}")
 
 

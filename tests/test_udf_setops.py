@@ -1,0 +1,202 @@
+"""UDF escape hatch + INTERSECT/EXCEPT set operations (round-5 expression-
+surface slice; reference wraps exactly these in its serde,
+`index/serde/package.scala:59-186`, and exercises them in
+`LogicalPlanSerDeTests.scala`)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import HyperspaceException, IndexConfig, IndexConstants
+from hyperspace_tpu.engine import HyperspaceSession, col, udf
+from hyperspace_tpu.hyperspace import (
+    Hyperspace,
+    disable_hyperspace,
+    enable_hyperspace,
+)
+from hyperspace_tpu.serde.plan_serde import deserialize_plan, serialize_plan
+
+
+@pytest.fixture()
+def session(tmp_path):
+    s = HyperspaceSession(warehouse=str(tmp_path))
+    s.conf.set(IndexConstants.INDEX_SYSTEM_PATH, str(tmp_path / "indexes"))
+    s.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 8)
+    return s
+
+
+# Module-scope so the serde round-trip can re-import it.
+def double_plus_one(x):
+    return None if x is None else 2 * x + 1
+
+
+def tier_of(q):
+    return "big" if q is not None and q > 5 else "small"
+
+
+class TestUdf:
+    def test_with_column_udf_numeric_and_nulls(self, session, tmp_path):
+        session.write_parquet(
+            {"k": [1, 2, 3], "q": [1, None, 7]}, str(tmp_path / "t")
+        )
+        f = udf(double_plus_one, "int64")
+        df = session.read.parquet(str(tmp_path / "t")).with_column("d", f(col("q")))
+        got = {r[0]: r[2] for r in df.select("k", "q", "d").collect().rows()}
+        assert got == {1: 3, 2: None, 3: 15}
+
+    def test_udf_string_result_and_filter(self, session, tmp_path):
+        session.write_parquet({"q": [1, 9, 3, 8]}, str(tmp_path / "t"))
+        tier = udf(tier_of, "string")
+        df = (
+            session.read.parquet(str(tmp_path / "t"))
+            .with_column("tier", tier(col("q")))
+            .filter(col("tier") == "big")
+            .select("q")
+        )
+        assert sorted(r[0] for r in df.collect().rows()) == [8, 9]
+
+    def test_index_still_fires_under_udf_projection(self, session, tmp_path):
+        """The join index must apply when a UDF column is computed ABOVE the
+        join from covered columns (the reference's UDF-tolerance contract)."""
+        rng = np.random.RandomState(4)
+        session.write_parquet(
+            {
+                "k": rng.randint(0, 40, 3000).astype(np.int64),
+                "qty": rng.randint(1, 9, 3000).astype(np.int64),
+            },
+            str(tmp_path / "l"),
+        )
+        session.write_parquet(
+            {"k2": np.arange(40, dtype=np.int64), "w": np.arange(40, dtype=np.int64)},
+            str(tmp_path / "r"),
+        )
+        hs = Hyperspace(session)
+        hs.create_index(
+            session.read.parquet(str(tmp_path / "l")), IndexConfig("ul", ["k"], ["qty"])
+        )
+        hs.create_index(
+            session.read.parquet(str(tmp_path / "r")), IndexConfig("ur", ["k2"], ["w"])
+        )
+        f = udf(double_plus_one, "int64")
+
+        def q():
+            l = session.read.parquet(str(tmp_path / "l"))
+            r = session.read.parquet(str(tmp_path / "r"))
+            return (
+                l.join(r, col("k") == col("k2"))
+                .with_column("dq", f(col("qty")))
+                .select("dq", "w")
+            )
+
+        disable_hyperspace(session)
+        expected = q().sorted_rows()
+        enable_hyperspace(session)
+        assert "ul" in q().explain_string()
+        assert q().sorted_rows() == expected
+
+    def test_udf_serde_round_trip(self, session, tmp_path):
+        session.write_parquet({"q": [1, 2]}, str(tmp_path / "t"))
+        f = udf(double_plus_one, "int64")
+        df = session.read.parquet(str(tmp_path / "t")).with_column("d", f(col("q")))
+        plan2 = deserialize_plan(serialize_plan(df.plan))
+        assert "udf:double_plus_one" in plan2.tree_string()
+        from hyperspace_tpu.engine.session import DataFrame
+
+        assert DataFrame(session, plan2).collect().rows() == df.collect().rows()
+
+    def test_udf_lambda_serde_fails_loudly(self, session, tmp_path):
+        session.write_parquet({"q": [1]}, str(tmp_path / "t"))
+        f = udf(lambda x: x, "int64")
+        df = session.read.parquet(str(tmp_path / "t")).with_column("d", f(col("q")))
+        with pytest.raises(HyperspaceException, match="cannot round-trip"):
+            deserialize_plan(serialize_plan(df.plan))
+
+
+class TestSetOps:
+    def _two(self, session, tmp_path):
+        session.write_parquet(
+            {"k": [1, 2, 2, 3, None], "v": ["a", "b", "b", "c", "d"]},
+            str(tmp_path / "l"),
+        )
+        session.write_parquet(
+            {"k": [2, 3, 4, None], "v": ["b", "zzz", "e", "d"]},
+            str(tmp_path / "r"),
+        )
+        return (
+            session.read.parquet(str(tmp_path / "l")),
+            session.read.parquet(str(tmp_path / "r")),
+        )
+
+    def test_intersect_distinct_null_aware(self, session, tmp_path):
+        l, r = self._two(session, tmp_path)
+        got = l.intersect(r).sorted_rows()
+        # (2,b) in both; (None,d): nulls compare equal in set ops (SQL).
+        assert got == sorted([(2, "b"), (None, "d")], key=lambda t: tuple(str(x) for x in t))
+
+    def test_except_distinct(self, session, tmp_path):
+        l, r = self._two(session, tmp_path)
+        got = l.subtract(r).sorted_rows()
+        assert got == sorted(
+            [(1, "a"), (3, "c")], key=lambda t: tuple(str(x) for x in t)
+        )
+        # right-side absent rows don't appear; duplicates deduped.
+        assert l.subtract(l).count() == 0
+
+    def test_setop_schema_mismatch_raises(self, session, tmp_path):
+        l, _ = self._two(session, tmp_path)
+        session.write_parquet({"x": [1]}, str(tmp_path / "other"))
+        other = session.read.parquet(str(tmp_path / "other"))
+        with pytest.raises(Exception):
+            l.intersect(other)
+
+    def test_setop_serde_round_trip(self, session, tmp_path):
+        l, r = self._two(session, tmp_path)
+        for df in (l.intersect(r), l.subtract(r)):
+            plan2 = deserialize_plan(serialize_plan(df.plan))
+            from hyperspace_tpu.engine.session import DataFrame
+
+            assert DataFrame(session, plan2).sorted_rows() == df.sorted_rows()
+
+    def test_setop_composes_with_index_rewrites(self, session, tmp_path):
+        """A filter under an intersect still gets the filter-index rewrite and
+        the oracle equality holds."""
+        session.write_parquet(
+            {"name": [f"n{i:02d}" for i in range(50)], "v": list(range(50))},
+            str(tmp_path / "a"),
+        )
+        session.write_parquet(
+            {"name": [f"n{i:02d}" for i in range(0, 50, 2)], "v": list(range(0, 50, 2))},
+            str(tmp_path / "b"),
+        )
+        hs = Hyperspace(session)
+        hs.create_index(
+            session.read.parquet(str(tmp_path / "a")),
+            IndexConfig("sa", ["name"], ["v"]),
+        )
+
+        def q():
+            a = session.read.parquet(str(tmp_path / "a")).filter(col("name") < "n10")
+            b = session.read.parquet(str(tmp_path / "b"))
+            return a.select("name", "v").intersect(b.select("name", "v"))
+
+        disable_hyperspace(session)
+        expected = q().sorted_rows()
+        enable_hyperspace(session)
+        assert "sa" in q().explain_string()
+        assert q().sorted_rows() == expected
+        assert len(expected) == 5  # n00..n08 even
+
+
+def test_string_udf_over_zero_rows(session, tmp_path):
+    """A rows-eliminating filter beneath a string UDF must not crash (empty
+    object arrays can't infer stringness)."""
+    session.write_parquet({"q": [1, 2, 3]}, str(tmp_path / "t"))
+    tier = udf(tier_of, "string")
+    df = (
+        session.read.parquet(str(tmp_path / "t"))
+        .filter(col("q") > 100)
+        .with_column("tier", tier(col("q")))
+    )
+    assert df.collect().rows() == []
+    assert df.schema.names == ["q", "tier"]
