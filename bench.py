@@ -701,6 +701,43 @@ def _device_section(s, base, col, runs, backend) -> dict:
         except Exception as e:
             out["pallas_probe_error"] = f"{type(e).__name__}: {e}"[:300]
 
+    # (d) the Pallas in-VMEM bitonic sort vs the XLA argsort, at a bounded
+    # sub-shape inside the kernel's VMEM budget (same honesty contract as the
+    # probe comparison: prefix slices of the real padded matrices).
+    if backend == "tpu" or os.environ.get("HYPERSPACE_PALLAS_SORT") == "1":
+        try:
+            import jax.numpy as jnp
+
+            from hyperspace_tpu.ops.pallas_sort import (
+                shape_supported as sort_shape_ok,
+                sort_padded_with_order,
+            )
+
+            cap_s = min(int(lk.shape[1]), 8192)
+            Bs = int(lk.shape[0])
+            if not sort_shape_ok(Bs, cap_s):
+                raise ValueError(f"unsupported pallas sort shape B={Bs} cap={cap_s}")
+            if not jnp.issubdtype(lk.dtype, jnp.integer):
+                raise ValueError(f"sort comparison needs integer keys, got {lk.dtype}")
+            unsorted = jnp.flip(lk[:, :cap_s].astype(jnp.int64), axis=1)  # de-sort
+
+            def pl_sort():
+                jax.block_until_ready(sort_padded_with_order(unsorted))
+
+            def xla_sort():
+                order = jnp.argsort(unsorted, axis=1)
+                jax.block_until_ready(
+                    (jnp.take_along_axis(unsorted, order, axis=1), order)
+                )
+
+            pl_sort()  # compile
+            xla_sort()
+            out["pallas_sort_sub_p50_s"] = round(timed_p50(pl_sort, runs), 5)
+            out["xla_sort_sub_p50_s"] = round(timed_p50(xla_sort, runs), 5)
+            out["sort_sub_shape"] = [Bs, cap_s]
+        except Exception as e:
+            out["pallas_sort_error"] = f"{type(e).__name__}: {e}"[:300]
+
     # Measured traffic: the probe reads both padded key matrices; pad+sort
     # reads+writes the left one.
     probe_bytes = int(lk.nbytes) + int(rk.nbytes)

@@ -265,6 +265,13 @@ def _evaluate_udf(expr: Udf, table, devcols: Dict[str, jnp.ndarray]) -> _Val:
             data = np.asarray(v.dictionary)[np.asarray(v.arr)]
         else:
             data = np.asarray(v.arr)
+        if data.ndim == 0:
+            # Literal arithmetic yields 0-d results (the same case
+            # evaluate_column broadcasts): treat as a per-row constant.
+            prepared.append(("lit", data.item(), None))
+            continue
+        if valid is not None and valid.ndim == 0:
+            valid = np.full(data.shape, bool(valid))
         prepared.append(("arr", data, valid))
     out = []
     for i in range(n):

@@ -33,18 +33,29 @@ def _cap_pow2(n: int) -> int:
 
 
 @partial(jax.jit, static_argnums=(2, 3))
-def _pad_and_sort(keys, starts, num_buckets: int, cap: int):
-    """Scatter per-row keys (concatenated in bucket order) into a sorted [B, cap]
-    matrix. Returns (sorted_keys [B,cap], order [B,cap] slot→original-slot, lengths)."""
+def _pad_scatter(keys, starts, num_buckets: int, cap: int):
+    """Scatter per-row keys (concatenated in bucket order) into an UNSORTED
+    padded [B, cap] matrix (pad = i64 max) + per-bucket lengths — the input
+    shape the Pallas in-VMEM sort consumes."""
     n = keys.shape[0]
     pos = jnp.arange(n)
     b_of_row = jnp.searchsorted(starts, pos, side="right") - 1
     slot = pos - starts[b_of_row]
     padded = jnp.full((num_buckets, cap), _PAD, dtype=jnp.int64)
     padded = padded.at[b_of_row, slot].set(keys)
+    lengths = starts[1:] - starts[:-1]
+    return padded, lengths
+
+
+@partial(jax.jit, static_argnums=(2, 3))
+def _pad_and_sort(keys, starts, num_buckets: int, cap: int):
+    """Scatter per-row keys (concatenated in bucket order) into a sorted [B, cap]
+    matrix. Returns (sorted_keys [B,cap], order [B,cap] slot→original-slot, lengths).
+    ONE scatter implementation: composes `_pad_scatter` (jit nests fine), so the
+    Pallas and XLA paths can never diverge on the bucket-mapping semantics."""
+    padded, lengths = _pad_scatter(keys, starts, num_buckets, cap)
     order = jnp.argsort(padded, axis=1)
     sorted_keys = jnp.take_along_axis(padded, order, axis=1)
-    lengths = starts[1:] - starts[:-1]
     return sorted_keys, order, lengths
 
 
@@ -223,11 +234,29 @@ def pad_buckets_by_value(vals, starts_np: np.ndarray) -> Optional[PaddedBuckets]
 
 def pad_buckets_by_hash(key64_arr, starts_np: np.ndarray) -> PaddedBuckets:
     """Hash-key padded matrices (argsort within bucket) for the general case:
-    multi-column or string keys, nullable keys, or unsorted buckets."""
+    multi-column or string keys, nullable keys, or unsorted buckets. Within
+    its VMEM shape budget the in-bucket sort dispatches to the Pallas
+    single-pass bitonic kernel (`ops.pallas_sort`), guarded like the probe —
+    any lowering failure falls back to the XLA argsort permanently."""
+    from .pallas_sort import (
+        pallas_sort_wanted,
+        record_sort_failure,
+        sort_padded_with_order,
+    )
+
     B = len(starts_np) - 1
     lens = np.diff(starts_np)
     cap = _cap_pow2(int(lens.max())) if B else 1
     keys_nudged = jnp.minimum(jnp.asarray(key64_arr), _PAD - 1)
+    if pallas_sort_wanted(B, cap):
+        try:
+            padded, lengths = _pad_scatter(
+                keys_nudged, jnp.asarray(starts_np), B, cap
+            )
+            keys, order = sort_padded_with_order(padded)
+            return PaddedBuckets(keys, lengths, np.asarray(order), starts_np, "hash")
+        except Exception as e:  # Mosaic lowering/runtime problems
+            record_sort_failure(e)
     keys, order, lengths = _pad_and_sort(keys_nudged, jnp.asarray(starts_np), B, cap)
     return PaddedBuckets(keys, lengths, np.asarray(order), starts_np, "hash")
 

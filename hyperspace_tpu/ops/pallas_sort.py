@@ -1,0 +1,180 @@
+"""Pallas TPU kernel for the in-bucket sort (hash-mode padded reps).
+
+`bucket_join._pad_and_sort` sorts each padded bucket row with `jnp.argsort`
+(XLA variadic sort): at round-4 bench shapes that was the DOMINANT device
+kernel (pad+sort 5.49 s vs the probe's 1.15 s at 8M rows) — a bitonic network
+whose every stage round-trips HBM. This kernel keeps a whole [TB, cap] bucket
+group resident in VMEM and runs the complete bitonic network in one
+`pallas_call` — a single HBM read + write per element regardless of the
+network's O(log² cap) stages. That trade only exists while the block fits
+VMEM, so the dispatcher gates on cap (pow2 by construction — `_cap_pow2`).
+
+Formulation: compare-exchange at stride j is a reshape to [TB, m, 2, j] —
+lane-local slicing, no gathers (partner i^j sits at [..., 1, :] of the pair
+axis). Keys are 64-bit, pre-split OUTSIDE the kernel into the same
+lexicographic (hi, lo) int32 pair the probe kernel uses (no 64-bit values on
+the VPU; no 64-bit bitcasts for the relay's X64-elimination to reject). The
+row-index payload rides the exchanges, so the kernel returns both sorted keys
+and the argsort permutation in one pass.
+
+Bitonic networks are NOT stable; equal keys land in arbitrary order. That is
+sound here by the same argument as hash collisions: the probe emits the whole
+equal-key RANGE and verification compares actual values, so any permutation
+within an equal run yields the identical pair set.
+
+Equivalence with `jnp.argsort` is pinned by tests/test_pallas_sort.py
+(interpret mode off-TPU); the guarded dispatcher falls back to the XLA path
+on any lowering failure, scoped with the same latch discipline as the probe.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_ENV_KEY = "HYPERSPACE_PALLAS_SORT"
+# VMEM budget: 3 int32 payloads (hi, lo, idx) x in/out + temps. TB=8 rows of
+# cap=32768 is ~6 MB — comfortable; 65536 doubles it and starts crowding
+# double-buffering, so the gate stops at 32768.
+_MAX_CAP = 32768
+_MIN_CAP = 256  # below this the dispatch overhead beats any fusion win
+_sort_broken: dict = {}  # scoped latch (single kind: "sort")
+
+
+def _pairs_gt(ah, al, bh, bl):
+    """64-bit (hi, lo) lexicographic signed compare: a > b."""
+    return (ah > bh) | ((ah == bh) & (al > bl))
+
+
+def _bitonic_body(h, l, idx):
+    """The full bitonic network over the LAST axis of [TB, cap] arrays,
+    python-unrolled (cap is static): O(log² cap) reshape/where stages."""
+    tb, n = h.shape
+    k = 2
+    while k <= n:
+        j = k // 2
+        while j >= 1:
+            m = n // (2 * j)
+            h4 = h.reshape(tb, m, 2, j)
+            l4 = l.reshape(tb, m, 2, j)
+            i4 = idx.reshape(tb, m, 2, j)
+            ah, bh = h4[:, :, 0, :], h4[:, :, 1, :]
+            al, bl = l4[:, :, 0, :], l4[:, :, 1, :]
+            ai, bi = i4[:, :, 0, :], i4[:, :, 1, :]
+            # Direction of the pair's bitonic run: bit log2(k) of the lower
+            # element's global position g*2j (t < j never reaches that bit).
+            g = jax.lax.broadcasted_iota(jnp.int32, (tb, m, 1, j), 1)
+            desc = ((g * (2 * j)) & k) > 0
+            desc = desc[:, :, 0, :]
+            swap = _pairs_gt(ah, al, bh, bl) != desc
+            nah = jnp.where(swap, bh, ah)
+            nbh = jnp.where(swap, ah, bh)
+            nal = jnp.where(swap, bl, al)
+            nbl = jnp.where(swap, al, bl)
+            nai = jnp.where(swap, bi, ai)
+            nbi = jnp.where(swap, ai, bi)
+            h = jnp.stack([nah, nbh], axis=2).reshape(tb, n)
+            l = jnp.stack([nal, nbl], axis=2).reshape(tb, n)
+            idx = jnp.stack([nai, nbi], axis=2).reshape(tb, n)
+            j //= 2
+        k *= 2
+    return h, l, idx
+
+
+def _sort_kernel(h_ref, l_ref, i_ref, ho_ref, lo_ref, io_ref):
+    h, l, idx = _bitonic_body(h_ref[...], l_ref[...], i_ref[...])
+    ho_ref[...] = h
+    lo_ref[...] = l
+    io_ref[...] = idx
+
+
+def _bucket_tile(B: int) -> int:
+    """Same legality rule as the probe kernel: 8-row groups when divisible,
+    whole axis otherwise (equal-to-dimension)."""
+    return 8 if B % 8 == 0 else B
+
+
+def shape_supported(B: int, cap: int) -> bool:
+    if B <= 0 or cap < _MIN_CAP or cap > _MAX_CAP:
+        return False
+    if cap & (cap - 1):
+        return False  # bitonic needs pow2 (guaranteed by _cap_pow2 upstream)
+    tb = _bucket_tile(B)
+    if tb > 8 and B > 8:
+        return False  # whole-axis block beyond 8 rows would blow VMEM
+    return True
+
+
+@partial(jax.jit, static_argnums=(3,))
+def _sort_pallas_call(hi, lo, idx, interpret: bool):
+    B, cap = hi.shape
+    TB = _bucket_tile(B)
+    grid = (B // TB,)
+    spec = pl.BlockSpec((TB, cap), lambda b: (b, 0))
+    return pl.pallas_call(
+        _sort_kernel,
+        grid=grid,
+        in_specs=[spec, spec, spec],
+        out_specs=[spec, spec, spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, cap), jnp.int32),
+            jax.ShapeDtypeStruct((B, cap), jnp.int32),
+            jax.ShapeDtypeStruct((B, cap), jnp.int32),
+        ],
+        interpret=interpret,
+    )(hi, lo, idx)
+
+
+@jax.jit
+def _recombine(hi, lo):
+    """(hi, lo) int32 pair → the original int64 keys (undo `_split_hi_lo`)."""
+    h = hi.astype(jnp.int64) << 32
+    l = (lo.astype(jnp.int64) + jnp.int64(0x80000000)) & jnp.int64(0xFFFFFFFF)
+    return h | l
+
+
+def sort_padded_with_order(keys_i64) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Drop-in replacement for the argsort+gather inside `_pad_and_sort`:
+    returns (sorted_keys int64 [B, cap], order int32 [B, cap]) where
+    `sorted[b, s] == keys[b, order[b, s]]`. Equal keys may permute (bitonic
+    is unstable) — sound for the join, see the module docstring."""
+    from .pallas_probe import _split_hi_lo
+
+    keys_i64 = jnp.asarray(keys_i64)
+    B, cap = keys_i64.shape
+    hi, lo = _split_hi_lo(keys_i64)
+    idx = jnp.broadcast_to(jnp.arange(cap, dtype=jnp.int32)[None, :], (B, cap))
+    interpret = jax.default_backend() != "tpu"
+    sh, sl, order = _sort_pallas_call(hi, lo, idx, interpret)
+    return _recombine(sh, sl), order
+
+
+def pallas_sort_wanted(B: int, cap: int) -> bool:
+    """Dispatch decision: forced by env (1/0), else auto on TPU within the
+    VMEM shape budget. Any lowering failure latches a permanent fallback
+    (scoped to the sort; the validated probe kernel is unaffected)."""
+    if "sort" in _sort_broken:
+        return False
+    mode = os.environ.get(_ENV_KEY, "auto")
+    if mode == "0":
+        return False
+    if not shape_supported(B, cap):
+        return False
+    if mode == "1":
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def record_sort_failure(exc: BaseException) -> None:
+    import logging
+
+    _sort_broken["sort"] = f"{type(exc).__name__}: {exc}"
+    logging.getLogger("hyperspace_tpu.ops").warning(
+        "pallas sort failed; falling back to the XLA sort permanently: %s",
+        _sort_broken["sort"],
+    )

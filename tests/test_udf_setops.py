@@ -200,3 +200,16 @@ def test_string_udf_over_zero_rows(session, tmp_path):
     )
     assert df.collect().rows() == []
     assert df.schema.names == ["q", "tier"]
+
+
+def test_udf_scalar_literal_argument(session, tmp_path):
+    """A UDF argument that evaluates to a 0-d scalar (literal arithmetic) is
+    broadcast as a per-row constant, matching evaluate_column's behavior."""
+    from hyperspace_tpu.engine import lit
+
+    session.write_parquet({"q": [1, 2, 3]}, str(tmp_path / "t"))
+    f = udf(lambda a, b: a + b, "int64")
+    df = session.read.parquet(str(tmp_path / "t")).with_column(
+        "z", f(col("q"), lit(2) + lit(3))
+    )
+    assert [r[1] for r in df.select("q", "z").collect().rows()] == [6, 7, 8]
