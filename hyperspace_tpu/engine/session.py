@@ -116,6 +116,21 @@ class DataFrame:
 
         return DataFrame(self.session, ExceptNode(self.plan, other.plan))
 
+    def scalar(self):
+        """The single value of a 1x1 result — the scalar-subquery pattern
+        (`WHERE x > (SELECT max(...) FROM ...)`) as eager composition:
+
+            df.filter(col("x") > other.group_by().agg(m=("x", "max")).scalar())
+
+        Raises unless the result is exactly one row x one column."""
+        t = self.collect()
+        if t.num_rows != 1 or len(t.column_names) != 1:
+            raise HyperspaceException(
+                f"scalar() requires a 1x1 result, got "
+                f"{t.num_rows}x{len(t.column_names)}"
+            )
+        return t.rows()[0][0]
+
     def drop(self, *columns: str) -> "DataFrame":
         """Project away the named columns (missing names are ignored, like
         Spark's drop). Name matching honors `hyperspace.resolution.caseSensitive`

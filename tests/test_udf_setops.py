@@ -213,3 +213,16 @@ def test_udf_scalar_literal_argument(session, tmp_path):
         "z", f(col("q"), lit(2) + lit(3))
     )
     assert [r[1] for r in df.select("q", "z").collect().rows()] == [6, 7, 8]
+
+
+def test_scalar_subquery_pattern(session, tmp_path):
+    """df.scalar(): the scalar-subquery composition (eager, like the
+    reference's serde-wrapped ScalarSubquery in spirit)."""
+    session.write_parquet({"x": [1, 5, 9, 3]}, str(tmp_path / "t"))
+    df = session.read.parquet(str(tmp_path / "t"))
+    mx = df.group_by().agg(m=("x", "max")).scalar()
+    assert mx == 9
+    above_avg = df.filter(col("x") > df.group_by().agg(a=("x", "avg")).scalar())
+    assert sorted(r[0] for r in above_avg.collect().rows()) == [5, 9]
+    with pytest.raises(HyperspaceException, match="1x1"):
+        df.scalar()
