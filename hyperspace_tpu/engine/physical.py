@@ -1368,11 +1368,18 @@ class SortMergeJoinExec(PhysicalNode):
         count-only query skips the whole gather/concat of payload columns.
         Bucketed inner joins go further: the count never leaves the device
         (`_bucketed_count_fast`)."""
+        pre = None
         if self.bucketed and self.how == "inner":
             n = self._bucketed_count_fast(ctx)
             if n is not None:
                 return n
-        left, right, li, ri = self._compute_pairs(ctx)
+        elif not self.bucketed and self.how == "inner":
+            # Children execute ONCE: the fast path and the fallback share them.
+            pre = self._exec_general_children(ctx)
+            n = self._general_count_fast(ctx, pre)
+            if n is not None:
+                return n
+        left, right, li, ri = self._compute_pairs(ctx, pre)
         how = self.how
         if how == "inner":
             return len(li)
@@ -1388,26 +1395,31 @@ class SortMergeJoinExec(PhysicalNode):
             n += right.num_rows - len(np.unique(ri))
         return n
 
-    def _compute_pairs(self, ctx) -> Tuple[Table, Table, np.ndarray, np.ndarray]:
-        """Execute both children and produce the VERIFIED join pair indices."""
-        if self.bucketed:
-            return self._bucketed_pairs(ctx)
+    def _exec_general_children(self, ctx):
+        """Execute both (non-bucketed) children BELOW any exchange markers:
+        (lex, rex, lt, rt) with lex/rex None when no joint exchange applies."""
         lex = self._unwrap_exchange(self.left)
         rex = self._unwrap_exchange(self.right)
         if lex is not None and rex is not None and ctx.session is not None:
+            return lex, rex, lex.child.execute(ctx), rex.child.execute(ctx)
+        return None, None, self.left.execute(ctx), self.right.execute(ctx)
+
+    def _compute_pairs(self, ctx, pre=None) -> Tuple[Table, Table, np.ndarray, np.ndarray]:
+        """Execute both children and produce the VERIFIED join pair indices.
+        `pre` threads already-executed children in (the count fast path shares
+        its execution with this fallback)."""
+        if self.bucketed:
+            return self._bucketed_pairs(ctx)
+        lex, rex, lt, rt = pre if pre is not None else self._exec_general_children(ctx)
+        if lex is not None and rex is not None:
             # Joint exchange decision: both sides exchange over the mesh, or
             # neither — a one-sided exchange would pay a full all_to_all whose
             # co-partition layout the join could never use.
-            lt = lex.child.execute(ctx)
-            rt = rex.child.execute(ctx)
             mesh = ctx.session.mesh_for(lt.num_rows + rt.num_rows)
             if mesh is not None and lt.num_rows > 0 and rt.num_rows > 0:
                 ppd = _partitions_per_device(ctx)
                 lt = lex.exchange_table(mesh, lt, ppd)
                 rt = rex.exchange_table(mesh, rt, ppd)
-        else:
-            lt = self.left.execute(ctx)
-            rt = self.right.execute(ctx)
         pairs = self._copartitioned_pairs(lt, rt)
         if pairs is not None:
             li, ri = _verify_pairs(
@@ -1548,6 +1560,51 @@ class SortMergeJoinExec(PhysicalNode):
         )
         li, ri = (bi, ai) if swapped else (ai, bi)
         lanes, flat = _verify_lanes(left, right, self.left_keys, self.right_keys)
+        return int(_verified_count_jit(lanes, li, ri, valid, *flat))
+
+    def _general_count_fast(self, ctx, pre) -> Optional[int]:
+        """Inner-join row count for the GENERAL (non-bucketed) path without
+        pulling pairs to the host: the global sort+probe (`_merge_phase_a`)
+        already runs on device; candidate enumeration + exact verification
+        reuse the bucketed machinery as its one-bucket special case. On the
+        relay the old path pulled ~16 bytes per candidate pair to the host —
+        this keeps the NON-indexed baseline count on-device too, so the bench
+        compares two equally-tuned paths. `pre` carries the already-executed
+        children (shared with the `_compute_pairs` fallback). None when not
+        applicable (CPU backend, mesh execution)."""
+        from ..ops.backend import use_device_path
+        from ..ops.bucket_join import _cap_pow2, _expand_pairs_dev
+        from ..ops.join import _merge_phase_a
+
+        if not use_device_path():
+            return None
+        _lex, _rex, lt, rt = pre
+        if lt.num_rows == 0 or rt.num_rows == 0:
+            return 0
+        if (
+            ctx.session is not None
+            and ctx.session.mesh_for(lt.num_rows + rt.num_rows) is not None
+        ):
+            return None  # the distributed exchange path owns mesh-scale counts
+        lk = _table_key64(lt, self.left_keys)
+        rk = _table_key64(rt, self.right_keys)
+        l_order, r_order, lo, counts, total_dev = _merge_phase_a(lk, rk)
+        total = int(total_dev)
+        if total == 0:
+            return 0
+        starts_l = jnp.asarray(np.asarray([0, lt.num_rows], np.int64))
+        starts_r = jnp.asarray(np.asarray([0, rt.num_rows], np.int64))
+        li, ri, valid = _expand_pairs_dev(
+            _cap_pow2(total),
+            True,
+            lo[None, :],
+            counts[None, :],
+            starts_l,
+            starts_r,
+            l_order[None, :],
+            r_order[None, :],
+        )
+        lanes, flat = _verify_lanes(lt, rt, self.left_keys, self.right_keys)
         return int(_verified_count_jit(lanes, li, ri, valid, *flat))
 
     def _device_pairs_compacted(self, left: Table, right: Table, l_starts, r_starts):

@@ -294,3 +294,35 @@ def test_fused_agg_collision_rename_matches_unfused(dev_session, tmp_path):
     assert got == expected
     for y, ssum, n in got:
         assert 100 * n <= ssum <= 106 * n, (y, ssum, n)  # right.y, not the literal
+
+
+def test_general_join_device_count_matches_oracle(dev_session, tmp_path):
+    """The NON-indexed (general sort-merge) inner-join count also stays on
+    device: string keys + nulls, against the materializing oracle."""
+    s = dev_session
+    base = str(tmp_path)
+    rng = np.random.RandomState(8)
+    sk = np.array([f"g{i % 70:02d}" for i in range(6000)], dtype=object)
+    sk[::101] = None
+    s.write_parquet(
+        {"gk": sk, "v": rng.randint(0, 5, 6000).astype(np.int64)},
+        os.path.join(base, "gl"),
+    )
+    s.write_parquet(
+        {
+            "gk2": np.array([f"g{i:02d}" for i in range(90)]),
+            "w": np.arange(90, dtype=np.int64),
+        },
+        os.path.join(base, "gr"),
+    )
+
+    def q():
+        l = s.read.parquet(os.path.join(base, "gl"))
+        r = s.read.parquet(os.path.join(base, "gr"))
+        return l.join(r, col("gk") == col("gk2")).select("v", "w")
+
+    # No indexes at all: this is the general path.
+    disable_hyperspace(s)
+    expected_rows = len(q().collect().rows())
+    assert q().count() == expected_rows
+    assert expected_rows < 6000  # nulls dropped
