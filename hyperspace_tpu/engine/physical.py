@@ -630,6 +630,42 @@ class _JoinedDeviceEnv:
         )
         return DevCol(col.dtype, arr, col.dictionary, valid)
 
+    def prefetch(self, names) -> None:
+        """Gather EVERY named source column (+ validity lanes) in ONE compiled
+        program — on a remote PJRT transport each eager gather is a dispatch
+        round-trip, so a 6-column aggregate pays 1 RTT here instead of ~8.
+        Unresolvable/computed names are skipped (get() handles them)."""
+        from ..ops.aggregate import DevCol
+
+        plan: Dict[str, Column] = {}  # lname -> source column
+        sides, arrays = [], []
+        for name in names:
+            lname = name.lower()
+            if lname in self._cache or lname in self._computed or lname in plan:
+                continue
+            try:
+                side, col = self._resolve_source(name)
+            except KeyError:
+                continue
+            plan[lname] = col
+            sides.append(side)
+            arrays.append(device_array(col.data))
+            if col.validity is not None:
+                sides.append(side)
+                arrays.append(device_array(col.validity))
+        if not plan:
+            return
+        gathered = _gather_many_jit(tuple(sides), self.li, self.ri, *arrays)
+        i = 0
+        for lname, col in plan.items():
+            arr = gathered[i]
+            i += 1
+            valid = None
+            if col.validity is not None:
+                valid = gathered[i]
+                i += 1
+            self._cache[lname] = DevCol(col.dtype, arr, col.dictionary, valid)
+
     def get(self, name: str):
         lname = name.lower()
         hit = self._cache.get(lname)
@@ -782,6 +818,29 @@ class HashAggregateExec(PhysicalNode):
         row_valid = None if n_keep == out_cap else jnp.arange(out_cap) < n_keep
         try:
             env = _JoinedDeviceEnv(left, right, li, ri, out_cap)
+            # One batched gather for every SOURCE column this aggregate will
+            # touch. Shadow-aware in execution order: a reference resolves to
+            # the source value only until some withColumn shadows the name —
+            # after that it reads the computed column, so prefetching the
+            # source would be a full-pair-count gather thrown away.
+            needed = []
+            shadowed: set = set()
+            for wc in reversed(withcols):  # execution order: innermost first
+                needed += [
+                    n
+                    for n in sorted(wc.expr.references())
+                    if n.lower() not in shadowed
+                ]
+                shadowed.add(wc.col_name.lower())
+            needed += [
+                n
+                for n in (
+                    list(self.group_keys)
+                    + [cn for _, _, cn in self.aggs if cn is not None]
+                )
+                if n.lower() not in shadowed
+            ]
+            env.prefetch(needed)
             for wc in reversed(withcols):  # innermost applies first
                 env.add_computed(wc.col_name, wc.expr, wc.dtype)
             from ..ops.aggregate import hash_aggregate_device
@@ -1303,6 +1362,13 @@ def _verify_lanes(
 from functools import partial as _fpartial
 
 import jax as _jax
+
+
+@_fpartial(_jax.jit, static_argnums=(0,))
+def _gather_many_jit(sides: tuple, li, ri, *arrays):
+    """Batch gather through the join pair indices: one program for all
+    payload columns of a fused join→aggregate."""
+    return tuple(a[li if s == "l" else ri] for s, a in zip(sides, arrays))
 
 
 @_fpartial(_jax.jit, static_argnums=(0,))

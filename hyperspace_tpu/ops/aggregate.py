@@ -195,11 +195,10 @@ def _global_aggregate(table: Table, aggs: Sequence[AggTriple]) -> Table:
     return Table(out)
 
 
-@_partial(jax.jit, static_argnums=(0, 1, 2))
-def _seg_reduce_jit(fn: str, n_groups: int, has_valid: bool, gid, perm, x, valid=None):
-    """One aggregate's whole device pipeline (permute + mask + segment reduce)
-    as a single compiled program, keyed on (fn, n_groups, validity presence,
-    shapes/dtypes). Returns (values, n_valid)."""
+def _seg_reduce_body(fn: str, n_groups: int, has_valid: bool, gid, perm, x, valid=None):
+    """One aggregate's permute + mask + segment reduce — the traced body shared
+    by the single-agg program and the all-aggs-fused program. Returns
+    (values, n_valid)."""
     n = x.shape[0]
     v = valid[perm] if has_valid else jnp.ones(n, bool)
     n_valid = jax.ops.segment_sum(v.astype(jnp.int64), gid, num_segments=n_groups)
@@ -226,6 +225,33 @@ def _seg_reduce_jit(fn: str, n_groups: int, has_valid: bool, gid, perm, x, valid
     masked = jnp.where(v, xs, fill)
     reduce = jax.ops.segment_min if fn == "min" else jax.ops.segment_max
     return reduce(masked, gid, num_segments=n_groups), n_valid
+
+
+@_partial(jax.jit, static_argnums=(0, 1, 2))
+def _seg_reduce_jit(fn: str, n_groups: int, has_valid: bool, gid, perm, x, valid=None):
+    """One aggregate's whole device pipeline as a single compiled program,
+    keyed on (fn, n_groups, validity presence, shapes/dtypes)."""
+    return _seg_reduce_body(fn, n_groups, has_valid, gid, perm, x, valid)
+
+
+@_partial(jax.jit, static_argnums=(0, 1))
+def _seg_reduce_multi_jit(specs: tuple, n_groups: int, gid, perm, *flat):
+    """EVERY aggregate's segment reduction in ONE compiled program — on a
+    remote PJRT transport each dispatch is a round-trip, so a 4-aggregate
+    query pays 1 RTT here instead of 4. `specs[i] = (fn, has_valid)`; `flat`
+    carries x [+ valid] per aggregate in order. XLA CSEs the shared permute.
+    Returns a flat tuple of (values, n_valid) pairs."""
+    out = []
+    i = 0
+    for fn, has_valid in specs:
+        x = flat[i]
+        i += 1
+        valid = None
+        if has_valid:
+            valid = flat[i]
+            i += 1
+        out.extend(_seg_reduce_body(fn, n_groups, has_valid, gid, perm, x, valid))
+    return tuple(out)
 
 
 def _segment_reduce(
@@ -391,28 +417,37 @@ def hash_aggregate_device(
         return None  # collision split: caller takes the exact path
 
     out = dict(rep_cols)
+    # ALL aggregates reduce in ONE compiled program (1 dispatch RTT), results
+    # pulled host-side in ONE transfer.
+    specs, flat, metas = [], [], []
     for out_name, fn, col_name in aggs:
         c = cols[col_name] if col_name is not None else None
         dtype = result_dtype(fn, None if c is None else c.dtype)
         if fn == "count" and c is None:
             # count(*) counts surviving rows: the row_valid lane IS the data.
-            x = row_valid if row_valid is not None else k64
-            args = (x,) + ((row_valid,) if row_valid is not None else ())
-            _, n_valid = _seg_reduce_jit(
-                "count", n_groups, row_valid is not None, gid, perm, *args
-            )
-            out[out_name] = _out_column(fn, None, dtype, np.asarray(n_valid), None)
+            specs.append(("count", row_valid is not None))
+            flat.append(row_valid if row_valid is not None else k64)
+            if row_valid is not None:
+                flat.append(row_valid)
+            metas.append((out_name, fn, None, dtype))
             continue
         v = c.validity
         if row_valid is not None:
             v = row_valid if v is None else (v & row_valid)
-        args = (c.arr,) + ((v,) if v is not None else ())
-        vals, n_valid = _seg_reduce_jit(fn, n_groups, v is not None, gid, perm, *args)
+        specs.append((fn, v is not None))
+        flat.append(c.arr)
+        if v is not None:
+            flat.append(v)
+        metas.append((out_name, fn, c, dtype))
+    results = jax.device_get(
+        _seg_reduce_multi_jit(tuple(specs), n_groups, gid, perm, *flat)
+    )
+    for i, (out_name, fn, c, dtype) in enumerate(metas):
+        vals, n_valid = np.asarray(results[2 * i]), np.asarray(results[2 * i + 1])
         if fn == "count":
-            out[out_name] = _out_column(fn, None, dtype, np.asarray(n_valid), None)
+            out[out_name] = _out_column(fn, None, dtype, n_valid, None)
             continue
-        any_valid = np.asarray(n_valid) > 0
-        out[out_name] = _out_column(fn, c, dtype, np.asarray(vals), any_valid)
+        out[out_name] = _out_column(fn, c, dtype, vals, n_valid > 0)
     return Table(out)
 
 

@@ -326,3 +326,36 @@ def test_general_join_device_count_matches_oracle(dev_session, tmp_path):
     expected_rows = len(q().collect().rows())
     assert q().count() == expected_rows
     assert expected_rows < 6000  # nulls dropped
+
+
+def test_fused_agg_with_shadowing_withcolumn(dev_session, tmp_path):
+    """A withColumn that SHADOWS a source column (reading it in its own
+    expression) must aggregate the computed values, not the source."""
+    s = dev_session
+    base = str(tmp_path)
+    _fact_dim(s, base)
+    hs = Hyperspace(s)
+    hs.create_index(
+        s.read.parquet(os.path.join(base, "fact")),
+        IndexConfig("sh", ["k"], ["qty"]),
+    )
+    hs.create_index(
+        s.read.parquet(os.path.join(base, "dim")), IndexConfig("sd", ["dk"], ["grp"])
+    )
+
+    def q():
+        f = s.read.parquet(os.path.join(base, "fact"))
+        d = s.read.parquet(os.path.join(base, "dim"))
+        return (
+            f.join(d, col("k") == col("dk"))
+            .with_column("qty", col("qty") * 10)  # shadows the source column
+            .group_by("grp")
+            .agg(total=("qty", "sum"))
+            .order_by(("grp", True))
+        )
+
+    disable_hyperspace(s)
+    expected = q().collect().sorted_rows()
+    enable_hyperspace(s)
+    got = q().collect().sorted_rows()
+    assert got == expected
