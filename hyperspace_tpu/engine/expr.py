@@ -193,8 +193,41 @@ class Udf(Expr):
         return tuple(self.args)
 
     def __repr__(self):
+        # repr keys several caches (compiled predicates, filtered-scan
+        # concats), so it must carry FUNCTION identity: two distinct lambdas
+        # both named "<lambda>" over the same args are different expressions.
+        # The uid is stable per function object and never reused (monotonic).
         args = ", ".join(repr(a) for a in self.args)
-        return f"udf:{self.name}({args})"
+        return f"udf:{self.name}#{_udf_uid(self.fn)}({args})"
+
+
+import itertools as _itertools
+import weakref as _weakref
+
+_udf_uids: "_weakref.WeakKeyDictionary" = _weakref.WeakKeyDictionary()
+_udf_counter = _itertools.count()
+
+
+_udf_uids_strong: dict = {}  # id(fn) -> (fn, uid) for non-weakref-able callables
+
+
+def _udf_uid(fn) -> int:
+    """Monotonic id per function OBJECT (weak-keyed: ids die with their
+    functions and are never reused — unlike id(), which the allocator
+    recycles). Non-weakref-able callables (e.g. numpy ufuncs) get a
+    strong-keyed entry: the kept reference pins id(fn) against reuse."""
+    try:
+        u = _udf_uids.get(fn)
+        if u is None:
+            u = next(_udf_counter)
+            _udf_uids[fn] = u
+        return u
+    except TypeError:
+        ent = _udf_uids_strong.get(id(fn))
+        if ent is None or ent[0] is not fn:
+            ent = (fn, next(_udf_counter))
+            _udf_uids_strong[id(fn)] = ent
+        return ent[1]
 
 
 def udf(fn, dtype: str, name: Optional[str] = None):
@@ -229,6 +262,36 @@ def lit(value) -> Lit:
 # ---------------------------------------------------------------------------
 # Analysis helpers used by the rewrite rules
 # ---------------------------------------------------------------------------
+
+
+def canonical_condition_repr(e: Expr, case_sensitive: bool = False) -> str:
+    """Cache-key form of a condition: under case-INsensitive resolution,
+    column spellings are normalized so `col("X") == 1` and `col("x") == 1`
+    share one cache entry (they read the same data) instead of duplicating
+    it. Injective per distinct condition — the structure mirrors each node's
+    repr; unknown node types fall back to repr."""
+    if case_sensitive:
+        return repr(e)
+
+    def walk(x: Expr) -> str:
+        if isinstance(x, Col):
+            return f"col({x.name.lower()})"
+        if isinstance(x, Lit):
+            return repr(x)
+        if isinstance(x, BinaryOp):
+            return f"({walk(x.left)} {x.op} {walk(x.right)})"
+        if isinstance(x, Not):
+            return f"(not {walk(x.child)})"
+        if isinstance(x, IsNull):
+            return f"({walk(x.child)} is {'not ' if x.negated else ''}null)"
+        if isinstance(x, IsIn):
+            return f"({walk(x.child)} in {x.values!r})"
+        if isinstance(x, Udf):
+            args = ", ".join(walk(a) for a in x.args)
+            return f"udf:{x.name}#{_udf_uid(x.fn)}({args})"
+        return repr(x)
+
+    return walk(e)
 
 
 def split_conjuncts(e: Expr) -> List[Expr]:

@@ -335,13 +335,26 @@ class FilterExec(PhysicalNode):
             raise HyperspaceException(
                 "execute_concat requires a bucketed scan child"
             )
+        from .expr import canonical_condition_repr
         from .scan_cache import global_filtered_cache
 
         base_key = child._concat_cache_key()
+        cs = (
+            ctx.session.hs_conf.case_sensitive
+            if ctx is not None and ctx.session is not None
+            else False
+        )
+        # Spelling normalization is only sound when no two schema columns
+        # collide case-insensitively: Table._resolve is exact-match-first, so
+        # with both 'X' and 'x' present, col('X') and col('x') read DIFFERENT
+        # columns and must not share a cache entry.
+        names = child.relation.schema.names
+        if len({n.lower() for n in names}) != len(names):
+            cs = True
         key = (
             None
             if base_key is None
-            else ("filtered", base_key, repr(self.condition))
+            else ("filtered", base_key, canonical_condition_repr(self.condition, cs))
         )
         if key is not None:
             hit = global_filtered_cache().get(key)
@@ -1396,6 +1409,46 @@ def _verified_count_jit(lanes: tuple, li, ri, valid, *flat):
     return _verified_keep_jit(lanes, li, ri, valid, *flat).sum(dtype=jnp.int64)
 
 
+@_fpartial(_jax.jit, static_argnums=(0, 1, 2))
+def _verified_match_counts_jit(lanes: tuple, lcap: int, rcap: int, li, ri, valid, *flat):
+    """(verified pair count, distinct matched left rows, distinct matched
+    right rows) in one program — everything every join type's COUNT needs
+    (outer fills, semi/anti) without materializing pairs. `lcap`/`rcap` are
+    POW2-QUANTIZED row-count caps (padding scatters nothing and sums zero),
+    so growing tables share compiled programs instead of recompiling per
+    exact size."""
+    keep = _verified_keep_jit(lanes, li, ri, valid, *flat)
+    k32 = keep.astype(jnp.int32)
+    lmask = jnp.zeros(lcap, jnp.int32).at[li].max(k32, mode="drop")
+    rmask = jnp.zeros(rcap, jnp.int32).at[ri].max(k32, mode="drop")
+    return (
+        keep.sum(dtype=jnp.int64),
+        lmask.sum(dtype=jnp.int64),
+        rmask.sum(dtype=jnp.int64),
+    )
+
+
+def _count_from_match_stats(
+    how: str, n_pairs: int, lm: int, rm: int, n_left: int, n_right: int
+) -> int:
+    """Join-output row count from (verified pairs, matched-left, matched-right)
+    — the ONE home of the per-join-type arithmetic, shared by the host path
+    (np.unique stats), the device fast path, and the empty-side case (all
+    stats zero)."""
+    if how == "inner":
+        return n_pairs
+    if how == "left_semi":
+        return lm
+    if how == "left_anti":
+        return n_left - lm
+    n = n_pairs
+    if how in ("left", "full"):
+        n += n_left - lm
+    if how in ("right", "full"):
+        n += n_right - rm
+    return n
+
+
 class SortMergeJoinExec(PhysicalNode):
     name = "SortMergeJoin"
 
@@ -1439,7 +1492,7 @@ class SortMergeJoinExec(PhysicalNode):
             n = self._bucketed_count_fast(ctx)
             if n is not None:
                 return n
-        elif not self.bucketed and self.how == "inner":
+        elif not self.bucketed:
             # Children execute ONCE: the fast path and the fallback share them.
             pre = self._exec_general_children(ctx)
             n = self._general_count_fast(ctx, pre)
@@ -1450,16 +1503,10 @@ class SortMergeJoinExec(PhysicalNode):
         if how == "inner":
             return len(li)
         lm = len(np.unique(li))
-        if how == "left_semi":
-            return lm
-        if how == "left_anti":
-            return left.num_rows - lm
-        n = len(li)
-        if how in ("left", "full"):
-            n += left.num_rows - lm
-        if how in ("right", "full"):
-            n += right.num_rows - len(np.unique(ri))
-        return n
+        rm = len(np.unique(ri)) if how in ("right", "full") else 0
+        return _count_from_match_stats(
+            how, len(li), lm, rm, left.num_rows, right.num_rows
+        )
 
     def _exec_general_children(self, ctx):
         """Execute both (non-bucketed) children BELOW any exchange markers:
@@ -1645,8 +1692,10 @@ class SortMergeJoinExec(PhysicalNode):
         if not use_device_path():
             return None
         _lex, _rex, lt, rt = pre
+        how = self.how
         if lt.num_rows == 0 or rt.num_rows == 0:
-            return 0
+            # No pairs exist: the shared arithmetic at all-zero match stats.
+            return _count_from_match_stats(how, 0, 0, 0, lt.num_rows, rt.num_rows)
         if (
             ctx.session is not None
             and ctx.session.mesh_for(lt.num_rows + rt.num_rows) is not None
@@ -1657,21 +1706,38 @@ class SortMergeJoinExec(PhysicalNode):
         l_order, r_order, lo, counts, total_dev = _merge_phase_a(lk, rk)
         total = int(total_dev)
         if total == 0:
-            return 0
-        starts_l = jnp.asarray(np.asarray([0, lt.num_rows], np.int64))
-        starts_r = jnp.asarray(np.asarray([0, rt.num_rows], np.int64))
-        li, ri, valid = _expand_pairs_dev(
-            _cap_pow2(total),
-            True,
-            lo[None, :],
-            counts[None, :],
-            starts_l,
-            starts_r,
-            l_order[None, :],
-            r_order[None, :],
+            n_pairs = lm = rm = 0
+        else:
+            starts_l = jnp.asarray(np.asarray([0, lt.num_rows], np.int64))
+            starts_r = jnp.asarray(np.asarray([0, rt.num_rows], np.int64))
+            li, ri, valid = _expand_pairs_dev(
+                _cap_pow2(total),
+                True,
+                lo[None, :],
+                counts[None, :],
+                starts_l,
+                starts_r,
+                l_order[None, :],
+                r_order[None, :],
+            )
+            lanes, flat = _verify_lanes(lt, rt, self.left_keys, self.right_keys)
+            if how == "inner":
+                return int(_verified_count_jit(lanes, li, ri, valid, *flat))
+            n_pairs, lm, rm = (
+                int(x)
+                for x in _verified_match_counts_jit(
+                    lanes,
+                    _cap_pow2(lt.num_rows),
+                    _cap_pow2(rt.num_rows),
+                    li,
+                    ri,
+                    valid,
+                    *flat,
+                )
+            )
+        return _count_from_match_stats(
+            how, n_pairs, lm, rm, lt.num_rows, rt.num_rows
         )
-        lanes, flat = _verify_lanes(lt, rt, self.left_keys, self.right_keys)
-        return int(_verified_count_jit(lanes, li, ri, valid, *flat))
 
     def _device_pairs_compacted(self, left: Table, right: Table, l_starts, r_starts):
         """VERIFIED inner-join pairs as DEVICE arrays, compacted and padded to a

@@ -226,3 +226,15 @@ def test_scalar_subquery_pattern(session, tmp_path):
     assert sorted(r[0] for r in above_avg.collect().rows()) == [5, 9]
     with pytest.raises(HyperspaceException, match="1x1"):
         df.scalar()
+
+
+def test_udf_of_ufunc_and_distinct_lambdas(session, tmp_path):
+    """Non-weakref-able callables (numpy ufuncs) work as UDFs, and two
+    distinct same-named lambdas never share a cache identity (repr differs)."""
+    session.write_parquet({"q": [1.0, 4.0, 9.0]}, str(tmp_path / "t"))
+    sq = udf(np.sqrt, "float64")
+    df = session.read.parquet(str(tmp_path / "t")).with_column("r", sq(col("q")))
+    assert [r[1] for r in df.select("q", "r").collect().rows()] == [1.0, 2.0, 3.0]
+    f1 = udf(lambda x: x + 1, "int64")
+    f2 = udf(lambda x: x + 2, "int64")
+    assert repr(f1(col("q"))) != repr(f2(col("q")))
