@@ -1428,6 +1428,29 @@ def _verified_match_counts_jit(lanes: tuple, lcap: int, rcap: int, li, ri, valid
     )
 
 
+@_jax.jit
+def _value_inner_count_jit(lv, rv):
+    """Inner-join count over a single null-free numeric key pair, on ACTUAL
+    values: sort one side, range-probe the other, sum — no candidate
+    expansion and no verification pass (value equality IS the join
+    condition; the promotion matches `_verify_pairs`' numpy-promoted
+    equality). NaN probes count zero (NaN == NaN is false in SQL and in the
+    verify path); right-side NaNs sort past every real probe value."""
+    # NUMPY's promotion lattice, not JAX's: _verify_pairs (the oracle this
+    # must match) compares via numpy, where int64 x float32 -> float64; JAX
+    # would give float32 and a 2^24-magnitude int key could falsely match.
+    common = np.promote_types(np.dtype(lv.dtype), np.dtype(rv.dtype))
+    lv = lv.astype(common)
+    rv = rv.astype(common)
+    r_sorted = jnp.sort(rv)
+    lo = jnp.searchsorted(r_sorted, lv, side="left")
+    hi = jnp.searchsorted(r_sorted, lv, side="right")
+    counts = hi - lo
+    if jnp.issubdtype(common, jnp.floating):
+        counts = jnp.where(jnp.isnan(lv), 0, counts)
+    return counts.sum(dtype=jnp.int64)
+
+
 def _count_from_match_stats(
     how: str, n_pairs: int, lm: int, rm: int, n_left: int, n_right: int
 ) -> int:
@@ -1701,6 +1724,24 @@ class SortMergeJoinExec(PhysicalNode):
             and ctx.session.mesh_for(lt.num_rows + rt.num_rows) is not None
         ):
             return None  # the distributed exchange path owns mesh-scale counts
+        if how == "inner" and len(self.left_keys) == 1:
+            # Value-direct: a single null-free numeric key needs no hashing,
+            # no candidate expansion, and no verification — one program.
+            lc = lt.column(self.left_keys[0])
+            rc = rt.column(self.right_keys[0])
+            if (
+                not lc.is_string
+                and not rc.is_string
+                and lc.validity is None
+                and rc.validity is None
+                and lc.data.dtype != np.bool_
+                and rc.data.dtype != np.bool_
+            ):
+                return int(
+                    _value_inner_count_jit(
+                        device_array(lc.data), device_array(rc.data)
+                    )
+                )
         lk = _table_key64(lt, self.left_keys)
         rk = _table_key64(rt, self.right_keys)
         l_order, r_order, lo, counts, total_dev = _merge_phase_a(lk, rk)

@@ -274,9 +274,11 @@ def probe_orientation(left, right):
 
 def probe_keys_promoted(a_keys, b_keys):
     """Key matrices promoted to a common dtype (value-direct sides may be int32/
-    float while hash sides are int64)."""
+    float while hash sides are int64). NUMPY's promotion lattice, matching the
+    exact-verification pass (int64 x float32 -> float64 there; JAX's lattice
+    would give float32 and a 2^24-magnitude int could falsely probe-match)."""
     if a_keys.dtype != b_keys.dtype:
-        common = jnp.promote_types(a_keys.dtype, b_keys.dtype)
+        common = np.promote_types(np.dtype(a_keys.dtype), np.dtype(b_keys.dtype))
         return a_keys.astype(common), b_keys.astype(common)
     return a_keys, b_keys
 

@@ -58,21 +58,19 @@ def _mix_combine(h, k):
 def _words_u32(arr):
     """Split an array into two uint32 word arrays from its canonical bit pattern.
 
-    Values are canonicalized to 64-bit first (ints/bools → int64, floats → float64) so
-    that equal values hash equal regardless of storage width — an int32 id column must
-    bucket/join against an int64 one (equal-value-equal-hash is what makes bucket
-    co-location across independently built indexes sound)."""
+    ALL numerics canonicalize to float64 bits, so that equal VALUES hash equal
+    across every numeric storage kind — int32 vs int64, and int vs float
+    (numpy-promoted 5 == 5.0 is an equi-join match, Spark parity): equal-value-
+    equal-hash is what makes bucket co-location across independently built
+    indexes sound, and the join's exact verification is what keeps results
+    right when distinct values share a pattern (integers beyond 2^53 can alias
+    in float64 — they become hash collisions, removed like any other)."""
     x = jnp.asarray(arr)
-    if jnp.issubdtype(x.dtype, jnp.floating):
-        x = x.astype(jnp.float64)
-        # Normalize -0.0 to +0.0 so equal floats hash equal.
-        x = jnp.where(x == 0, jnp.zeros_like(x), x)
-        bits = jax.lax.bitcast_convert_type(x, jnp.uint32)  # shape (..., 2)
-        return [bits[..., 0], bits[..., 1]]
-    x = x.astype(jnp.int64)
-    lo = (x & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
-    hi = ((x >> jnp.int64(32)) & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
-    return [lo, hi]
+    x = x.astype(jnp.float64)
+    # Normalize -0.0 to +0.0 so equal values hash equal.
+    x = jnp.where(x == 0, jnp.zeros_like(x), x)
+    bits = jax.lax.bitcast_convert_type(x, jnp.uint32)  # shape (..., 2)
+    return [bits[..., 0], bits[..., 1]]
 
 
 def hash_device_values(arr, seed: np.uint32):

@@ -530,3 +530,29 @@ def test_ambiguous_join_orientation_refused(session, tmp_path):
     with pytest.raises(HyperspaceException, match="Ambiguous"):
         dl.join(dr, col("k") == col("x")).count()
     assert dl.join(dr, col("k") == col("k")).count() == 4
+
+
+def test_cross_kind_numeric_join_spark_parity(session, tmp_path):
+    """int keys join float keys by VALUE (Spark casts both to double): the
+    hash canonicalizes all numerics to float64 bits, verification compares
+    numpy-promoted values. Distinct int64 beyond 2^53 that alias in float64
+    are hash collisions — found as candidates, removed by verification."""
+    session.write_parquet(
+        {"a": np.array([5, 7, 2**53 + 1, 2**53 + 2], dtype=np.int64)},
+        str(tmp_path / "ints"),
+    )
+    session.write_parquet(
+        {"b": np.array([5.0, 8.0], dtype=np.float64)}, str(tmp_path / "floats")
+    )
+    di = session.read.parquet(str(tmp_path / "ints"))
+    df = session.read.parquet(str(tmp_path / "floats"))
+    q = di.join(df, col("a") == col("b"))
+    assert q.count() == len(q.collect().rows()) == 1  # 5 == 5.0 only
+
+    # Aliasing ints join EXACTLY among themselves despite equal hashes.
+    session.write_parquet(
+        {"c": np.array([2**53 + 1], dtype=np.int64)}, str(tmp_path / "big")
+    )
+    db = session.read.parquet(str(tmp_path / "big"))
+    q2 = di.join(db, col("a") == col("c"))
+    assert q2.count() == len(q2.collect().rows()) == 1  # not 2**53+2

@@ -384,3 +384,47 @@ def test_general_join_device_count_all_types(dev_session, tmp_path, how):
     disable_hyperspace(s)
     expected = len(q().collect().rows())
     assert q().count() == expected
+
+
+def test_general_value_direct_count_with_nans(dev_session, tmp_path):
+    """Single numeric-key inner count takes the value-direct device program;
+    NaN keys never match (SQL), matching the materializing oracle."""
+    s = dev_session
+    base = str(tmp_path)
+    rng = np.random.RandomState(13)
+    lk = rng.randint(0, 40, 3000).astype(np.float64)
+    lk[::29] = np.nan
+    rk = np.arange(50, dtype=np.float64)
+    rk[7] = np.nan  # right-side NaN must match nothing either
+    s.write_parquet({"a": lk, "v": np.arange(3000, dtype=np.int64)},
+                    os.path.join(base, "vl"))
+    s.write_parquet({"b": rk, "w": np.arange(50, dtype=np.int64)},
+                    os.path.join(base, "vr"))
+
+    def q():
+        l = s.read.parquet(os.path.join(base, "vl"))
+        r = s.read.parquet(os.path.join(base, "vr"))
+        return l.join(r, col("a") == col("b"))
+
+    disable_hyperspace(s)
+    expected = len(q().collect().rows())
+    assert q().count() == expected
+    assert expected < 3000
+
+
+def test_general_value_count_numpy_promotion(dev_session, tmp_path):
+    """int64 x float32 keys promote per NUMPY (-> float64), matching the
+    verify oracle: a 2^24+1 int key must NOT match 2^24 float32."""
+    s = dev_session
+    base = str(tmp_path)
+    s.write_parquet(
+        {"a": np.array([16777217, 5], dtype=np.int64)}, os.path.join(base, "pl")
+    )
+    s.write_parquet(
+        {"b": np.array([16777216.0, 5.0], dtype=np.float32)}, os.path.join(base, "pr")
+    )
+    l = s.read.parquet(os.path.join(base, "pl"))
+    r = s.read.parquet(os.path.join(base, "pr"))
+    q = l.join(r, col("a") == col("b"))
+    disable_hyperspace(s)
+    assert q.count() == len(q.collect().rows()) == 1  # only the 5 == 5.0 pair
