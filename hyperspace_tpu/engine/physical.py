@@ -1319,28 +1319,57 @@ def _dist_blocks(table: Table, starts: np.ndarray, keys: List[str], mesh):
     return _cached_by_table(_padded_cache, table, subkey, compute)
 
 
-def _table_key64(table: Table, keys: List[str]):
+def _table_key64(table: Table, keys: List[str], force_float=None):
     """Join key64 of a table, cached per table identity.
 
     Bucketed scans return the SAME Table object across queries (BucketedConcatCache),
     so the hashed key column stays device-resident between queries instead of being
-    re-uploaded and re-hashed — the steady-state indexed join starts at the probe."""
+    re-uploaded and re-hashed — the steady-state indexed join starts at the probe.
+    `force_float[i]` hashes numeric key i in the cross-kind float64 space (the
+    JOINT decision of both join sides — see `_joint_float_flags`)."""
 
     def compute():
         cols = [table.column(k) for k in keys]
-        return key64(cols, [device_array(c.data) for c in cols])
+        return key64(cols, [device_array(c.data) for c in cols], force_float)
 
-    return _cached_by_table(
-        _key64_cache, table, tuple(k.lower() for k in keys), compute
+    subkey = (
+        tuple(k.lower() for k in keys),
+        None if force_float is None else tuple(force_float),
     )
+    return _cached_by_table(_key64_cache, table, subkey, compute)
+
+
+def _joint_float_flags(
+    lt: Table, rt: Table, lkeys: List[str], rkeys: List[str]
+) -> Optional[List[bool]]:
+    """Per-key-pair cross-kind decision: when one side's key column is float
+    and the other's is int, BOTH sides must hash in the float64 space (the
+    join's equality is numpy-promoted float64 equality — Spark casts both
+    sides to double). None when no pair is mixed (the common case: every
+    column hashes exactly within its own kind)."""
+    flags = []
+    for lk, rk in zip(lkeys, rkeys):
+        lc, rc = lt.column(lk), rt.column(rk)
+        if lc.is_string or rc.is_string:
+            flags.append(False)
+            continue
+        lf = np.issubdtype(lc.data.dtype, np.floating)
+        rf = np.issubdtype(rc.data.dtype, np.floating)
+        # Mixed kinds only: float columns already hash in float64 naturally,
+        # so forcing is needed (and cache-key-visible) just for the int side
+        # of a mixed pair.
+        flags.append(lf != rf)
+    return flags if any(flags) else None
 
 
 def _join_pairs(
     left: Table, right: Table, left_keys: List[str], right_keys: List[str]
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Hash-key merge join pair indices with exact verification."""
+    flags = _joint_float_flags(left, right, left_keys, right_keys)
     li, ri = merge_join_pairs(
-        _table_key64(left, left_keys), _table_key64(right, right_keys)
+        _table_key64(left, left_keys, flags),
+        _table_key64(right, right_keys, flags),
     )
     return _verify_pairs(left, right, left_keys, right_keys, li, ri)
 
@@ -1550,9 +1579,18 @@ class SortMergeJoinExec(PhysicalNode):
         if lex is not None and rex is not None:
             # Joint exchange decision: both sides exchange over the mesh, or
             # neither — a one-sided exchange would pay a full all_to_all whose
-            # co-partition layout the join could never use.
+            # co-partition layout the join could never use. Cross-kind key
+            # pairs (int ⋈ float) also skip it: the exchange hashes each side
+            # in its own kind's space, which would break co-partitioning in
+            # the joint float64 space the mixed join compares in.
+            mixed = (
+                lt.num_rows > 0
+                and rt.num_rows > 0
+                and _joint_float_flags(lt, rt, self.left_keys, self.right_keys)
+                is not None
+            )
             mesh = ctx.session.mesh_for(lt.num_rows + rt.num_rows)
-            if mesh is not None and lt.num_rows > 0 and rt.num_rows > 0:
+            if mesh is not None and not mixed and lt.num_rows > 0 and rt.num_rows > 0:
                 ppd = _partitions_per_device(ctx)
                 lt = lex.exchange_table(mesh, lt, ppd)
                 rt = rex.exchange_table(mesh, rt, ppd)
@@ -1742,8 +1780,9 @@ class SortMergeJoinExec(PhysicalNode):
                         device_array(lc.data), device_array(rc.data)
                     )
                 )
-        lk = _table_key64(lt, self.left_keys)
-        rk = _table_key64(rt, self.right_keys)
+        flags = _joint_float_flags(lt, rt, self.left_keys, self.right_keys)
+        lk = _table_key64(lt, self.left_keys, flags)
+        rk = _table_key64(rt, self.right_keys, flags)
         l_order, r_order, lo, counts, total_dev = _merge_phase_a(lk, rk)
         total = int(total_dev)
         if total == 0:
@@ -2049,7 +2088,23 @@ def plan_physical(
                 # Join keys in bucket-column order so per-bucket key hashing pairs up.
                 jl = lbc
                 jr = [pair_map[key(c)] for c in lbc]
-                return SortMergeJoinExec(lphys, rphys, jl, jr, bucketed=True)
+                # Kind compatibility: bucket assignment hashed each column in
+                # its OWN kind at build time, so an int-bucketed side is not
+                # co-located with a float-bucketed one even for equal values —
+                # mixed pairs take the general join (float64 joint hashing).
+                def _kind(schema, name):
+                    dt = schema.field(name).dtype
+                    return "f" if dt in ("float32", "float64") else (
+                        "s" if dt == "string" else "i"
+                    )
+
+                kinds_ok = all(
+                    _kind(lbucket.relation.schema, a)
+                    == _kind(rbucket.relation.schema, b)
+                    for a, b in zip(jl, jr)
+                )
+                if kinds_ok:
+                    return SortMergeJoinExec(lphys, rphys, jl, jr, bucketed=True)
 
         # General path: exchange + sort both sides.
         if isinstance(lphys, BucketedIndexScanExec):

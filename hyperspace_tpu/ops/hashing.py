@@ -55,27 +55,36 @@ def _mix_combine(h, k):
     return h
 
 
-def _words_u32(arr):
+def _words_u32(arr, force_float: bool = False):
     """Split an array into two uint32 word arrays from its canonical bit pattern.
 
-    ALL numerics canonicalize to float64 bits, so that equal VALUES hash equal
-    across every numeric storage kind — int32 vs int64, and int vs float
-    (numpy-promoted 5 == 5.0 is an equi-join match, Spark parity): equal-value-
-    equal-hash is what makes bucket co-location across independently built
-    indexes sound, and the join's exact verification is what keeps results
-    right when distinct values share a pattern (integers beyond 2^53 can alias
-    in float64 — they become hash collisions, removed like any other)."""
+    Values canonicalize WITHIN their kind (ints/bools → int64 bits, floats →
+    float64 bits) so equal values hash equal regardless of storage width — an
+    int32 id column must bucket/join against an int64 one. Integer hashing
+    stays EXACT (float64 canonicalization would alias dense ids beyond 2^53 —
+    snowflake ids, nanosecond timestamps — into systematic collision runs).
+
+    `force_float` canonicalizes integers through float64 too: the CROSS-KIND
+    join case (int key ⋈ float key), where equality is numpy-promoted float64
+    equality (Spark casts both sides to double), so both sides must hash in
+    that space. The JOIN decides this jointly per key pair; it never applies
+    to single-table hashing (builds, group-bys)."""
     x = jnp.asarray(arr)
-    x = x.astype(jnp.float64)
-    # Normalize -0.0 to +0.0 so equal values hash equal.
-    x = jnp.where(x == 0, jnp.zeros_like(x), x)
-    bits = jax.lax.bitcast_convert_type(x, jnp.uint32)  # shape (..., 2)
-    return [bits[..., 0], bits[..., 1]]
+    if force_float or jnp.issubdtype(x.dtype, jnp.floating):
+        x = x.astype(jnp.float64)
+        # Normalize -0.0 to +0.0 so equal floats hash equal.
+        x = jnp.where(x == 0, jnp.zeros_like(x), x)
+        bits = jax.lax.bitcast_convert_type(x, jnp.uint32)  # shape (..., 2)
+        return [bits[..., 0], bits[..., 1]]
+    x = x.astype(jnp.int64)
+    lo = (x & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
+    hi = ((x >> jnp.int64(32)) & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
+    return [lo, hi]
 
 
-def hash_device_values(arr, seed: np.uint32):
+def hash_device_values(arr, seed: np.uint32, force_float: bool = False):
     """uint32 hash of a numeric device array's values."""
-    words = _words_u32(arr)
+    words = _words_u32(arr, force_float)
     h = jnp.full(words[0].shape, jnp.uint32(seed))
     for w in words:
         h = _mix_combine(h, w)
@@ -142,7 +151,7 @@ def _lane_trace(seed, dh_slot, cols):
         if c[0] == "str":
             hc = c[2 + dh_slot][c[1]]
         else:
-            hc = hash_device_values(c[1], seed)
+            hc = hash_device_values(c[1], seed, force_float=(c[0] == "numf"))
         h = hc if h is None else fmix32(_mix_combine(h, hc))
     return h
 
@@ -154,7 +163,7 @@ def _unflatten(kinds, flat, per_str: int):
             cols.append(("str", *flat[i : i + per_str]))
             i += per_str
         else:
-            cols.append(("num", flat[i]))
+            cols.append((kind, flat[i]))  # "num" | "numf" (forced-float canon)
             i += 1
     return cols
 
@@ -184,18 +193,20 @@ def _bucket_id_fused(kinds, num_buckets, *flat):
     return (h1 % jnp.uint32(num_buckets)).astype(jnp.int32)
 
 
-def _flat_inputs(columns, device_arrays, seeds):
+def _flat_inputs(columns, device_arrays, seeds, force_float=None):
     """(kinds, flat) for the fused kernels: string columns contribute their
-    codes plus one host-hashed dictionary table per seed."""
+    codes plus one host-hashed dictionary table per seed. `force_float[i]`
+    canonicalizes numeric column i through float64 (the cross-kind join
+    space — see `_words_u32`)."""
     kinds, flat = [], []
-    for col, arr in zip(columns, device_arrays):
+    for i, (col, arr) in enumerate(zip(columns, device_arrays)):
         if col.is_string:
             kinds.append("str")
             flat.append(arr)
             for s in seeds:
                 flat.append(host_hash_dictionary(col.dictionary, int(s)))
         else:
-            kinds.append("num")
+            kinds.append("numf" if force_float is not None and force_float[i] else "num")
             flat.append(arr)
     return tuple(kinds), flat
 
@@ -206,13 +217,14 @@ def combined_hash_u32(columns, device_arrays, seed: np.uint32):
     return _combined_fused(kinds, seed, *flat)
 
 
-def key64(columns, device_arrays):
+def key64(columns, device_arrays, force_float=None):
     """Signed 64-bit join/sort key from two independent 32-bit hash lanes.
 
     Equal key tuples always map to equal key64 (value-based hashing); unequal tuples
     collide with probability ~2^-64 and are removed by the join's exact-equality
-    verification pass."""
-    kinds, flat = _flat_inputs(columns, device_arrays, (_SEED1, _SEED2))
+    verification pass. `force_float[i]` hashes numeric column i in the
+    cross-kind float64 space (joint decision of both join sides)."""
+    kinds, flat = _flat_inputs(columns, device_arrays, (_SEED1, _SEED2), force_float)
     return _key64_fused(kinds, *flat)
 
 

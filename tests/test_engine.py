@@ -556,3 +556,40 @@ def test_cross_kind_numeric_join_spark_parity(session, tmp_path):
     db = session.read.parquet(str(tmp_path / "big"))
     q2 = di.join(db, col("a") == col("c"))
     assert q2.count() == len(q2.collect().rows()) == 1  # not 2**53+2
+
+
+def test_cross_kind_bucketed_pair_demotes_to_general_join(session, tmp_path):
+    """An int-bucketed index joined against a float-bucketed index is NOT
+    co-located (each column bucketized in its own kind's hash space): the
+    planner must refuse the no-shuffle path and still produce exact results
+    via the general join's joint float64 hashing."""
+    from hyperspace_tpu import IndexConfig, IndexConstants
+    from hyperspace_tpu.hyperspace import Hyperspace, disable_hyperspace, enable_hyperspace
+
+    session.conf.set(IndexConstants.INDEX_SYSTEM_PATH, str(tmp_path / "indexes"))
+    session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 8)
+    session.write_parquet(
+        {"a": np.arange(200, dtype=np.int64) % 40, "v": np.arange(200, dtype=np.int64)},
+        str(tmp_path / "il"),
+    )
+    session.write_parquet(
+        {"b": np.arange(40, dtype=np.float64), "w": np.arange(40, dtype=np.int64)},
+        str(tmp_path / "fr"),
+    )
+    hs = Hyperspace(session)
+    hs.create_index(session.read.parquet(str(tmp_path / "il")), IndexConfig("cki", ["a"], ["v"]))
+    hs.create_index(session.read.parquet(str(tmp_path / "fr")), IndexConfig("ckf", ["b"], ["w"]))
+
+    def q():
+        l = session.read.parquet(str(tmp_path / "il"))
+        r = session.read.parquet(str(tmp_path / "fr"))
+        return l.join(r, col("a") == col("b")).select("v", "w")
+
+    disable_hyperspace(session)
+    expected = q().sorted_rows()
+    assert len(expected) == 200  # every int 0..39 matches its float
+    enable_hyperspace(session)
+    plan = q().explain_string()
+    assert "bucketed, no exchange" not in plan  # co-location refused
+    assert q().sorted_rows() == expected
+    assert q().count() == 200
