@@ -36,6 +36,16 @@ class IndexConstants:
     INDEX_LINEAGE_ENABLED_DEFAULT = False
     DATA_FILE_NAME_COLUMN = "_data_file_name"
 
+    # Bucket/sketch hash-scheme version recorded at build time. Bucket
+    # co-location across independently built indexes (and bloom-sketch
+    # probing) requires BUILD and QUERY to hash identically, so a future
+    # hash-function change must bump this — candidates built under another
+    # scheme are then skipped instead of silently mis-joined. "1" = the
+    # kind-split exact scheme (ints as int64 bits, floats as float64 bits);
+    # entries with no recorded version predate the field and used scheme 1.
+    HASH_SCHEME_KEY = "hashSchemeVersion"
+    HASH_SCHEME_VERSION = "1"
+
     # On-lake layout names (reference `IndexConstants.scala:41-42`).
     HYPERSPACE_LOG = "_hyperspace_log"
     INDEX_VERSION_DIR_PREFIX = "v__"

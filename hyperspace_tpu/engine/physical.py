@@ -1339,37 +1339,39 @@ def _table_key64(table: Table, keys: List[str], force_float=None):
     return _cached_by_table(_key64_cache, table, subkey, compute)
 
 
-def _joint_float_flags(
-    lt: Table, rt: Table, lkeys: List[str], rkeys: List[str]
-) -> Optional[List[bool]]:
+def _joint_float_flags(lt: Table, rt: Table, lkeys: List[str], rkeys: List[str]):
     """Per-key-pair cross-kind decision: when one side's key column is float
     and the other's is int, BOTH sides must hash in the float64 space (the
     join's equality is numpy-promoted float64 equality — Spark casts both
-    sides to double). None when no pair is mixed (the common case: every
-    column hashes exactly within its own kind)."""
-    flags = []
+    sides to double). Returns PER-SIDE flag lists (l_flags, r_flags), each
+    None when nothing on that side needs forcing: float columns hash in
+    float64 naturally, so only the INT side of a mixed pair gets a flag —
+    keeping the float side's cached key64 entry shared with same-kind joins."""
+    l_flags, r_flags = [], []
     for lk, rk in zip(lkeys, rkeys):
         lc, rc = lt.column(lk), rt.column(rk)
         if lc.is_string or rc.is_string:
-            flags.append(False)
+            l_flags.append(False)
+            r_flags.append(False)
             continue
         lf = np.issubdtype(lc.data.dtype, np.floating)
         rf = np.issubdtype(rc.data.dtype, np.floating)
-        # Mixed kinds only: float columns already hash in float64 naturally,
-        # so forcing is needed (and cache-key-visible) just for the int side
-        # of a mixed pair.
-        flags.append(lf != rf)
-    return flags if any(flags) else None
+        l_flags.append(rf and not lf)  # int left of a mixed pair
+        r_flags.append(lf and not rf)  # int right of a mixed pair
+    return (
+        l_flags if any(l_flags) else None,
+        r_flags if any(r_flags) else None,
+    )
 
 
 def _join_pairs(
     left: Table, right: Table, left_keys: List[str], right_keys: List[str]
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Hash-key merge join pair indices with exact verification."""
-    flags = _joint_float_flags(left, right, left_keys, right_keys)
+    l_flags, r_flags = _joint_float_flags(left, right, left_keys, right_keys)
     li, ri = merge_join_pairs(
-        _table_key64(left, left_keys, flags),
-        _table_key64(right, right_keys, flags),
+        _table_key64(left, left_keys, l_flags),
+        _table_key64(right, right_keys, r_flags),
     )
     return _verify_pairs(left, right, left_keys, right_keys, li, ri)
 
@@ -1583,11 +1585,11 @@ class SortMergeJoinExec(PhysicalNode):
             # pairs (int ⋈ float) also skip it: the exchange hashes each side
             # in its own kind's space, which would break co-partitioning in
             # the joint float64 space the mixed join compares in.
-            mixed = (
-                lt.num_rows > 0
-                and rt.num_rows > 0
-                and _joint_float_flags(lt, rt, self.left_keys, self.right_keys)
-                is not None
+            mixed = lt.num_rows > 0 and rt.num_rows > 0 and any(
+                f is not None
+                for f in _joint_float_flags(
+                    lt, rt, self.left_keys, self.right_keys
+                )
             )
             mesh = ctx.session.mesh_for(lt.num_rows + rt.num_rows)
             if mesh is not None and not mixed and lt.num_rows > 0 and rt.num_rows > 0:
@@ -1780,9 +1782,9 @@ class SortMergeJoinExec(PhysicalNode):
                         device_array(lc.data), device_array(rc.data)
                     )
                 )
-        flags = _joint_float_flags(lt, rt, self.left_keys, self.right_keys)
-        lk = _table_key64(lt, self.left_keys, flags)
-        rk = _table_key64(rt, self.right_keys, flags)
+        l_flags, r_flags = _joint_float_flags(lt, rt, self.left_keys, self.right_keys)
+        lk = _table_key64(lt, self.left_keys, l_flags)
+        rk = _table_key64(rt, self.right_keys, r_flags)
         l_order, r_order, lo, counts, total_dev = _merge_phase_a(lk, rk)
         total = int(total_dev)
         if total == 0:

@@ -178,6 +178,9 @@ class CoveringIndexBuilder(IndexerBuilder):
                 included_columns=included,
                 schema_json=self._index_schema(df, index_config).to_json_string(),
                 num_buckets=self._session.hs_conf.num_buckets,
+                properties={
+                    IndexConstants.HASH_SCHEME_KEY: IndexConstants.HASH_SCHEME_VERSION
+                },
             ),
             content=Content.from_directory(index_data_path, self._session.fs),
             source=Source(

@@ -29,6 +29,7 @@ from ..engine import io as engine_io
 from ..engine.logical import ScanNode
 from ..engine.schema import Schema
 from ..engine.table import Column, Table
+from ..config import IndexConstants
 from ..exceptions import HyperspaceException
 from ..ops.hashing import _SEED1, _SEED2, column_hash_u32
 from ..util.resolver_utils import resolve_all
@@ -242,7 +243,8 @@ class DataSkippingIndexBuilder(IndexerBuilder):
                 schema_json=Schema([]).to_json_string(),
                 num_buckets=1,
                 properties={
-                    "sketches": json.dumps([s.to_json() for s in index_config.sketches])
+                    "sketches": json.dumps([s.to_json() for s in index_config.sketches]),
+                    IndexConstants.HASH_SCHEME_KEY: IndexConstants.HASH_SCHEME_VERSION,
                 },
             ),
             content=Content.from_directory(index_data_path, self._session.fs),

@@ -104,6 +104,10 @@ class DataSkippingFilterRule:
                     index_manager,
                     scan,
                     hybrid_scan=session.hs_conf.hybrid_scan_enabled,
+                    # Sketches are PER SOURCE FILE: a vanished file vanishes
+                    # from the scan itself, surviving files' sketches stay
+                    # valid — deletes need no lineage here.
+                    deletes_without_lineage_ok=True,
                     kind=DATA_SKIPPING_KIND,
                 )
                 if not candidates:

@@ -18,7 +18,11 @@ from ..storage.filesystem import FileStatus
 
 
 def get_candidate_indexes(
-    index_manager, plan: LogicalPlan, hybrid_scan: bool = False, kind: str = "CoveringIndex"
+    index_manager,
+    plan: LogicalPlan,
+    hybrid_scan: bool = False,
+    kind: str = "CoveringIndex",
+    deletes_without_lineage_ok: bool = False,
 ) -> List["CandidateIndex"]:
     """ACTIVE indexes applicable to `plan` (normally a relation node).
 
@@ -47,7 +51,11 @@ def get_candidate_indexes(
           its old rows are inseparable from new ones — never scannable;
         - a recorded file VANISHED: tolerable IFF the index carries lineage
           (`_data_file_name` per row) — its rows are pruned at scan time by a
-          bucket-preserving filter. Without lineage, not scannable."""
+          bucket-preserving filter. Without lineage, not scannable — except
+          for index kinds whose data is PER SOURCE FILE
+          (`deletes_without_lineage_ok`, e.g. data skipping: a vanished file
+          simply vanishes from the scan; surviving files' sketches stay
+          valid)."""
         if not isinstance(plan, ScanNode):
             return None
         recorded = {
@@ -65,7 +73,7 @@ def get_candidate_indexes(
             if name in current_paths:
                 return None  # changed in place: rows not separable
             deleted.append(name)
-        if deleted and not _has_lineage(entry):
+        if deleted and not deletes_without_lineage_ok and not _has_lineage(entry):
             return None
         appended = [
             f for f in current if (f.path, f.size, f.modified_time) not in recorded
@@ -78,6 +86,11 @@ def get_candidate_indexes(
     for e in index_manager.get_indexes([states.ACTIVE]):
         if e.kind != kind or not e.created:
             continue
+        if not _hash_scheme_compatible(e):
+            # Built under a different bucket/sketch hash scheme: bucket
+            # co-location (and bloom probing) with the CURRENT scheme would
+            # be silently wrong — the index must sit out until refreshed.
+            continue
         if signature_valid(e):
             out.append(CandidateIndex(e, []))
         elif hybrid_scan:
@@ -85,6 +98,17 @@ def get_candidate_indexes(
             if delta is not None:
                 out.append(CandidateIndex(e, delta[0], delta[1]))
     return out
+
+
+def _hash_scheme_compatible(entry: IndexLogEntry) -> bool:
+    """Whether the index was bucketed/sketched under the CURRENT hash scheme
+    (`IndexConstants.HASH_SCHEME_VERSION`). Entries with no recorded version
+    predate the field and used scheme 1."""
+    from ..config import IndexConstants
+
+    props = getattr(entry.derived_dataset, "properties", None) or {}
+    v = props.get(IndexConstants.HASH_SCHEME_KEY)
+    return v in (None, IndexConstants.HASH_SCHEME_VERSION)
 
 
 def _has_lineage(entry: IndexLogEntry) -> bool:

@@ -704,3 +704,43 @@ class TestBucketPreservingFilters:
             if isinstance(nde, SortMergeJoinExec)
         ]
         assert joins and joins[0].bucketed
+
+
+def test_hash_scheme_version_guard(session, tmp_path):
+    """An index recorded under a DIFFERENT bucket-hash scheme must sit out
+    (bucket co-location with the current scheme would be silently wrong);
+    current-version and legacy (unversioned) entries stay candidates."""
+    import json as _json
+
+    from hyperspace_tpu.config import IndexConstants as IC
+    from hyperspace_tpu.index.factories import IndexLogManagerFactory
+
+    session.write_parquet(
+        {"k": list(range(40)), "v": list(range(40))}, str(tmp_path / "hv")
+    )
+    hs = Hyperspace(session)
+    hs.create_index(
+        session.read.parquet(str(tmp_path / "hv")), IndexConfig("hvIdx", ["k"], ["v"])
+    )
+    enable_hyperspace(session)
+    q = lambda: session.read.parquet(str(tmp_path / "hv")).filter(col("k") == 3).select("v")
+    assert "hvIdx" in q().explain_string()
+
+    # Rewrite the entry's recorded scheme to a future version: index sits out.
+    import os as _os
+
+    idx_root = _os.path.join(str(tmp_path / "indexes"), "hvIdx")
+    lm = IndexLogManagerFactory().create(idx_root)
+    entry = lm.get_latest_stable_log()
+    entry.derived_dataset.properties[IC.HASH_SCHEME_KEY] = "999"
+    log_dir = _os.path.join(idx_root, IC.HYPERSPACE_LOG)
+    latest = max(int(p) for p in _os.listdir(log_dir) if p.isdigit())
+    with open(_os.path.join(log_dir, str(latest)), "w") as f:
+        _json.dump(entry.to_json(), f)
+    with open(_os.path.join(log_dir, "latestStable"), "w") as f:
+        _json.dump(entry.to_json(), f)
+    from hyperspace_tpu.hyperspace import _index_manager_for
+
+    _index_manager_for(session).clear_cache()
+    assert "hvIdx" not in q().explain_string()
+    assert q().to_pydict()["v"] == [3]  # query still correct via the scan

@@ -455,3 +455,35 @@ class TestHybridScanDeleteTolerance:
         assert plan_op_names(q()).count("ShuffleExchange") == 0
         assert q().sorted_rows() == expected
         assert q().count() == len(expected)  # device count path agrees too
+
+
+def test_data_skipping_survives_deleted_file(session, tmp_path):
+    """Sketches are per source file: deleting one file keeps the data-skipping
+    index usable WITHOUT lineage (the vanished file vanishes from the scan;
+    survivors still prune), under hybrid scan."""
+    import os as _os
+
+    from hyperspace_tpu.index.dataskipping import DataSkippingIndexConfig, MinMaxSketch
+
+    d = tmp_path / "ds"
+    d.mkdir()
+    for i in range(4):
+        eio.write_parquet(
+            Table.from_pydict(
+                {"ts": list(range(i * 100, i * 100 + 100)),
+                 "val": list(range(100))}
+            ),
+            str(d / f"part-{i}.parquet"),
+        )
+    hs = Hyperspace(session)
+    hs.create_index(
+        session.read.parquet(str(d)), DataSkippingIndexConfig("dsd", [MinMaxSketch("ts")])
+    )
+    _os.remove(str(d / "part-3.parquet"))
+    enable_hyperspace(session)
+    session.conf.set(IndexConstants.INDEX_HYBRID_SCAN_ENABLED, "true")
+    q = lambda: session.read.parquet(str(d)).filter(col("ts") == 150).select("val")
+    assert "pruned by" in q().explain_string()  # still prunes after the delete
+    assert q().to_pydict()["val"] == [50]
+    disable_hyperspace(session)
+    assert q().to_pydict()["val"] == [50]

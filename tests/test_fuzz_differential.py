@@ -57,6 +57,7 @@ def test_random_join_agg_differential(tmp_path, seed):
     s = HyperspaceSession(warehouse=str(tmp_path))
     s.conf.set(IndexConstants.INDEX_SYSTEM_PATH, str(tmp_path / "indexes"))
     s.conf.set(IndexConstants.INDEX_NUM_BUCKETS, int(rng.choice([4, 8, 16])))
+    saved = os.environ.get("HYPERSPACE_FORCE_DEVICE_OPS")  # CI matrix sets it
     if rng.rand() < 0.5:
         os.environ["HYPERSPACE_FORCE_DEVICE_OPS"] = "1"
     else:
@@ -114,4 +115,7 @@ def test_random_join_agg_differential(tmp_path, seed):
         assert q_join().sorted_rows() == join_oracle
         _rows_close(q_agg().collect().sorted_rows(), agg_oracle)
     finally:
-        os.environ.pop("HYPERSPACE_FORCE_DEVICE_OPS", None)
+        if saved is None:
+            os.environ.pop("HYPERSPACE_FORCE_DEVICE_OPS", None)
+        else:
+            os.environ["HYPERSPACE_FORCE_DEVICE_OPS"] = saved
