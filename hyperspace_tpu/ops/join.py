@@ -21,17 +21,24 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def stable_argsort_host(x) -> np.ndarray:
+    """The host branch of the backend-adaptive sort trade, as a NUMPY
+    permutation (callers that continue host-side skip the device round-trip)."""
+    return np.argsort(np.asarray(x), kind="stable")
+
+
 def stable_argsort(x: jnp.ndarray) -> jnp.ndarray:
     """Backend-adaptive stable argsort — the ONE home of the trade ops.partition
     documents: XLA's CPU sort is ~3x slower than numpy's, so the CPU backend
-    sorts on host; the device argsort is the TPU path (jnp.argsort is stable by
-    default). Applied to the NON-indexed baseline path too, so the bench's
-    indexed-vs-scan speedup compares two equally-tuned implementations.
-    `HYPERSPACE_FORCE_DEVICE_OPS=1` forces the device path (ops.backend)."""
+    sorts on host (`stable_argsort_host`); the device argsort is the TPU path
+    (jnp.argsort is stable by default). Applied to the NON-indexed baseline
+    path too, so the bench's indexed-vs-scan speedup compares two equally-tuned
+    implementations. `HYPERSPACE_FORCE_DEVICE_OPS=1` forces the device path
+    (ops.backend)."""
     from .backend import use_device_path
 
     if not use_device_path():
-        return jnp.asarray(np.argsort(np.asarray(x), kind="stable"))
+        return jnp.asarray(stable_argsort_host(x))
     return jnp.argsort(x)
 
 
