@@ -226,7 +226,9 @@ def _metric_from(d: dict, rows_label: str = None) -> dict:
     idx = d.get("indexed_join_p50_s")
     scan = d.get("scan_join_p50_s")
     partial = (
-        " (partial)" if ("aborted_at" in d or d.get("skipped_phases")) else ""
+        " (partial)"
+        if ("aborted_at" in d or d.get("skipped_phases") or d.get("phase_errors"))
+        else ""
     )
     if build is not None and idx is not None:
         name, value = f"tpch({rows}) index-build+join-p50{partial}", build + idx
@@ -256,6 +258,10 @@ def _metric_from(d: dict, rows_label: str = None) -> dict:
 # run_bench's `finally` cannot do across os._exit.
 _LIVE_PHASES: list = []
 _BENCH_TMPDIR: list = []
+# run_bench deposits its completed result here BEFORE its teardown (the
+# tempdir rmtree takes seconds at 8M): the watchdog must not mistake a
+# finished run still in teardown for a hung one.
+_BENCH_RESULT: list = []
 
 
 def run_bench(deadline: float = None) -> dict:
@@ -465,7 +471,9 @@ def run_bench(deadline: float = None) -> dict:
         # A deadline/transport abort must never masquerade as a complete run:
         # _metric_from carries the partial marker and degrades to the best
         # available single measurement (same contract as the parent's salvage).
-        return _metric_from(d, rows_label=f"{n_li}x{n_ord}")
+        res = _metric_from(d, rows_label=f"{n_li}x{n_ord}")
+        _BENCH_RESULT.append(res)
+        return res
     finally:
         shutil.rmtree(base, ignore_errors=True)
 
@@ -995,7 +1003,10 @@ def _child_main():
     def _overrun_watchdog():
         while True:
             time.sleep(10)
-            if bench_done.is_set():
+            # _BENCH_RESULT: the run finished and is merely tearing down its
+            # tempdir — the real final record is about to print; never
+            # supersede it with a salvage stamped as an abort.
+            if bench_done.is_set() or _BENCH_RESULT:
                 return
             if _now() <= deadline + 60:
                 continue
@@ -1021,7 +1032,7 @@ def _child_main():
                     # last instant must win — its final record is already
                     # printed (or about to be, by a main thread holding
                     # bench_done) and must stay the LAST stdout line.
-                    if bench_done.is_set():
+                    if bench_done.is_set() or _BENCH_RESULT:
                         return
                     print(lines, flush=True)
             except Exception:
