@@ -502,6 +502,128 @@ def hash_aggregate_device(
     return Table(out)
 
 
+# Direct-address aggregation cell budget: 4M cells ≈ 32 MB per int64
+# accumulator — bincount is O(n + R), so a bounded R keeps the pass linear.
+_DIRECT_CELL_BUDGET = 1 << 22
+
+
+def _direct_host_aggregate(
+    table: Table, group_keys, key_cols, aggs: Sequence[AggTriple]
+) -> Optional[Table]:
+    """Sort-free host aggregation for bounded-range integer/dictionary keys:
+    each key tuple maps to a dense cell id (mixed-radix over per-key value
+    ranges) and every aggregate is one `np.bincount` pass — no 8M-row argsort
+    (measured 0.58 s of the 8M CPU Q3 aggregate) and no representative-row
+    gather (key values are reconstructed from the cell id). Returns None
+    whenever the shape doesn't apply — the sort path is always correct:
+    float or null-able keys, unbounded ranges, or min/max aggregates (which
+    have no vectorized direct-address form; `ufunc.at` is slower than the
+    sort)."""
+    n = table.num_rows
+    for _, fn, _ in aggs:
+        if fn in ("min", "max"):
+            return None
+    los, ranges, datas = [], [], []
+    for c in key_cols:
+        if c.validity is not None:
+            return None
+        data = c.data
+        if c.is_string:
+            lo, hi = 0, max(len(c.dictionary) - 1, 0)
+        elif data.dtype == np.bool_:
+            data = data.astype(np.int64)
+            lo, hi = 0, 1
+        elif np.issubdtype(data.dtype, np.integer):
+            lo, hi = int(data.min()), int(data.max())
+        else:
+            return None
+        los.append(lo)
+        ranges.append(hi - lo + 1)
+        datas.append(data)
+    cells = 1
+    for r in ranges:
+        cells *= r
+        if cells > _DIRECT_CELL_BUDGET:
+            return None
+
+    # Mixed-radix cell id per row: last key fastest (row-major).
+    strides = [1] * len(ranges)
+    for i in range(len(ranges) - 2, -1, -1):
+        strides[i] = strides[i + 1] * ranges[i + 1]
+    gid0 = np.zeros(n, np.int64)
+    for data, lo, st in zip(datas, los, strides):
+        gid0 += (data.astype(np.int64) - lo) * st
+
+    counts = np.bincount(gid0, minlength=cells)
+    present = np.nonzero(counts)[0]
+    n_groups = len(present)
+    counts_p = counts[present]
+    remap = None  # dense per-row group ids, built only if an agg needs them
+
+    out = {}
+    for k, c, lo, rng, st in zip(group_keys, key_cols, los, ranges, strides):
+        vals = lo + (present // st) % rng
+        if c.is_string:
+            out[k] = Column(STRING, vals.astype(np.int32), c.dictionary, None)
+        else:
+            out[k] = Column(c.dtype, vals.astype(c.data.dtype), None, None)
+
+    # Per-column memo of (valid-cell ids, valid counts): count(v)+sum(v)+avg(v)
+    # over one nullable column must not pay three O(n) mask gathers and
+    # full-cells bincounts for the same answer.
+    nv_cache: dict = {}
+
+    def _valid_stats(col_name, valid):
+        if valid is None:
+            return gid0, counts_p
+        if col_name not in nv_cache:
+            g = gid0[valid]
+            nv_cache[col_name] = (g, np.bincount(g, minlength=cells)[present])
+        return nv_cache[col_name]
+
+    for out_name, fn, col_name in aggs:
+        col = table.column(col_name) if col_name is not None else None
+        dtype = result_dtype(fn, None if col is None else col.dtype)
+        if fn == "count" and col is None:
+            out[out_name] = _out_column(fn, col, dtype, counts_p, None)
+            continue
+        valid = col.validity
+        if fn == "count":
+            _, nv = _valid_stats(col_name, valid)
+            out[out_name] = _out_column(fn, col, dtype, nv, None)
+            continue
+        if fn == "count_distinct":
+            if remap is None:
+                remap = np.full(cells, -1, np.int64)
+                remap[present] = np.arange(n_groups)
+            v = valid if valid is not None else np.ones(n, bool)
+            vals = _count_distinct_per_group(remap[gid0], col, v, n_groups)
+            out[out_name] = _out_column(fn, col, dtype, vals, None)
+            continue
+        # sum / avg
+        g, nv = _valid_stats(col_name, valid)
+        any_valid = nv > 0
+        data = col.data
+        if np.issubdtype(data.dtype, np.floating):
+            w = data.astype(np.float64)
+            if valid is not None:
+                w = w[valid]
+            s = np.bincount(g, weights=w, minlength=cells)[present]
+        else:
+            # Exact int64 accumulation (bincount weights are float64 and
+            # would round sums past 2^53).
+            acc = data.astype(np.int64)
+            if valid is not None:
+                acc = acc[valid]
+            s = np.zeros(cells, np.int64)
+            np.add.at(s, g, acc)
+            s = s[present]
+        if fn == "avg":
+            s = s.astype(np.float64) / np.maximum(nv, 1)
+        out[out_name] = _out_column(fn, col, dtype, s, any_valid)
+    return Table(out)
+
+
 def hash_aggregate(table: Table, group_keys, aggs: Sequence[AggTriple]) -> Table:
     """GROUP BY `group_keys` computing `aggs` = [(out_name, fn, column|None)]."""
     group_keys = list(group_keys)
@@ -512,15 +634,20 @@ def hash_aggregate(table: Table, group_keys, aggs: Sequence[AggTriple]) -> Table
         return _empty_result(table, group_keys, aggs)
 
     n = table.num_rows
+    from .backend import use_device_path
+
+    device = use_device_path()
+    if not device:
+        direct = _direct_host_aggregate(table, group_keys, key_cols, aggs)
+        if direct is not None:
+            return direct
     arrs = [device_array(c.data) for c in key_cols]
     k64 = key64(key_cols, arrs)
 
-    # Group boundaries from ADJACENT ACTUAL VALUES (+ validity), never the hash.
-    from .backend import use_device_path
-
-    # ONE host-side lane list (data [+ validity] per key column); the device
-    # branch maps it through the memoized upload cache, the host branch
-    # consumes it directly.
+    # Group boundaries from ADJACENT ACTUAL VALUES (+ validity), never the
+    # hash. ONE host-side lane list (data [+ validity] per key column); the
+    # device branch maps it through the memoized upload cache, the host
+    # branch consumes it directly.
     flat_host = []
     has_valid = []
     for c in key_cols:
@@ -528,7 +655,6 @@ def hash_aggregate(table: Table, group_keys, aggs: Sequence[AggTriple]) -> Table
         has_valid.append(c.validity is not None)
         if c.validity is not None:
             flat_host.append(c.validity)
-    device = use_device_path()
     if device:
         # One fused program for sort + boundary detection + group ids: each
         # eager op is a dispatch, and on the axon relay a round-trip.
