@@ -1090,7 +1090,13 @@ def _gather_verified(
 _key64_cache: Dict[int, tuple] = {}
 _padded_cache: Dict[int, tuple] = {}
 _verify_cache: Dict[tuple, tuple] = {}
-_CACHES = {"k64": _key64_cache, "pad": _padded_cache, "ver": _verify_cache}
+_pairs_cache: Dict[tuple, tuple] = {}
+_CACHES = {
+    "k64": _key64_cache,
+    "pad": _padded_cache,
+    "ver": _verify_cache,
+    "pairs": _pairs_cache,
+}
 _CACHE_TAGS = {id(_key64_cache): "k64", id(_padded_cache): "pad"}
 
 # Concurrent queries (thread-local active sessions) share these memos; the
@@ -1145,7 +1151,7 @@ def _touch(tag, key) -> None:
 
 
 def _entry_nbytes(tag: str, ent) -> int:
-    if tag == "ver":
+    if tag in ("ver", "pairs"):  # two-table entries: (wr_left, wr_right, value)
         return _val_nbytes(ent[2])
     return sum(_val_nbytes(v) for v in ent[1].values())
 
@@ -1231,44 +1237,54 @@ def _cached_by_table(cache: Dict[int, tuple], table: Table, subkey, compute):
     return val
 
 
+def _cached_two_table(tag: str, left: Table, right: Table, subkey: tuple, compute):
+    """Per-(left, right) table-identity memo with the same byte accounting and
+    id-reuse guards as `_cached_by_table`: entries die with EITHER table (each
+    weakref may only drop the entry it installed)."""
+    import weakref
+
+    global _device_cache_bytes
+    cache = _CACHES[tag]
+    key = (id(left), id(right)) + subkey
+    with _cache_lock:
+        ent = cache.get(key)
+        if ent is not None and ent[0]() is left and ent[1]() is right:
+            _touch(tag, key)
+            return ent[2]
+    val = compute()  # outside the lock: device work must not serialize queries
+
+    def _evict(wr, key=key):
+        ent_now = cache.get(key)
+        if ent_now is not None and (ent_now[0] is wr or ent_now[1] is wr):
+            _drop_entry(tag, key)
+
+    with _cache_lock:
+        ent = cache.get(key)  # re-read under the lock
+        if ent is not None:
+            if ent[0]() is left and ent[1]() is right:
+                _touch(tag, key)
+                return ent[2]
+            _device_cache_bytes -= _val_nbytes(ent[2])
+        cache[key] = (weakref.ref(left, _evict), weakref.ref(right, _evict), val)
+        _device_cache_bytes += _val_nbytes(val)
+        _touch(tag, key)
+        _evict_over_budget((tag, key))
+    return val
+
+
 def _aligned_key_codes(left: Table, right: Table, lkey: str, rkey: str):
     """Union-dictionary-aligned code arrays for one string join-key pair, cached
     per (left, right) table identity so steady-state verification never decodes
     the raw strings (`_gather_verified` previously decoded both full columns per
     query)."""
-    import weakref
 
-    global _device_cache_bytes
-    key = (id(left), id(right), lkey.lower(), rkey.lower())
-    with _cache_lock:
-        ent = _verify_cache.get(key)
-        if ent is not None and ent[0]() is left and ent[1]() is right:
-            _touch("ver", key)
-            return ent[2]
-    lc, rc = align_dictionaries(left.column(lkey), right.column(rkey))
-    la, ra = lc.data, rc.data
+    def compute():
+        lc, rc = align_dictionaries(left.column(lkey), right.column(rkey))
+        return lc.data, rc.data
 
-    def _evict(wr, key=key):
-        # Same id-reuse guard as _cached_by_table: only the installing weakref
-        # may drop the entry.
-        ent_now = _verify_cache.get(key)
-        if ent_now is not None and (ent_now[0] is wr or ent_now[1] is wr):
-            _drop_entry("ver", key)
-
-    with _cache_lock:
-        ent = _verify_cache.get(key)  # re-read under the lock
-        if ent is not None:
-            if ent[0]() is left and ent[1]() is right:
-                _touch("ver", key)
-                return ent[2]
-            _device_cache_bytes -= _val_nbytes(ent[2])
-        _verify_cache[key] = (
-            weakref.ref(left, _evict), weakref.ref(right, _evict), (la, ra)
-        )
-        _device_cache_bytes += _val_nbytes((la, ra))
-        _touch("ver", key)
-        _evict_over_budget(("ver", key))
-    return la, ra
+    return _cached_two_table(
+        "ver", left, right, (lkey.lower(), rkey.lower()), compute
+    )
 
 
 def _padded_rep(table: Table, starts: np.ndarray, keys: List[str], force_hash: bool = False):
@@ -1656,10 +1672,28 @@ class SortMergeJoinExec(PhysicalNode):
             if l_blocks is not None and r_blocks is not None:
                 pairs = probe_dist_blocks(mesh, l_blocks, r_blocks)
         if pairs is None:
-            # Single-device: cached device-resident padded matrices (value-direct
-            # when possible), so the steady-state query starts at the probe.
-            l_rep, r_rep = self._reconciled_reps(left, right, l_starts, r_starts)
-            pairs = probe_padded(l_rep, r_rep)
+            # Single-device: the VERIFIED pair arrays are cached per
+            # (left, right) table identity — fully determined by the two
+            # tables and the keys, so a steady-state query that needs the
+            # joined rows (aggregates, collects) skips probe + expansion +
+            # verification entirely (~1 s of the 8M CPU Q3 aggregate). The
+            # padded reps underneath stay cached for the count-only and
+            # cold paths.
+            def compute():
+                l_rep, r_rep = self._reconciled_reps(
+                    left, right, l_starts, r_starts
+                )
+                p = probe_padded(l_rep, r_rep)
+                return _verify_pairs(
+                    left, right, self.left_keys, self.right_keys, p[0], p[1]
+                )
+
+            subkey = (
+                tuple(k.lower() for k in self.left_keys),
+                tuple(k.lower() for k in self.right_keys),
+            )
+            li, ri = _cached_two_table("pairs", left, right, subkey, compute)
+            return left, right, li, ri
         li, ri = _verify_pairs(
             left, right, self.left_keys, self.right_keys, pairs[0], pairs[1]
         )
