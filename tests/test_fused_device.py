@@ -428,3 +428,52 @@ def test_general_value_count_numpy_promotion(dev_session, tmp_path):
     q = l.join(r, col("a") == col("b"))
     disable_hyperspace(s)
     assert q.count() == len(q.collect().rows()) == 1  # only the 5 == 5.0 pair
+
+
+def test_fused_agg_device_pairs_cached_across_queries(dev_session, tmp_path):
+    """Steady-state fused aggregates must not redo the device probe/expansion/
+    verification: the compacted device pairs are cached per (left, right)
+    table identity, so the second identical query computes them zero times
+    (on TPU the probe alone measured 1.15 s at 8M rows)."""
+    from hyperspace_tpu.engine import physical as ph
+
+    s = dev_session
+    base = str(tmp_path)
+    _fact_dim(s, base)
+    hs = Hyperspace(s)
+    hs.create_index(
+        s.read.parquet(os.path.join(base, "fact")),
+        IndexConfig("pc_f", ["k"], ["qty", "price"]),
+    )
+    hs.create_index(
+        s.read.parquet(os.path.join(base, "dim")), IndexConfig("pc_d", ["dk"], ["grp"])
+    )
+    enable_hyperspace(s)
+
+    def q():
+        f = s.read.parquet(os.path.join(base, "fact"))
+        d = s.read.parquet(os.path.join(base, "dim"))
+        return (
+            f.join(d, col("k") == col("dk"))
+            .group_by("grp")
+            .agg(total=("qty", "sum"))
+            .order_by(("grp", True))
+        )
+
+    calls = []
+    orig = ph.SortMergeJoinExec._device_pairs_compacted
+
+    def spy(self, *a, **k):
+        calls.append(1)
+        return orig(self, *a, **k)
+
+    ph.SortMergeJoinExec._device_pairs_compacted = spy
+    try:
+        first = q().collect().rows()
+        n_first = len(calls)
+        assert n_first >= 1  # the fused path actually computed device pairs
+        second = q().collect().rows()
+        assert len(calls) == n_first  # cache hit: zero recomputes
+    finally:
+        ph.SortMergeJoinExec._device_pairs_compacted = orig
+    assert first == second
