@@ -235,12 +235,12 @@ class BucketedIndexScanExec(PhysicalNode):
             {n: _empty_column(self.relation.schema.field(n).dtype) for n in names}
         )
 
-    def _concat_cache_key(self):
-        """Steady-state cache key: the file inventory + pruned columns. A hybrid
-        append contributes ITS file inventory too — the merged bucketization is
-        a pure function of (index files, appended files, columns), and any
-        change to the appended set (new append, rewrite) changes the key, the
-        same freshness contract every scan cache rides."""
+    def rows_token(self, ctx=None) -> tuple:
+        """Identity of this scan's ROW SET AND ORDER, independent of column
+        pruning: the file inventory (+ hybrid-append inventory). Two prunings
+        of the same scan concat the same buckets in the same order, so join
+        pair indices computed against one apply verbatim to the other — the
+        pairs cache keys on this, not on the (column-pruned) table identity."""
         ha = self.relation.hybrid_append
         ha_key = ()
         if ha is not None:
@@ -250,9 +250,16 @@ class BucketedIndexScanExec(PhysicalNode):
             )
         return (
             tuple((f.path, f.size, f.modified_time) for f in self.relation.files),
+            ha_key,
+        )
+
+    def _concat_cache_key(self):
+        """Steady-state cache key: the row identity + pruned columns. Any
+        change to the source or appended file set changes the key, the same
+        freshness contract every scan cache rides."""
+        return self.rows_token() + (
             # None (all columns) must not share a key with [] (zero columns).
             ("<all>",) if self.columns is None else tuple(self.columns),
-            ha_key,
         )
 
     def execute_concat(self, ctx) -> Tuple[Table, np.ndarray]:
@@ -335,26 +342,13 @@ class FilterExec(PhysicalNode):
             raise HyperspaceException(
                 "execute_concat requires a bucketed scan child"
             )
-        from .expr import canonical_condition_repr
         from .scan_cache import global_filtered_cache
 
         base_key = child._concat_cache_key()
-        cs = (
-            ctx.session.hs_conf.case_sensitive
-            if ctx is not None and ctx.session is not None
-            else False
-        )
-        # Spelling normalization is only sound when no two schema columns
-        # collide case-insensitively: Table._resolve is exact-match-first, so
-        # with both 'X' and 'x' present, col('X') and col('x') read DIFFERENT
-        # columns and must not share a cache entry.
-        names = child.relation.schema.names
-        if len({n.lower() for n in names}) != len(names):
-            cs = True
         key = (
             None
             if base_key is None
-            else ("filtered", base_key, canonical_condition_repr(self.condition, cs))
+            else ("filtered", base_key, self._condition_key(ctx))
         )
         if key is not None:
             hit = global_filtered_cache().get(key)
@@ -371,6 +365,33 @@ class FilterExec(PhysicalNode):
         if key is not None:
             global_filtered_cache().put(key, table, starts)
         return table, starts
+
+    def _condition_key(self, ctx) -> str:
+        """Cache-key spelling of the condition. Spelling normalization is only
+        sound when no two schema columns collide case-insensitively:
+        Table._resolve is exact-match-first, so with both 'X' and 'x' present,
+        col('X') and col('x') read DIFFERENT columns and must not share a
+        cache entry."""
+        from .expr import canonical_condition_repr
+
+        cs = (
+            ctx.session.hs_conf.case_sensitive
+            if ctx is not None and ctx.session is not None
+            else False
+        )
+        names = self.child.relation.schema.names
+        if len({n.lower() for n in names}) != len(names):
+            cs = True
+        return canonical_condition_repr(self.condition, cs)
+
+    def rows_token(self, ctx=None):
+        """Row identity of the filtered bucketed scan (see
+        `BucketedIndexScanExec.rows_token`): the child's row identity + the
+        condition. None when the child can't provide one."""
+        child = self.child
+        if not isinstance(child, BucketedIndexScanExec):
+            return None
+        return ("filtered-rows", child.rows_token(ctx), self._condition_key(ctx))
 
     def simple_string(self):
         return f"Filter {self.condition!r}"
@@ -830,10 +851,8 @@ class HashAggregateExec(PhysicalNode):
         # state aggregate; probe alone measured 1.15 s at 8M on TPU) runs
         # once per table pair, not once per query. HBM pinning rides the
         # device-memo byte budget. A legitimately-empty join caches None.
-        subkey = (
-            "dev",
-            tuple(k.lower() for k in join.left_keys),
-            tuple(k.lower() for k in join.right_keys),
+        subkey = ("dev",) + _pair_subkey(
+            join.left_keys, join.right_keys, left, right
         )
         pairs = _cached_two_table(
             "pairs",
@@ -841,6 +860,7 @@ class HashAggregateExec(PhysicalNode):
             right,
             subkey,
             lambda: join._device_pairs_compacted(left, right, l_starts, r_starts),
+            rows_key=_pair_rows_key(join.left, join.right, ctx),
         )
         if pairs is None:
             return None
@@ -1254,18 +1274,41 @@ def _cached_by_table(cache: Dict[int, tuple], table: Table, subkey, compute):
     return val
 
 
-def _cached_two_table(tag: str, left: Table, right: Table, subkey: tuple, compute):
-    """Per-(left, right) table-identity memo with the same byte accounting and
-    id-reuse guards as `_cached_by_table`: entries die with EITHER table (each
-    weakref may only drop the entry it installed)."""
+def _two_table_key(left: Table, right: Table, subkey: tuple, rows_key):
+    """Cache key + entry-validity predicate for the two-table memos.
+
+    Default (rows_key None): keyed by table identity — a hit requires the
+    entry's weakrefs to point at EXACTLY these tables (id-reuse guard).
+
+    With a rows_key (value identity: file inventories + conditions), the key
+    is projection-independent — pairs computed against one column pruning of
+    a scan serve every other pruning of the same rows. The weakrefs then only
+    manage lifetime/accounting: a hit requires both producer tables to still
+    be alive (their death invalidates nothing semantically, but the entry's
+    memory accounting dies with them)."""
+    if rows_key is None:
+        key = (id(left), id(right)) + subkey
+        valid = lambda ent: ent[0]() is left and ent[1]() is right
+    else:
+        key = rows_key + subkey
+        valid = lambda ent: ent[0]() is not None and ent[1]() is not None
+    return key, valid
+
+
+def _cached_two_table(
+    tag: str, left: Table, right: Table, subkey: tuple, compute, rows_key=None
+):
+    """Per-table-pair memo with the same byte accounting and id-reuse guards
+    as `_cached_by_table`: entries die with EITHER table (each weakref may
+    only drop the entry it installed). See `_two_table_key` for keying."""
     import weakref
 
     global _device_cache_bytes
     cache = _CACHES[tag]
-    key = (id(left), id(right)) + subkey
+    key, valid = _two_table_key(left, right, subkey, rows_key)
     with _cache_lock:
         ent = cache.get(key)
-        if ent is not None and ent[0]() is left and ent[1]() is right:
+        if ent is not None and valid(ent):
             _touch(tag, key)
             return ent[2]
     val = compute()  # outside the lock: device work must not serialize queries
@@ -1278,7 +1321,7 @@ def _cached_two_table(tag: str, left: Table, right: Table, subkey: tuple, comput
     with _cache_lock:
         ent = cache.get(key)  # re-read under the lock
         if ent is not None:
-            if ent[0]() is left and ent[1]() is right:
+            if valid(ent):
                 _touch(tag, key)
                 return ent[2]
             _device_cache_bytes -= _val_nbytes(ent[2])
@@ -1287,6 +1330,52 @@ def _cached_two_table(tag: str, left: Table, right: Table, subkey: tuple, comput
         _touch(tag, key)
         _evict_over_budget((tag, key))
     return val
+
+
+def _peek_two_table(
+    tag: str, left: Table, right: Table, subkey: tuple, rows_key=None
+):
+    """Read-only probe of a `_cached_two_table` entry: (hit, value). Lets a
+    cheaper consumer (e.g. a count) reuse work a richer query already paid
+    for, without computing anything on a miss."""
+    cache = _CACHES[tag]
+    key, valid = _two_table_key(left, right, subkey, rows_key)
+    with _cache_lock:
+        ent = cache.get(key)
+        if ent is not None and valid(ent):
+            _touch(tag, key)
+            return True, ent[2]
+    return False, None
+
+
+def _pair_rows_key(lnode, rnode, ctx):
+    """Projection-independent rows key for a join's pair caches, when both
+    children can state their row identity (bucketed scans / bucket-preserving
+    filters). None falls back to table-identity keying."""
+    lt = getattr(lnode, "rows_token", None)
+    rt = getattr(rnode, "rows_token", None)
+    if lt is None or rt is None:
+        return None
+    ltok, rtok = lt(ctx), rt(ctx)
+    if ltok is None or rtok is None:
+        return None
+    return (ltok, rtok)
+
+
+def _pair_subkey(left_keys, right_keys, left: Table, right: Table) -> tuple:
+    """Join-key component of the pair-cache keys. Spelling-normalized
+    (lowercased) ONLY when no schema column case-collides — the same guard as
+    `FilterExec._condition_key`: with both 'K' and 'k' present, resolution is
+    exact-match-first, so joins on 'K' and on 'k' read DIFFERENT columns and
+    must not share a cache entry (the projection-independent rows key would
+    otherwise make them collide)."""
+    names = list(left.column_names) + list(right.column_names)
+    if len({n.lower() for n in names}) != len(set(names)):
+        return tuple(left_keys), tuple(right_keys)
+    return (
+        tuple(k.lower() for k in left_keys),
+        tuple(k.lower() for k in right_keys),
+    )
 
 
 def _aligned_key_codes(left: Table, right: Table, lkey: str, rkey: str):
@@ -1705,11 +1794,15 @@ class SortMergeJoinExec(PhysicalNode):
                     left, right, self.left_keys, self.right_keys, p[0], p[1]
                 )
 
-            subkey = (
-                tuple(k.lower() for k in self.left_keys),
-                tuple(k.lower() for k in self.right_keys),
+            subkey = _pair_subkey(self.left_keys, self.right_keys, left, right)
+            li, ri = _cached_two_table(
+                "pairs",
+                left,
+                right,
+                subkey,
+                compute,
+                rows_key=_pair_rows_key(self.left, self.right, ctx),
             )
-            li, ri = _cached_two_table("pairs", left, right, subkey, compute)
             return left, right, li, ri
         li, ri = _verify_pairs(
             left, right, self.left_keys, self.right_keys, pairs[0], pairs[1]
@@ -1762,6 +1855,17 @@ class SortMergeJoinExec(PhysicalNode):
         )
         if mesh is not None:
             return None  # the sharded probe owns mesh-scale execution
+        # Cross-query reuse: an aggregate/collect over these same ROWS (any
+        # column pruning) has already computed and cached the verified pairs
+        # — the count is free.
+        subkey = _pair_subkey(self.left_keys, self.right_keys, left, right)
+        rows_key = _pair_rows_key(self.left, self.right, ctx)
+        hit, val = _peek_two_table("pairs", left, right, subkey, rows_key)
+        if hit:
+            return len(val[0])
+        hit, val = _peek_two_table("pairs", left, right, ("dev",) + subkey, rows_key)
+        if hit:
+            return 0 if val is None else int(val[2])
         l_rep, r_rep = self._reconciled_reps(left, right, l_starts, r_starts)
         if l_rep.mode != "value" and not use_device_path():
             # Hash-mode counts on the CPU backend take the host expansion path;

@@ -477,3 +477,61 @@ def test_fused_agg_device_pairs_cached_across_queries(dev_session, tmp_path):
     finally:
         ph.SortMergeJoinExec._device_pairs_compacted = orig
     assert first == second
+
+
+def test_count_reuses_pairs_cached_by_aggregate(dev_session, tmp_path):
+    """Cross-query reuse: after an aggregate cached the device pairs for a
+    table pair, a count on the same join must answer from the cache without
+    re-deriving the padded reps (the probe's input)."""
+    from hyperspace_tpu.engine import physical as ph
+
+    s = dev_session
+    base = str(tmp_path)
+    _fact_dim(s, base)
+    hs = Hyperspace(s)
+    hs.create_index(
+        s.read.parquet(os.path.join(base, "fact")),
+        IndexConfig("cr_f", ["k"], ["qty", "price"]),
+    )
+    hs.create_index(
+        s.read.parquet(os.path.join(base, "dim")), IndexConfig("cr_d", ["dk"], ["grp"])
+    )
+
+    def join():
+        f = s.read.parquet(os.path.join(base, "fact"))
+        d = s.read.parquet(os.path.join(base, "dim"))
+        return f.join(d, col("k") == col("dk"))
+
+    disable_hyperspace(s)
+    expected = join().count()
+    enable_hyperspace(s)
+    # The aggregate populates the device pairs cache for this table pair.
+    join().group_by("grp").agg(total=("qty", "sum")).collect()
+
+    orig = ph.SortMergeJoinExec._reconciled_reps
+
+    def boom(self, *a, **k):
+        raise AssertionError("count should answer from the pairs cache")
+
+    ph.SortMergeJoinExec._reconciled_reps = boom
+    try:
+        assert join().count() == expected
+    finally:
+        ph.SortMergeJoinExec._reconciled_reps = orig
+
+
+def test_pair_subkey_preserves_case_on_colliding_schemas():
+    """With both 'K' and 'k' in scope, joins on col('K') and col('k') read
+    DIFFERENT columns (resolution is exact-match-first) and must not share a
+    pairs-cache entry under the projection-independent rows key."""
+    from hyperspace_tpu.engine import physical as ph
+    from hyperspace_tpu.engine.table import Table
+
+    plain_l = Table.from_pydict({"k": np.array([1]), "v": np.array([2])})
+    plain_r = Table.from_pydict({"dk": np.array([1])})
+    assert ph._pair_subkey(["K"], ["dk"], plain_l, plain_r) == (("k",), ("dk",))
+
+    collide_l = Table.from_pydict({"K": np.array([1]), "k": np.array([2])})
+    a = ph._pair_subkey(["K"], ["dk"], collide_l, plain_r)
+    b = ph._pair_subkey(["k"], ["dk"], collide_l, plain_r)
+    assert a != b  # exact spellings kept: no shared entry
