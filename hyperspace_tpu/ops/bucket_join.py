@@ -75,6 +75,28 @@ def _probe(ls, rs, l_len, r_len):
     return lo.astype(jnp.int32), counts.astype(jnp.int32)
 
 
+def _probe_host(L, R, l_len, r_len):
+    """Host twin of `_probe` for the CPU backend: per-bucket `np.searchsorted`
+    over the valid regions (XLA-CPU's vmap'd searchsorted measured ~4x slower
+    at bench shapes — 1.19 s vs 0.31 s at 64x131072 probing 64x16384). Same
+    contract: int32 (lo, counts), counts zeroed on left pad slots, ranges
+    clamped to the right side's valid length by construction (the build slice
+    stops at r_len)."""
+    lo = np.zeros(L.shape, np.int32)
+    cnt = np.zeros(L.shape, np.int32)
+    for b in range(L.shape[0]):
+        n, m = int(l_len[b]), int(r_len[b])
+        if n == 0 or m == 0:
+            continue
+        probe, build = L[b, :n], R[b, :m]
+        left = np.searchsorted(build, probe, "left")
+        lo[b, :n] = left
+        cnt[b, :n] = (np.searchsorted(build, probe, "right") - left).astype(
+            np.int32
+        )
+    return lo, cnt
+
+
 def _expand_np(
     lo: np.ndarray,
     counts: np.ndarray,
@@ -161,8 +183,14 @@ def _compact_pairs_dev(out_cap2: int, ai, bi, keep):
     return a2, b2
 
 
-@jax.jit
 def _counts_total(counts):
+    if isinstance(counts, np.ndarray):  # host probe output: no device hop
+        return counts.sum(dtype=np.int64)
+    return _counts_total_jit(counts)
+
+
+@jax.jit
+def _counts_total_jit(counts):
     return counts.sum(dtype=jnp.int64)
 
 
@@ -286,17 +314,25 @@ def probe_keys_promoted(a_keys, b_keys):
 def probe_ranges(ls, rs, l_len, r_len):
     """Probe dispatcher: the Pallas tiled-compare kernel when wanted (on-TPU
     within its capacity budget, or HYPERSPACE_PALLAS_PROBE=1), else the XLA
-    vmap'd-searchsorted probe. Any Pallas failure is recorded once and falls
-    back permanently — an index problem must never break a query."""
+    vmap'd-searchsorted probe; the CPU backend probes on host (numpy
+    searchsorted, ~4x the XLA-CPU probe). Any Pallas failure is recorded once
+    and falls back permanently — an index problem must never break a query."""
+    from .backend import use_device_path
     from .pallas_probe import pallas_probe_wanted, probe_pallas, record_pallas_failure
 
     if pallas_probe_wanted(
         int(ls.shape[1]), int(rs.shape[1]), int(ls.shape[0]), ls.dtype
     ):
+        # Checked FIRST: HYPERSPACE_PALLAS_PROBE=1 forces the kernel even on
+        # the CPU backend (interpret-mode validation rides this).
         try:
             return probe_pallas(ls, rs, l_len, r_len)
         except Exception as e:  # Mosaic lowering/runtime problems
             record_pallas_failure(e, ls.dtype)
+    if not use_device_path():
+        return _probe_host(
+            np.asarray(ls), np.asarray(rs), np.asarray(l_len), np.asarray(r_len)
+        )
     return _probe(ls, rs, l_len, r_len)
 
 

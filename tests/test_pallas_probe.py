@@ -204,3 +204,23 @@ def test_float_keys_admitted_on_any_backend(monkeypatch):
     monkeypatch.setattr(pp, "_pallas_broken", {})
     monkeypatch.setenv("HYPERSPACE_PALLAS_PROBE", "1")
     assert pp.pallas_probe_wanted(256, 512, 8, np.dtype(np.float64))
+
+
+def test_host_probe_matches_xla_probe():
+    """The CPU backend's host probe (`_probe_host`) must match the XLA probe
+    exactly on valid regions: lo wherever counts > 0, counts everywhere."""
+    from hyperspace_tpu.ops.bucket_join import _probe, _probe_host
+
+    rng = np.random.RandomState(3)
+    B, capL, capR = 6, 256, 64
+    L = np.sort(rng.randint(0, 300, (B, capL)).astype(np.int64), axis=1)
+    R = np.sort(rng.randint(0, 300, (B, capR)).astype(np.int64), axis=1)
+    l_len = rng.randint(0, capL + 1, B).astype(np.int32)
+    r_len = rng.randint(0, capR + 1, B).astype(np.int32)
+    lo_h, cnt_h = _probe_host(L, R, l_len, r_len)
+    lo_x, cnt_x = (np.asarray(a) for a in _probe(L, R, l_len, r_len))
+    valid = np.arange(capL)[None, :] < l_len[:, None]
+    np.testing.assert_array_equal(cnt_h[valid], cnt_x[valid])
+    np.testing.assert_array_equal(cnt_h[~valid], 0)
+    m = valid & (cnt_h > 0)
+    np.testing.assert_array_equal(lo_h[m], lo_x[m])
