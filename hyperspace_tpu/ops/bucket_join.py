@@ -251,6 +251,20 @@ def pad_buckets_by_value(vals, starts_np: np.ndarray) -> Optional[PaddedBuckets]
     cap = _cap_pow2(int(lens.max()))
     vals = jnp.asarray(vals)
     if jnp.issubdtype(vals.dtype, jnp.floating):
+        # NaN keys disqualify value mode EXPLICITLY: value-mode probe counts
+        # are trusted without verification, but every probe implementation
+        # counts NaN as matching NaN while the engine's equality says NaN
+        # never equals anything. (Multi-row NaN buckets already fail the
+        # non-decreasing check below — NaN >= x is false — but a SINGLETON
+        # NaN bucket has zero comparisons and would slip through.) The hash
+        # rep canonicalizes NaN and verifies exactly.
+        if bool(jnp.isnan(vals).any()):
+            return None
+        # Canonicalize -0.0 -> +0.0: probe implementations disagree on signed
+        # zeros (numpy searchsorted compares IEEE-equal; lax.sort's total
+        # order puts -0.0 < +0.0 on some backends), and the engine's equality
+        # treats them equal — canonical keys make every probe agree.
+        vals = jnp.where(vals == 0, jnp.zeros((), vals.dtype), vals)
         pad = jnp.asarray(jnp.finfo(vals.dtype).max, dtype=vals.dtype)
     else:
         pad = jnp.asarray(jnp.iinfo(vals.dtype).max, dtype=vals.dtype)

@@ -45,6 +45,37 @@ def _sortable(arr: jnp.ndarray) -> jnp.ndarray:
     return arr
 
 
+def _composite_sort_host(
+    b_host: np.ndarray, cols, num_buckets: int
+) -> "np.ndarray | None":
+    """Single-lane composite sort for the common single-key case: with one
+    non-null integer-or-dictionary key of bounded range, `bucket * range +
+    (key - min)` fits int64 and one unstable introsort orders by
+    (bucket, key) — measured 0.84 s vs lexsort's 2.58 s at 8M. Instability
+    within equal (bucket, key) is arbitrary-safe by the same argument as the
+    Pallas bitonic sort (`ops/pallas_sort.py` docstring): joins emit whole
+    equal-key ranges and verify actual values. Strings ride their sorted-
+    dictionary codes (code order IS value order). None = use the lexsort."""
+    if len(cols) != 1:
+        return None
+    c = cols[0]
+    if getattr(c, "validity", None) is not None:
+        return None
+    data = c.data  # codes for strings
+    if data.dtype == np.bool_:
+        data = data.astype(np.int64)
+    if not np.issubdtype(data.dtype, np.integer):
+        return None
+    if data.shape[0] == 0:
+        return np.empty(0, np.int64)
+    lo, hi = int(data.min()), int(data.max())
+    span = hi - lo + 1
+    if span > (1 << 62) // max(num_buckets, 1):
+        return None
+    comp = b_host.astype(np.int64) * span + (data.astype(np.int64) - lo)
+    return np.argsort(comp)
+
+
 def bucketize_table(
     table: Table, bucket_columns: Sequence[str], num_buckets: int
 ) -> Tuple[Table, np.ndarray]:
@@ -64,11 +95,13 @@ def bucketize_table(
         # design is for the TPU, where lax.sort is the right primitive. The
         # output contract (permutation by (bucket, keys...)) is identical.
         b_host = np.asarray(b)
-        lanes = tuple(
-            c.data.astype(np.int32) if c.data.dtype == np.bool_ else c.data
-            for c in reversed(cols)
-        ) + (b_host,)
-        perm_host = np.lexsort(lanes)
+        perm_host = _composite_sort_host(b_host, cols, num_buckets)
+        if perm_host is None:
+            lanes = tuple(
+                c.data.astype(np.int32) if c.data.dtype == np.bool_ else c.data
+                for c in reversed(cols)
+            ) + (b_host,)
+            perm_host = np.lexsort(lanes)
         sorted_b_host = b_host[perm_host]
     else:
         perm, sorted_b = _sort_perm(

@@ -593,3 +593,42 @@ def test_cross_kind_bucketed_pair_demotes_to_general_join(session, tmp_path):
     assert "bucketed, no exchange" not in plan  # co-location refused
     assert q().sorted_rows() == expected
     assert q().count() == 200
+
+
+def test_composite_sort_matches_lexsort_contract():
+    """The single-key composite sort (CPU fast path) must order identically to
+    the lexsort: same ordered (bucket, key) tuples, same bucket boundaries.
+    Negative keys, string codes, and the fallback conditions are all pinned."""
+    from hyperspace_tpu.engine.table import Column
+    from hyperspace_tpu.ops.partition import _composite_sort_host
+
+    rng = np.random.RandomState(9)
+    n = 20000
+    for key in (
+        rng.randint(-500, 400, n).astype(np.int64),  # negative range
+        rng.randint(0, 37, n).astype(np.int32),
+    ):
+        b = (rng.randint(0, 16, n)).astype(np.int32)
+        col = Column(str(key.dtype), key, None, None)
+        perm = _composite_sort_host(b, [col], 16)
+        assert perm is not None
+        ref = np.lexsort((key, b))
+        assert np.array_equal(
+            np.stack([b[perm], key[perm]]), np.stack([b[ref], key[ref]])
+        )
+    # String keys sort by dictionary code.
+    codes = rng.randint(0, 5, n).astype(np.int32)
+    scol = Column("string", codes, np.array(["a", "b", "c", "d", "e"]), None)
+    b = (codes % 4).astype(np.int32)
+    perm = _composite_sort_host(b, [scol], 4)
+    ref = np.lexsort((codes, b))
+    assert np.array_equal(codes[perm], codes[ref])
+    # Fallbacks: nullable key, float key, multi-key, oversized span.
+    assert _composite_sort_host(b, [Column("int64", codes.astype(np.int64), None,
+                                           rng.rand(n) > 0.5)], 4) is None
+    assert _composite_sort_host(b, [Column("float64", codes.astype(np.float64),
+                                           None, None)], 4) is None
+    assert _composite_sort_host(b, [scol, scol], 4) is None
+    wide = codes.astype(np.int64)
+    wide[0] = 1 << 61
+    assert _composite_sort_host(b, [Column("int64", wide, None, None)], 4) is None

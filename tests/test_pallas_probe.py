@@ -224,3 +224,33 @@ def test_host_probe_matches_xla_probe():
     np.testing.assert_array_equal(cnt_h[~valid], 0)
     m = valid & (cnt_h > 0)
     np.testing.assert_array_equal(lo_h[m], lo_x[m])
+
+
+def test_value_rep_canonicalizes_negative_zero():
+    """pad_buckets_by_value must emit no -0.0 keys (probe implementations
+    disagree on signed-zero ordering; the engine's equality treats them
+    equal), and a NaN-holding bucket must fall back to the hash rep."""
+    import jax.numpy as jnp
+    from hyperspace_tpu.ops import bucket_join as bj
+
+    rep = bj.pad_buckets_by_value(
+        jnp.asarray(np.array([-0.0, 0.0, 1.5])), np.array([0, 3])
+    )
+    assert rep is not None and rep.mode == "value"
+    keys = np.asarray(rep.keys)[0, :3]
+    assert not np.signbit(keys).any()
+    np.testing.assert_array_equal(keys, [0.0, 0.0, 1.5])
+    assert (
+        bj.pad_buckets_by_value(
+            jnp.asarray(np.array([1.0, np.nan])), np.array([0, 2])
+        )
+        is None
+    )
+    # A SINGLETON NaN bucket has zero sortedness comparisons — the explicit
+    # NaN gate (not the non-decreasing check) must reject it.
+    assert (
+        bj.pad_buckets_by_value(
+            jnp.asarray(np.array([np.nan])), np.array([0, 1])
+        )
+        is None
+    )
