@@ -851,16 +851,17 @@ class HashAggregateExec(PhysicalNode):
         # state aggregate; probe alone measured 1.15 s at 8M on TPU) runs
         # once per table pair, not once per query. HBM pinning rides the
         # device-memo byte budget. A legitimately-empty join caches None.
-        subkey = ("dev",) + _pair_subkey(
-            join.left_keys, join.right_keys, left, right
-        )
+        base_subkey = _pair_subkey(join.left_keys, join.right_keys, left, right)
+        rows_key = _pair_rows_key(join.left, join.right, ctx)
         pairs = _cached_two_table(
             "pairs",
             left,
             right,
-            subkey,
-            lambda: join._device_pairs_compacted(left, right, l_starts, r_starts),
-            rows_key=_pair_rows_key(join.left, join.right, ctx),
+            ("dev",) + base_subkey,
+            lambda: join._device_pairs_compacted(
+                left, right, l_starts, r_starts, base_subkey, rows_key
+            ),
+            rows_key=rows_key,
         )
         if pairs is None:
             return None
@@ -1362,6 +1363,31 @@ def _pair_rows_key(lnode, rnode, ctx):
     return (ltok, rtok)
 
 
+def _probe_ranges_cached(l_rep, r_rep, left: Table, right: Table, subkey, rows_key):
+    """Probe ranges (lo, counts) through the pairs memo: the probe is the
+    dominant steady-state device cost (1.15 s at 8M on TPU in round 4) and
+    its output is a pure function of the two reps — which are themselves
+    pure functions of row identity + keys + mode (the mode rides the cache
+    subkey: a hybrid-append flip from value to hash re-keys). Returns
+    (lo, counts) in the canonical probe orientation (deterministic from the
+    rep capacities; callers recompute it with `probe_orientation`)."""
+    from ..ops.bucket_join import (
+        probe_keys_promoted,
+        probe_orientation,
+        probe_ranges,
+    )
+
+    a, b, _swapped = probe_orientation(l_rep, r_rep)
+
+    def compute():
+        ak, bk = probe_keys_promoted(a.keys, b.keys)
+        return probe_ranges(ak, bk, a.lengths, b.lengths)
+
+    return _cached_two_table(
+        "pairs", left, right, ("probe", l_rep.mode) + subkey, compute, rows_key
+    )
+
+
 def _pair_subkey(left_keys, right_keys, left: Table, right: Table) -> tuple:
     """Join-key component of the pair-cache keys. Spelling-normalized
     (lowercased) ONLY when no schema column case-collides — the same guard as
@@ -1785,23 +1811,25 @@ class SortMergeJoinExec(PhysicalNode):
             # verification entirely (~1 s of the 8M CPU Q3 aggregate). The
             # padded reps underneath stay cached for the count-only and
             # cold paths.
+            subkey = _pair_subkey(self.left_keys, self.right_keys, left, right)
+            rows_key = _pair_rows_key(self.left, self.right, ctx)
+
             def compute():
                 l_rep, r_rep = self._reconciled_reps(
                     left, right, l_starts, r_starts
                 )
-                p = probe_padded(l_rep, r_rep)
+                # Ranges through the probe memo: a count on the same rows has
+                # usually probed already — this pair expansion starts there.
+                ranges = _probe_ranges_cached(
+                    l_rep, r_rep, left, right, subkey, rows_key
+                )
+                p = probe_padded(l_rep, r_rep, ranges=ranges)
                 return _verify_pairs(
                     left, right, self.left_keys, self.right_keys, p[0], p[1]
                 )
 
-            subkey = _pair_subkey(self.left_keys, self.right_keys, left, right)
             li, ri = _cached_two_table(
-                "pairs",
-                left,
-                right,
-                subkey,
-                compute,
-                rows_key=_pair_rows_key(self.left, self.right, ctx),
+                "pairs", left, right, subkey, compute, rows_key=rows_key
             )
             return left, right, li, ri
         li, ri = _verify_pairs(
@@ -1829,20 +1857,12 @@ class SortMergeJoinExec(PhysicalNode):
         Value-direct reps compare ACTUAL key values in the probe (same promoted
         space as `_verify_pairs`' equality), so the probe counts are already
         exact — the count is one device reduction of the count matrix, with no
-        pair expansion at all. Hash reps enumerate candidate ranges on device
-        (`_expand_pairs_dev`) and verify exact equality + null keys in one
-        fused program. Returns None when this path does not apply (mesh-sharded
-        execution, or hash mode on the CPU backend where the host expansion
-        measured faster)."""
+        pair expansion at all. Hash reps compute the same verified compacted
+        device pairs the fused join→aggregate uses (shared memo). Returns
+        None when this path does not apply (mesh-sharded execution, or hash
+        mode on the CPU backend where the host expansion measured faster)."""
         from ..ops.backend import use_device_path
-        from ..ops.bucket_join import (
-            _cap_pow2,
-            _counts_total,
-            _expand_pairs_dev,
-            probe_keys_promoted,
-            probe_orientation,
-            probe_ranges,
-        )
+        from ..ops.bucket_join import _counts_total
 
         left, l_starts = self.left.execute_concat(ctx)
         right, r_starts = self.right.execute_concat(ctx)
@@ -1871,27 +1891,31 @@ class SortMergeJoinExec(PhysicalNode):
             # Hash-mode counts on the CPU backend take the host expansion path;
             # bailing BEFORE the probe avoids running it twice.
             return None
-        a, b, swapped = probe_orientation(l_rep, r_rep)
-        ak, bk = probe_keys_promoted(a.keys, b.keys)
-        lo, counts = probe_ranges(ak, bk, a.lengths, b.lengths)
         if l_rep.mode == "value":
+            # Value-direct: probe counts are exact. The probe RANGES are an
+            # intermediate shared by counts, aggregates and collects, so they
+            # ride the pairs memo keyed by row identity — a repeated count is
+            # one reduction over the cached count matrix, not a re-probe
+            # (1.15 s at 8M on TPU in round 4).
+            _lo, counts = _probe_ranges_cached(
+                l_rep, r_rep, left, right, subkey, rows_key
+            )
             return int(_counts_total(counts))
-        total = int(_counts_total(counts))
-        if total == 0:
-            return 0
-        ai, bi, valid = _expand_pairs_dev(
-            _cap_pow2(total),
-            True,
-            lo,
-            counts,
-            device_array(a.starts),
-            device_array(b.starts),
-            device_array(a.order),
-            device_array(b.order),
+        # Hash mode on the device path: the verified compacted device pairs
+        # are the SAME artifact the fused join→aggregate caches — compute
+        # through the shared memo so a count warms the aggregate and vice
+        # versa, and repeats read n_keep straight from the cache.
+        pairs = _cached_two_table(
+            "pairs",
+            left,
+            right,
+            ("dev",) + subkey,
+            lambda: self._device_pairs_compacted(
+                left, right, l_starts, r_starts, subkey, rows_key
+            ),
+            rows_key=rows_key,
         )
-        li, ri = (bi, ai) if swapped else (ai, bi)
-        lanes, flat = _verify_lanes(left, right, self.left_keys, self.right_keys)
-        return int(_verified_count_jit(lanes, li, ri, valid, *flat))
+        return 0 if pairs is None else int(pairs[2])
 
     def _general_count_fast(self, ctx, pre) -> Optional[int]:
         """Inner-join row count for the GENERAL (non-bucketed) path without
@@ -1976,13 +2000,18 @@ class SortMergeJoinExec(PhysicalNode):
             how, n_pairs, lm, rm, lt.num_rows, rt.num_rows
         )
 
-    def _device_pairs_compacted(self, left: Table, right: Table, l_starts, r_starts):
+    def _device_pairs_compacted(
+        self, left: Table, right: Table, l_starts, r_starts,
+        subkey=None, rows_key=None,
+    ):
         """VERIFIED inner-join pairs as DEVICE arrays, compacted and padded to a
         static pow2 size: (li, ri, n_keep, out_cap) with slots >= n_keep
         repeating the first real pair. The whole pipeline — probe, expansion,
         exact verification, compaction — runs on device; nothing row-scale
         crosses the host boundary. Feeds the fused join→aggregate path.
-        Returns None for empty joins (caller falls back)."""
+        Returns None for empty joins (caller falls back). With `subkey` (the
+        bare pair subkey) the probe ranges ride the probe memo, so a count
+        that probed these rows already hands its ranges to this expansion."""
         from ..ops.bucket_join import (
             _cap_pow2,
             _compact_pairs_dev,
@@ -1995,8 +2024,13 @@ class SortMergeJoinExec(PhysicalNode):
 
         l_rep, r_rep = self._reconciled_reps(left, right, l_starts, r_starts)
         a, b, swapped = probe_orientation(l_rep, r_rep)
-        ak, bk = probe_keys_promoted(a.keys, b.keys)
-        lo, counts = probe_ranges(ak, bk, a.lengths, b.lengths)
+        if subkey is not None:
+            lo, counts = _probe_ranges_cached(
+                l_rep, r_rep, left, right, subkey, rows_key
+            )
+        else:
+            ak, bk = probe_keys_promoted(a.keys, b.keys)
+            lo, counts = probe_ranges(ak, bk, a.lengths, b.lengths)
         total = int(_counts_total(counts))
         if total == 0:
             return None

@@ -350,18 +350,23 @@ def probe_ranges(ls, rs, l_len, r_len):
     return _probe(ls, rs, l_len, r_len)
 
 
-def probe_padded(left: PaddedBuckets, right: PaddedBuckets):
+def probe_padded(left: PaddedBuckets, right: PaddedBuckets, ranges=None):
     """Batched range probe of two padded sides → host (left_row, right_row) pairs.
 
     Both sides must be in the SAME mode: value-direct keys and key64 hashes live in
     different spaces, so a mixed probe would silently find nothing. The caller makes
     the mode decision jointly (`_padded_rep` + the mode reconciliation in
-    `SortMergeJoinExec._execute_bucketed`)."""
+    `SortMergeJoinExec._execute_bucketed`). `ranges` optionally supplies
+    already-computed (lo, counts) in the canonical probe orientation (the
+    engine's probe-range memo), skipping the probe entirely."""
     if left.mode != right.mode:
         raise ValueError(f"mixed padded modes: {left.mode} vs {right.mode}")
     a, b, swapped = probe_orientation(left, right)
-    ak, bk = probe_keys_promoted(a.keys, b.keys)
-    lo, counts = probe_ranges(ak, bk, a.lengths, b.lengths)
+    if ranges is not None:
+        lo, counts = ranges
+    else:
+        ak, bk = probe_keys_promoted(a.keys, b.keys)
+        lo, counts = probe_ranges(ak, bk, a.lengths, b.lengths)
     counts_np = np.asarray(counts)
     if counts_np.sum() == 0:
         return np.empty(0, np.int64), np.empty(0, np.int64)
