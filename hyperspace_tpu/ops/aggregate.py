@@ -384,7 +384,7 @@ def _host_aggregate(table: Table, group_keys, aggs: Sequence[AggTriple]) -> Tabl
         any_valid = nv > 0
         data = col.data
         if fn in ("sum", "avg"):
-            acc = data.astype(np.float64 if dtype == FLOAT64 else np.int64)
+            acc = data.astype(_acc_dtype(data.dtype))
             s = np.zeros(n_groups, acc.dtype)
             np.add.at(s, inverse[valid], acc[valid])
             vals = s if fn == "sum" else s.astype(np.float64) / np.maximum(nv, 1)

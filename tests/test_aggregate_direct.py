@@ -11,6 +11,7 @@ import pytest
 from hyperspace_tpu.engine.schema import STRING
 from hyperspace_tpu.engine.table import Column, Table
 from hyperspace_tpu.ops import aggregate as agg
+from hyperspace_tpu.ops.backend import use_device_path
 
 
 def _sorted_rows(table: Table, group_keys):
@@ -89,6 +90,12 @@ def test_direct_matches_oracle(gk):
     _assert_same(direct, agg._host_aggregate(t, gk, AGGS), gk)
 
 
+@pytest.mark.skipif(
+    # The REAL dispatch gate, not a hand copy: the direct path fires only on
+    # the CPU backend without forced device ops (hash_aggregate's condition).
+    use_device_path(),
+    reason="direct host aggregation is gated off on the device path",
+)
 def test_hash_aggregate_dispatches_direct_and_matches(monkeypatch):
     t = _table(seed=3)
     fired = []
