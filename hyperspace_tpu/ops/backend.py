@@ -27,3 +27,12 @@ def use_device_path() -> bool:
     """True when the lax.sort/argsort device kernels should run: any non-CPU
     backend, or any backend under HYPERSPACE_FORCE_DEVICE_OPS=1."""
     return jax.default_backend() != "cpu" or device_ops_forced()
+
+
+def pallas_maybe_wanted(env_key: str) -> bool:
+    """Cheap pre-gate evaluated BEFORE importing any pallas module: importing
+    `jax.experimental.pallas` costs ~1 s, and off-TPU a kernel can only be
+    wanted under an explicit `<env_key>=1` force — exactly the dispatchers'
+    own off-TPU condition, so the gate can never produce a false negative.
+    `=0` (explicit disable) must NOT trigger the import."""
+    return jax.default_backend() == "tpu" or os.environ.get(env_key) == "1"

@@ -16,6 +16,8 @@ mesh (each device owns a contiguous bucket range and never communicates).
 
 from __future__ import annotations
 
+import os
+
 from functools import partial
 from typing import Tuple
 
@@ -280,25 +282,30 @@ def pad_buckets_by_hash(key64_arr, starts_np: np.ndarray) -> PaddedBuckets:
     its VMEM shape budget the in-bucket sort dispatches to the Pallas
     single-pass bitonic kernel (`ops.pallas_sort`), guarded like the probe —
     any lowering failure falls back to the XLA argsort permanently."""
-    from .pallas_sort import (
-        pallas_sort_wanted,
-        record_sort_failure,
-        sort_padded_with_order,
-    )
+    from .backend import pallas_maybe_wanted
 
     B = len(starts_np) - 1
     lens = np.diff(starts_np)
     cap = _cap_pow2(int(lens.max())) if B else 1
     keys_nudged = jnp.minimum(jnp.asarray(key64_arr), _PAD - 1)
-    if pallas_sort_wanted(B, cap):
-        try:
-            padded, lengths = _pad_scatter(
-                keys_nudged, jnp.asarray(starts_np), B, cap
-            )
-            keys, order = sort_padded_with_order(padded)
-            return PaddedBuckets(keys, lengths, np.asarray(order), starts_np, "hash")
-        except Exception as e:  # Mosaic lowering/runtime problems
-            record_sort_failure(e)
+    if pallas_maybe_wanted("HYPERSPACE_PALLAS_SORT"):
+        from .pallas_sort import (
+            pallas_sort_wanted,
+            record_sort_failure,
+            sort_padded_with_order,
+        )
+
+        if pallas_sort_wanted(B, cap):
+            try:
+                padded, lengths = _pad_scatter(
+                    keys_nudged, jnp.asarray(starts_np), B, cap
+                )
+                keys, order = sort_padded_with_order(padded)
+                return PaddedBuckets(
+                    keys, lengths, np.asarray(order), starts_np, "hash"
+                )
+            except Exception as e:  # Mosaic lowering/runtime problems
+                record_sort_failure(e)
     keys, order, lengths = _pad_and_sort(keys_nudged, jnp.asarray(starts_np), B, cap)
     return PaddedBuckets(keys, lengths, np.asarray(order), starts_np, "hash")
 
@@ -332,17 +339,29 @@ def probe_ranges(ls, rs, l_len, r_len):
     searchsorted, ~4x the XLA-CPU probe). Any Pallas failure is recorded once
     and falls back permanently — an index problem must never break a query."""
     from .backend import use_device_path
-    from .pallas_probe import pallas_probe_wanted, probe_pallas, record_pallas_failure
 
-    if pallas_probe_wanted(
-        int(ls.shape[1]), int(rs.shape[1]), int(ls.shape[0]), ls.dtype
-    ):
-        # Checked FIRST: HYPERSPACE_PALLAS_PROBE=1 forces the kernel even on
-        # the CPU backend (interpret-mode validation rides this).
-        try:
-            return probe_pallas(ls, rs, l_len, r_len)
-        except Exception as e:  # Mosaic lowering/runtime problems
-            record_pallas_failure(e, ls.dtype)
+    from .backend import pallas_maybe_wanted
+
+    # Cheap pre-gate before touching pallas at all: importing
+    # jax.experimental.pallas costs ~1 s on first use, and on the plain CPU
+    # backend the kernel is never wanted — the import would be pure cold-path
+    # tax (measured as the dominant cost of the first 8M indexed count).
+    if pallas_maybe_wanted("HYPERSPACE_PALLAS_PROBE"):
+        from .pallas_probe import (
+            pallas_probe_wanted,
+            probe_pallas,
+            record_pallas_failure,
+        )
+
+        if pallas_probe_wanted(
+            int(ls.shape[1]), int(rs.shape[1]), int(ls.shape[0]), ls.dtype
+        ):
+            # Checked FIRST: HYPERSPACE_PALLAS_PROBE=1 forces the kernel even
+            # on the CPU backend (interpret-mode validation rides this).
+            try:
+                return probe_pallas(ls, rs, l_len, r_len)
+            except Exception as e:  # Mosaic lowering/runtime problems
+                record_pallas_failure(e, ls.dtype)
     if not use_device_path():
         return _probe_host(
             np.asarray(ls), np.asarray(rs), np.asarray(l_len), np.asarray(r_len)
