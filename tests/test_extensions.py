@@ -487,3 +487,66 @@ def test_data_skipping_survives_deleted_file(session, tmp_path):
     assert q().to_pydict()["val"] == [50]
     disable_hyperspace(session)
     assert q().to_pydict()["val"] == [50]
+
+
+class TestPairCacheFreshness:
+    def test_join_count_sees_append_after_cached_pairs(self, session, tmp_path):
+        """The pairs/probe memos key on ROW identity (file inventory incl. the
+        hybrid-append set): a join count cached before a source append must
+        re-key — not serve stale pairs — once the appended file joins the
+        scan (docs/caching.md 'Freshness')."""
+        session.write_parquet(
+            {"k": [1, 2, 3, 4], "v": [10, 20, 30, 40]}, str(tmp_path / "l")
+        )
+        session.write_parquet({"rk": [1, 2, 3, 4, 9]}, str(tmp_path / "r"))
+        hs = Hyperspace(session)
+        hs.create_index(
+            session.read.parquet(str(tmp_path / "l")), IndexConfig("pf_l", ["k"], ["v"])
+        )
+        hs.create_index(
+            session.read.parquet(str(tmp_path / "r")), IndexConfig("pf_r", ["rk"], [])
+        )
+        enable_hyperspace(session)
+        session.conf.set(IndexConstants.INDEX_HYBRID_SCAN_ENABLED, "true")
+
+        def q():
+            l = session.read.parquet(str(tmp_path / "l"))
+            r = session.read.parquet(str(tmp_path / "r"))
+            return l.join(r, col("k") == col("rk")).select("v")
+
+        assert scanned_index_names(q()) == {"pf_l", "pf_r"}
+        # Spy on the probe so the memo's hit/miss behavior is ASSERTED, not
+        # assumed: a regressed cache key would leave the value checks passing
+        # while the memo guards nothing.
+        from hyperspace_tpu.ops import bucket_join as bj
+
+        calls = []
+        real = bj.probe_ranges
+
+        def spy(*a, **k):
+            calls.append(1)
+            return real(*a, **k)
+
+        bj.probe_ranges = spy
+        try:
+            assert q().count() == 4  # caches pairs for the pre-append inventory
+            n_first = len(calls)
+            assert n_first >= 1
+            assert q().count() == 4  # repeat: served through the memo
+            assert len(calls) == n_first
+
+            # Append a row that matches rk=9: the left scan's hybrid inventory
+            # (hence its rows token) changes, so the cached pairs must miss.
+            eio.write_parquet(
+                Table.from_pydict({"k": [9, 9], "v": [90, 91]}),
+                str(tmp_path / "l" / "appended.parquet"),
+            )
+            assert scanned_index_names(q()) == {"pf_l", "pf_r"}
+            assert q().count() == 6
+            assert len(calls) > n_first  # fresh probe: the stale entry missed
+        finally:
+            bj.probe_ranges = real
+        assert sorted(q().to_pydict()["v"]) == [10, 20, 30, 40, 90, 91]
+        # Oracle: non-indexed agrees.
+        disable_hyperspace(session)
+        assert q().count() == 6
