@@ -550,3 +550,41 @@ class TestPairCacheFreshness:
         # Oracle: non-indexed agrees.
         disable_hyperspace(session)
         assert q().count() == 6
+
+    def test_join_count_sees_delete_after_cached_pairs(self, session, tmp_path):
+        """Cross-query DELETION freshness: pairs cached against the intact
+        source must not serve once a recorded file vanishes — the
+        lineage-prune filter enters the plan and re-keys the rows token."""
+        import os as _os
+
+        session.conf.set(IndexConstants.INDEX_LINEAGE_ENABLED, "true")
+        d = tmp_path / "dl"
+        eio.write_parquet(
+            Table.from_pydict({"k": [1, 2], "v": [10, 20]}), str(d / "part-a.parquet")
+        )
+        eio.write_parquet(
+            Table.from_pydict({"k": [3, 4], "v": [30, 40]}), str(d / "part-b.parquet")
+        )
+        session.write_parquet({"rk": [1, 2, 3, 4]}, str(tmp_path / "dr"))
+        hs = Hyperspace(session)
+        hs.create_index(
+            session.read.parquet(str(d)), IndexConfig("dfl", ["k"], ["v"])
+        )
+        hs.create_index(
+            session.read.parquet(str(tmp_path / "dr")), IndexConfig("dfr", ["rk"], [])
+        )
+        enable_hyperspace(session)
+        session.conf.set(IndexConstants.INDEX_HYBRID_SCAN_ENABLED, "true")
+
+        def q():
+            l = session.read.parquet(str(d))
+            r = session.read.parquet(str(tmp_path / "dr"))
+            return l.join(r, col("k") == col("rk")).select("v")
+
+        assert q().count() == 4  # caches pairs for the intact inventory
+        _os.remove(str(d / "part-b.parquet"))  # k=3,4 rows vanish
+        assert scanned_index_names(q()) == {"dfl", "dfr"}
+        assert q().count() == 2
+        assert sorted(q().to_pydict()["v"]) == [10, 20]
+        disable_hyperspace(session)
+        assert q().count() == 2
