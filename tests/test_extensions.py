@@ -555,8 +555,6 @@ class TestPairCacheFreshness:
         """Cross-query DELETION freshness: pairs cached against the intact
         source must not serve once a recorded file vanishes — the
         lineage-prune filter enters the plan and re-keys the rows token."""
-        import os as _os
-
         session.conf.set(IndexConstants.INDEX_LINEAGE_ENABLED, "true")
         d = tmp_path / "dl"
         eio.write_parquet(
@@ -582,7 +580,7 @@ class TestPairCacheFreshness:
             return l.join(r, col("k") == col("rk")).select("v")
 
         assert q().count() == 4  # caches pairs for the intact inventory
-        _os.remove(str(d / "part-b.parquet"))  # k=3,4 rows vanish
+        os.remove(str(d / "part-b.parquet"))  # k=3,4 rows vanish
         assert scanned_index_names(q()) == {"dfl", "dfr"}
         assert q().count() == 2
         assert sorted(q().to_pydict()["v"]) == [10, 20]

@@ -13,12 +13,34 @@ import numpy as np
 import pytest
 
 from hyperspace_tpu import IndexConfig, IndexConstants
+from hyperspace_tpu.exceptions import HyperspaceException
 from hyperspace_tpu.engine import HyperspaceSession, col
 from hyperspace_tpu.hyperspace import (
     Hyperspace,
     disable_hyperspace,
     enable_hyperspace,
 )
+
+
+from contextlib import contextmanager
+
+
+@contextmanager
+def _random_device_ops(rng):
+    """Coin-flip HYPERSPACE_FORCE_DEVICE_OPS for one test body, restoring the
+    CI matrix's value afterwards — one implementation for every fuzz test."""
+    saved = os.environ.get("HYPERSPACE_FORCE_DEVICE_OPS")
+    if rng.rand() < 0.5:
+        os.environ["HYPERSPACE_FORCE_DEVICE_OPS"] = "1"
+    else:
+        os.environ.pop("HYPERSPACE_FORCE_DEVICE_OPS", None)
+    try:
+        yield
+    finally:
+        if saved is None:
+            os.environ.pop("HYPERSPACE_FORCE_DEVICE_OPS", None)
+        else:
+            os.environ["HYPERSPACE_FORCE_DEVICE_OPS"] = saved
 
 
 def _random_table(rng, n, key_kind):
@@ -57,12 +79,7 @@ def test_random_join_agg_differential(tmp_path, seed):
     s = HyperspaceSession(warehouse=str(tmp_path))
     s.conf.set(IndexConstants.INDEX_SYSTEM_PATH, str(tmp_path / "indexes"))
     s.conf.set(IndexConstants.INDEX_NUM_BUCKETS, int(rng.choice([4, 8, 16])))
-    saved = os.environ.get("HYPERSPACE_FORCE_DEVICE_OPS")  # CI matrix sets it
-    if rng.rand() < 0.5:
-        os.environ["HYPERSPACE_FORCE_DEVICE_OPS"] = "1"
-    else:
-        os.environ.pop("HYPERSPACE_FORCE_DEVICE_OPS", None)
-    try:
+    with _random_device_ops(rng):
         hs = Hyperspace(s)
         key_kind = ["int", "float", "str"][seed % 3]
         n_l, n_r = int(rng.randint(500, 4000)), int(rng.randint(50, 800))
@@ -114,8 +131,107 @@ def test_random_join_agg_differential(tmp_path, seed):
         assert q_join().count() == count_oracle
         assert q_join().sorted_rows() == join_oracle
         _rows_close(q_agg().collect().sorted_rows(), agg_oracle)
-    finally:
-        if saved is None:
-            os.environ.pop("HYPERSPACE_FORCE_DEVICE_OPS", None)
-        else:
-            os.environ["HYPERSPACE_FORCE_DEVICE_OPS"] = saved
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_random_mutation_sequence_differential(tmp_path, seed):
+    """Random interleavings of source mutations (append / delete / refresh /
+    optimize) and queries (count / rows / aggregate), each query checked
+    against the non-indexed oracle. This is the adversarial workload for the
+    row-identity memo hierarchy (docs/caching.md): every mutation must re-key
+    the probe/pair caches, every query must still be exact."""
+    from hyperspace_tpu.engine import io as eio
+    from hyperspace_tpu.engine.table import Table
+
+    rng = np.random.RandomState(2000 + seed)
+    s = HyperspaceSession(warehouse=str(tmp_path))
+    s.conf.set(IndexConstants.INDEX_SYSTEM_PATH, str(tmp_path / "indexes"))
+    s.conf.set(IndexConstants.INDEX_NUM_BUCKETS, int(rng.choice([4, 8])))
+    s.conf.set(IndexConstants.INDEX_LINEAGE_ENABLED, "true")
+    s.conf.set(IndexConstants.INDEX_HYBRID_SCAN_ENABLED, "true")
+    with _random_device_ops(rng):
+        hs = Hyperspace(s)
+        d = tmp_path / "ml"
+
+        def mk_rows(n):
+            return {
+                "k": rng.randint(0, 40, n).astype(np.int64),
+                "v": rng.randint(-100, 100, n).astype(np.int64),
+                "x": rng.rand(n) * 10,
+            }
+
+        n_files = 0
+
+        def write_file(tag):
+            nonlocal n_files
+            eio.write_parquet(
+                Table.from_pydict(mk_rows(int(rng.randint(20, 200)))),
+                str(d / f"part-{tag}-{n_files:03d}.parquet"),
+            )
+            n_files += 1
+
+        write_file("base")
+        write_file("base")
+        s.write_parquet(
+            {"rk": np.arange(40, dtype=np.int64),
+             "w": rng.randint(0, 9, 40).astype(np.int64)},
+            str(tmp_path / "mr"),
+        )
+        hs.create_index(
+            s.read.parquet(str(d)), IndexConfig(f"ml{seed}", ["k"], ["v", "x"])
+        )
+        hs.create_index(
+            s.read.parquet(str(tmp_path / "mr")), IndexConfig(f"mr{seed}", ["rk"], ["w"])
+        )
+        enable_hyperspace(s)
+
+        def q_join():
+            l = s.read.parquet(str(d))
+            r = s.read.parquet(str(tmp_path / "mr"))
+            return l.join(r, col("k") == col("rk")).select("v", "w")
+
+        def q_agg():
+            l = s.read.parquet(str(d))
+            r = s.read.parquet(str(tmp_path / "mr"))
+            return (
+                l.join(r, col("k") == col("rk"))
+                .with_column("y", col("x") + col("w"))
+                .group_by("w")
+                .agg(t=("y", "sum"), c=("v", "count"))
+                .order_by(("w", True))
+            )
+
+        def check():
+            enable_hyperspace(s)
+            got_count = q_join().count()
+            got_rows = q_join().sorted_rows()
+            got_agg = q_agg().collect().sorted_rows()
+            disable_hyperspace(s)
+            assert got_count == q_join().count()
+            assert got_rows == q_join().sorted_rows()
+            _rows_close(got_agg, q_agg().collect().sorted_rows())
+            enable_hyperspace(s)
+
+        check()
+        for step in range(8):
+            op = rng.choice(["append", "delete", "refresh", "optimize", "query"])
+            if op == "append":
+                write_file("app")
+            elif op == "delete":
+                files = sorted(p for p in os.listdir(str(d)) if p.endswith(".parquet"))
+                if len(files) > 1:  # never drop the last file of the dir
+                    os.remove(str(d / files[int(rng.randint(len(files)))]))
+            elif op == "refresh":
+                mode = str(rng.choice(["full", "incremental"]))
+                try:
+                    hs.refresh_index(f"ml{seed}", mode=mode)
+                except HyperspaceException:
+                    if mode == "full":
+                        raise  # full refresh has no legal refusal here
+                    # incremental refusing deletes/modifications is legal
+            elif op == "optimize":
+                try:
+                    hs.optimize_index(f"ml{seed}")
+                except HyperspaceException:
+                    pass  # nothing compactable — a legal refusal
+            check()
