@@ -1785,36 +1785,34 @@ class SortMergeJoinExec(PhysicalNode):
         right, r_starts = self.right.execute_concat(ctx)
         if left.num_rows == 0 or right.num_rows == 0:
             return left, right, np.empty(0, np.int64), np.empty(0, np.int64)
-        pairs = None
-        mesh = (
-            ctx.session.mesh_for(left.num_rows + right.num_rows)
-            if ctx.session is not None
-            else None
-        )
-        if mesh is not None:
-            # Sharded probe: each device joins its own bucket range with zero
-            # collectives (non-divisible bucket counts are padded with empty
-            # virtual buckets inside). The block layouts are cached per table
-            # identity, so steady-state queries skip the host→device key upload
-            # and start at the probe.
-            from ..parallel.table_ops import probe_dist_blocks
+        # The VERIFIED pair arrays are cached per row identity — pairs are a
+        # pure function of the two row sets and the keys, INDEPENDENT of the
+        # execution strategy (mesh-sharded or single-device), so one memo
+        # covers both: a steady-state query that needs the joined rows
+        # (counts, aggregates, collects) skips probe + expansion +
+        # verification entirely (~1 s of the 8M CPU Q3 aggregate). The padded
+        # reps / block layouts underneath stay cached for the cold paths.
+        subkey = _pair_subkey(self.left_keys, self.right_keys, left, right)
+        rows_key = _pair_rows_key(self.left, self.right, ctx)
 
-            l_blocks = _dist_blocks(left, l_starts, self.left_keys, mesh)
-            r_blocks = _dist_blocks(right, r_starts, self.right_keys, mesh)
-            if l_blocks is not None and r_blocks is not None:
-                pairs = probe_dist_blocks(mesh, l_blocks, r_blocks)
-        if pairs is None:
-            # Single-device: the VERIFIED pair arrays are cached per
-            # (left, right) table identity — fully determined by the two
-            # tables and the keys, so a steady-state query that needs the
-            # joined rows (aggregates, collects) skips probe + expansion +
-            # verification entirely (~1 s of the 8M CPU Q3 aggregate). The
-            # padded reps underneath stay cached for the count-only and
-            # cold paths.
-            subkey = _pair_subkey(self.left_keys, self.right_keys, left, right)
-            rows_key = _pair_rows_key(self.left, self.right, ctx)
+        def compute():
+            pairs = None
+            mesh = (
+                ctx.session.mesh_for(left.num_rows + right.num_rows)
+                if ctx.session is not None
+                else None
+            )
+            if mesh is not None:
+                # Sharded probe: each device joins its own bucket range with
+                # zero collectives (non-divisible bucket counts are padded
+                # with empty virtual buckets inside).
+                from ..parallel.table_ops import probe_dist_blocks
 
-            def compute():
+                l_blocks = _dist_blocks(left, l_starts, self.left_keys, mesh)
+                r_blocks = _dist_blocks(right, r_starts, self.right_keys, mesh)
+                if l_blocks is not None and r_blocks is not None:
+                    pairs = probe_dist_blocks(mesh, l_blocks, r_blocks)
+            if pairs is None:
                 l_rep, r_rep = self._reconciled_reps(
                     left, right, l_starts, r_starts
                 )
@@ -1823,17 +1821,13 @@ class SortMergeJoinExec(PhysicalNode):
                 ranges = _probe_ranges_cached(
                     l_rep, r_rep, left, right, subkey, rows_key
                 )
-                p = probe_padded(l_rep, r_rep, ranges=ranges)
-                return _verify_pairs(
-                    left, right, self.left_keys, self.right_keys, p[0], p[1]
-                )
-
-            li, ri = _cached_two_table(
-                "pairs", left, right, subkey, compute, rows_key=rows_key
+                pairs = probe_padded(l_rep, r_rep, ranges=ranges)
+            return _verify_pairs(
+                left, right, self.left_keys, self.right_keys, pairs[0], pairs[1]
             )
-            return left, right, li, ri
-        li, ri = _verify_pairs(
-            left, right, self.left_keys, self.right_keys, pairs[0], pairs[1]
+
+        li, ri = _cached_two_table(
+            "pairs", left, right, subkey, compute, rows_key=rows_key
         )
         return left, right, li, ri
 
@@ -1868,16 +1862,10 @@ class SortMergeJoinExec(PhysicalNode):
         right, r_starts = self.right.execute_concat(ctx)
         if left.num_rows == 0 or right.num_rows == 0:
             return 0
-        mesh = (
-            ctx.session.mesh_for(left.num_rows + right.num_rows)
-            if ctx.session is not None
-            else None
-        )
-        if mesh is not None:
-            return None  # the sharded probe owns mesh-scale execution
-        # Cross-query reuse: an aggregate/collect over these same ROWS (any
-        # column pruning) has already computed and cached the verified pairs
-        # — the count is free.
+        # Cross-query reuse FIRST (even under a mesh): an aggregate/collect
+        # over these same ROWS (any column pruning, any execution strategy)
+        # has already computed and cached the verified pairs — the count is
+        # free.
         subkey = _pair_subkey(self.left_keys, self.right_keys, left, right)
         rows_key = _pair_rows_key(self.left, self.right, ctx)
         hit, val = _peek_two_table("pairs", left, right, subkey, rows_key)
@@ -1886,6 +1874,13 @@ class SortMergeJoinExec(PhysicalNode):
         hit, val = _peek_two_table("pairs", left, right, ("dev",) + subkey, rows_key)
         if hit:
             return 0 if val is None else int(val[2])
+        mesh = (
+            ctx.session.mesh_for(left.num_rows + right.num_rows)
+            if ctx.session is not None
+            else None
+        )
+        if mesh is not None:
+            return None  # the sharded probe owns mesh-scale execution
         l_rep, r_rep = self._reconciled_reps(left, right, l_starts, r_starts)
         if l_rep.mode != "value" and not use_device_path():
             # Hash-mode counts on the CPU backend take the host expansion path;
