@@ -27,28 +27,15 @@ def stable_argsort_host(x) -> np.ndarray:
     return np.argsort(np.asarray(x), kind="stable")
 
 
-def stable_argsort(x: jnp.ndarray) -> jnp.ndarray:
-    """Backend-adaptive stable argsort — the ONE home of the trade ops.partition
-    documents: XLA's CPU sort is ~3x slower than numpy's, so the CPU backend
-    sorts on host (`stable_argsort_host`); the device argsort is the TPU path
-    (jnp.argsort is stable by default). Applied to the NON-indexed baseline
-    path too, so the bench's indexed-vs-scan speedup compares two equally-tuned
-    implementations. `HYPERSPACE_FORCE_DEVICE_OPS=1` forces the device path
-    (ops.backend)."""
-    from .backend import use_device_path
-
-    if not use_device_path():
-        return jnp.asarray(stable_argsort_host(x))
-    return jnp.argsort(x)
-
-
-def _range_probe_body(l_key64, r_key64, l_order, r_order):
+def _range_probe_body(l_key64, r_key64, l_order, r_order, xp=jnp):
     """Range probe of sorted views — the ONE home of the lo/hi/count
-    semantics, used traced (fused device program) and eagerly (CPU path)."""
+    semantics, used traced in the fused device program (xp=jnp) and on HOST
+    arrays by the CPU branch of `merge_join_pairs` (xp=np; eager jnp ops
+    there are per-operator XLA-CPU dispatches)."""
     ls = l_key64[l_order]
     rs = r_key64[r_order]
-    lo = jnp.searchsorted(rs, ls, side="left")
-    hi = jnp.searchsorted(rs, ls, side="right")
+    lo = xp.searchsorted(rs, ls, side="left")
+    hi = xp.searchsorted(rs, ls, side="right")
     return lo, hi - lo
 
 
@@ -77,21 +64,31 @@ def merge_join_pairs(l_key64, r_key64) -> Tuple[np.ndarray, np.ndarray]:
     if use_device_path():
         l_order, r_order, lo, counts, total_dev = _merge_phase_a(l_key64, r_key64)
         total = int(total_dev)  # the one scalar sync (dynamic output size)
-    else:
-        l_order = stable_argsort(l_key64)  # host argsort beats XLA-CPU's sort
-        r_order = stable_argsort(r_key64)
-        lo, counts = _range_probe_body(l_key64, r_key64, l_order, r_order)
-        total = int(counts.sum())
+        if total == 0:
+            return np.empty(0, np.int64), np.empty(0, np.int64)
+        starts = jnp.cumsum(counts) - counts  # exclusive prefix sum
+        l_pos = jnp.repeat(
+            jnp.arange(l_key64.shape[0]), counts, total_repeat_length=total
+        )
+        offset = jnp.arange(total) - starts[l_pos]
+        r_pos = lo[l_pos] + offset
+        return np.asarray(l_order[l_pos]), np.asarray(r_order[r_pos])
+    # CPU backend: the WHOLE merge stays on host — eager jnp sorts/probes/
+    # expansions here are per-op XLA-CPU dispatches (the sort ~3x slower than
+    # numpy, the expansion a chain of eager gathers). Same probe body as the
+    # device program (xp=np), same host sort as every other host path.
+    lk, rk = np.asarray(l_key64), np.asarray(r_key64)
+    l_order = stable_argsort_host(lk)
+    r_order = stable_argsort_host(rk)
+    lo, counts = _range_probe_body(lk, rk, l_order, r_order, xp=np)
+    total = int(counts.sum())
     if total == 0:
         return np.empty(0, np.int64), np.empty(0, np.int64)
-
-    starts = jnp.cumsum(counts) - counts  # exclusive prefix sum
-    l_pos = jnp.repeat(
-        jnp.arange(l_key64.shape[0]), counts, total_repeat_length=total
-    )
-    offset = jnp.arange(total) - starts[l_pos]
+    starts = np.cumsum(counts) - counts
+    l_pos = np.repeat(np.arange(lk.shape[0]), counts)
+    offset = np.arange(total) - starts[l_pos]
     r_pos = lo[l_pos] + offset
-    return np.asarray(l_order[l_pos]), np.asarray(r_order[r_pos])
+    return l_order[l_pos], r_order[r_pos]
 
 
 def nonzero_indices(mask) -> np.ndarray:
