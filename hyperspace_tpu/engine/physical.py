@@ -1607,27 +1607,41 @@ def _verified_match_counts_jit(lanes: tuple, lcap: int, rcap: int, li, ri, valid
     )
 
 
-@_jax.jit
-def _value_inner_count_jit(lv, rv):
+def _value_inner_count_body(lv, rv, xp=jnp):
     """Inner-join count over a single null-free numeric key pair, on ACTUAL
     values: sort one side, range-probe the other, sum — no candidate
     expansion and no verification pass (value equality IS the join
     condition; the promotion matches `_verify_pairs`' numpy-promoted
     equality). NaN probes count zero (NaN == NaN is false in SQL and in the
-    verify path); right-side NaNs sort past every real probe value."""
+    verify path); right-side NaNs sort past every real probe value. The ONE
+    home of these semantics: traced in `_value_inner_count_jit` (device) and
+    run on host arrays by the CPU scan-count path (xp=np)."""
     # NUMPY's promotion lattice, not JAX's: _verify_pairs (the oracle this
     # must match) compares via numpy, where int64 x float32 -> float64; JAX
     # would give float32 and a 2^24-magnitude int key could falsely match.
     common = np.promote_types(np.dtype(lv.dtype), np.dtype(rv.dtype))
     lv = lv.astype(common)
     rv = rv.astype(common)
-    r_sorted = jnp.sort(rv)
-    lo = jnp.searchsorted(r_sorted, lv, side="left")
-    hi = jnp.searchsorted(r_sorted, lv, side="right")
+    # HOST probes get sorted first: the count is order-invariant, and
+    # binary-searching with sorted probes turns the haystack accesses
+    # sequential — unsorted 8M probes into a 1M haystack measured 7.4 s on
+    # host (a cache miss per search step) vs ~0.7 s with the probe sort
+    # included. The device program keeps unsorted probes (its vectorized
+    # searchsorted was the measured round-5 baseline; the sort would be pure
+    # added work there).
+    probes = xp.sort(lv) if xp is np else lv
+    r_sorted = xp.sort(rv)
+    lo = xp.searchsorted(r_sorted, probes, side="left")
+    hi = xp.searchsorted(r_sorted, probes, side="right")
     counts = hi - lo
-    if jnp.issubdtype(common, jnp.floating):
-        counts = jnp.where(jnp.isnan(lv), 0, counts)
-    return counts.sum(dtype=jnp.int64)
+    if np.issubdtype(common, np.floating):
+        counts = xp.where(xp.isnan(probes), 0, counts)
+    return counts.sum(dtype=np.int64)
+
+
+@_jax.jit
+def _value_inner_count_jit(lv, rv):
+    return _value_inner_count_body(lv, rv)
 
 
 def _count_from_match_stats(
@@ -1919,15 +1933,16 @@ class SortMergeJoinExec(PhysicalNode):
         reuse the bucketed machinery as its one-bucket special case. On the
         relay the old path pulled ~16 bytes per candidate pair to the host —
         this keeps the NON-indexed baseline count on-device too, so the bench
-        compares two equally-tuned paths. `pre` carries the already-executed
-        children (shared with the `_compute_pairs` fallback). None when not
-        applicable (CPU backend, mesh execution)."""
+        compares two equally-tuned paths — the value-direct branch has a host
+        twin for the CPU backend under the same principle. `pre` carries the
+        already-executed children (shared with the `_compute_pairs`
+        fallback). None when not applicable (hash mode on the CPU backend,
+        mesh execution)."""
         from ..ops.backend import use_device_path
         from ..ops.bucket_join import _cap_pow2, _expand_pairs_dev
         from ..ops.join import _merge_phase_a
 
-        if not use_device_path():
-            return None
+        device = use_device_path()
         _lex, _rex, lt, rt = pre
         how = self.how
         if lt.num_rows == 0 or rt.num_rows == 0:
@@ -1940,7 +1955,8 @@ class SortMergeJoinExec(PhysicalNode):
             return None  # the distributed exchange path owns mesh-scale counts
         if how == "inner" and len(self.left_keys) == 1:
             # Value-direct: a single null-free numeric key needs no hashing,
-            # no candidate expansion, and no verification — one program.
+            # no candidate expansion, and no verification — one program
+            # (device) or one sort+probe (host).
             lc = lt.column(self.left_keys[0])
             rc = rt.column(self.right_keys[0])
             if (
@@ -1951,11 +1967,15 @@ class SortMergeJoinExec(PhysicalNode):
                 and lc.data.dtype != np.bool_
                 and rc.data.dtype != np.bool_
             ):
-                return int(
-                    _value_inner_count_jit(
-                        device_array(lc.data), device_array(rc.data)
+                if device:
+                    return int(
+                        _value_inner_count_jit(
+                            device_array(lc.data), device_array(rc.data)
+                        )
                     )
-                )
+                return int(_value_inner_count_body(lc.data, rc.data, xp=np))
+        if not device:
+            return None  # hash-mode counts on CPU ride the host pairs path
         l_flags, r_flags = _joint_float_flags(lt, rt, self.left_keys, self.right_keys)
         lk = _table_key64(lt, self.left_keys, l_flags)
         rk = _table_key64(rt, self.right_keys, r_flags)
