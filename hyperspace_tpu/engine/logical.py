@@ -116,12 +116,36 @@ class LogicalPlan:
         return all(c.is_linear() for c in kids)
 
 
+def internal_column(name: str) -> bool:
+    """The reserved index-internal column names (currently the lineage column
+    `_data_file_name`): hidden from logical schemas, physically read only on
+    explicit request (the delete-prune filter), stripped once consumed. The
+    ONE home of the rule — logical hiding, scan defaults, hybrid merges and
+    the filter strip all route through it."""
+    from ..config import IndexConstants
+
+    return name.lower() == IndexConstants.DATA_FILE_NAME_COLUMN
+
+
 class ScanNode(LogicalPlan):
     def __init__(self, relation: SourceRelation):
         self.relation = relation
 
     @property
     def output_schema(self) -> Schema:
+        if self.relation.index_name:
+            # An INDEX relation's lineage column is internal bookkeeping
+            # (`_data_file_name` — reference `IndexConstants.scala:54-56`):
+            # rewrites must be output-schema-preserving, so the logical
+            # schema hides it. The physical layer still reads it when the
+            # delete-tolerance prune filter asks (its condition references
+            # the column) and strips it once the filter has evaluated.
+            fields = [
+                f for f in self.relation.schema.fields
+                if not internal_column(f.name)
+            ]
+            if len(fields) != len(self.relation.schema.fields):
+                return Schema(fields)
         return self.relation.schema
 
     def with_children(self, children):

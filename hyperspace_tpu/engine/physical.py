@@ -120,6 +120,22 @@ class PhysicalNode:
         return out
 
 
+def _default_scan_columns(relation: SourceRelation, columns):
+    """Effective column list when `columns` is None ("everything"): for an
+    INDEX relation, "everything" means the VISIBLE schema — the internal
+    lineage column is read only when explicitly requested (the planner pushes
+    it for a delete-prune filter's condition; the logical `ScanNode`
+    output_schema hides it from every other consumer). None = no lineage in
+    the schema: keep the plain read-all path."""
+    if columns is not None or not relation.index_name:
+        return columns
+    from .logical import internal_column
+
+    names = relation.schema.names
+    visible = [n for n in names if not internal_column(n)]
+    return visible if len(visible) != len(names) else None
+
+
 class ScanExec(PhysicalNode):
     name = "Scan"
 
@@ -132,10 +148,11 @@ class ScanExec(PhysicalNode):
             # Demoted bucketed index scan (general join path / plain read): still must
             # merge the hybrid-appended rows.
             return BucketedIndexScanExec(self.relation, self.columns).execute(ctx)
+        cols = _default_scan_columns(self.relation, self.columns)
         files = [f.path for f in self.relation.files]
         if not files:
             # Every file pruned (data skipping) or an empty source: empty table.
-            names = self.columns or self.relation.schema.names
+            names = cols or self.relation.schema.names
             return Table(
                 {n: _empty_column(self.relation.schema.field(n).dtype) for n in names}
             )
@@ -143,7 +160,7 @@ class ScanExec(PhysicalNode):
         if self.relation.partition_spec is not None:
             partitions = (self.relation.partition_spec, self.relation.root_paths)
         return engine_io.read_files(
-            files, self.relation.file_format, self.columns, partitions=partitions
+            files, self.relation.file_format, cols, partitions=partitions
         )
 
     def execute_count(self, ctx) -> int:
@@ -177,12 +194,13 @@ class BucketedIndexScanExec(PhysicalNode):
     def execute_buckets(self, ctx) -> List[Optional[Table]]:
         spec = self.relation.bucket_spec
         buckets: List[Optional[Table]] = [None] * spec.num_buckets
+        cols = _default_scan_columns(self.relation, self.columns)
         for f in self.relation.files:
             m = _BUCKET_FILE_RE.search(os.path.basename(f.path))
             if m is None:
                 raise HyperspaceException(f"Not a bucketed index file: {f.path}")
             b = int(m.group(1))
-            t = engine_io.read_files([f.path], self.relation.file_format, self.columns)
+            t = engine_io.read_files([f.path], self.relation.file_format, cols)
             buckets[b] = t if buckets[b] is None else Table.concat([buckets[b], t])
         if self.relation.hybrid_append is not None:
             self._merge_appended(buckets)
@@ -196,11 +214,15 @@ class BucketedIndexScanExec(PhysicalNode):
         from ..config import IndexConstants
         from ..ops.partition import bucketize_table
 
+        from .logical import internal_column
+
         ha = self.relation.hybrid_append
         spec = self.relation.bucket_spec
-        wanted = self.columns or self.relation.schema.names
-        lineage_col = IndexConstants.DATA_FILE_NAME_COLUMN
-        source_cols = [c for c in wanted if c.lower() != lineage_col]
+        wanted = (
+            _default_scan_columns(self.relation, self.columns)
+            or self.relation.schema.names
+        )
+        source_cols = [c for c in wanted if not internal_column(c)]
         partitions = None
         if ha.partition_spec is not None:
             partitions = (ha.partition_spec, ha.root_paths)
@@ -209,7 +231,9 @@ class BucketedIndexScanExec(PhysicalNode):
             t = engine_io.read_files(
                 [f.path], ha.file_format, source_cols, partitions=partitions
             )
-            if any(c.lower() == lineage_col for c in wanted):
+            internal = [c for c in wanted if internal_column(c)]
+            if internal:
+                lineage_col = internal[0]  # the scan's requested spelling
                 cols = dict(t.columns)
                 cols[lineage_col] = Table.from_pydict(
                     {lineage_col: [f.path] * t.num_rows}
@@ -230,7 +254,10 @@ class BucketedIndexScanExec(PhysicalNode):
 
     def empty_table(self) -> Table:
         """Empty table with this scan's (pruned) schema."""
-        names = self.columns or self.relation.schema.names
+        names = (
+            _default_scan_columns(self.relation, self.columns)
+            or self.relation.schema.names
+        )
         return Table(
             {n: _empty_column(self.relation.schema.field(n).dtype) for n in names}
         )
@@ -324,9 +351,28 @@ class FilterExec(PhysicalNode):
     def execute(self, ctx) -> Table:
         t = self.child.execute(ctx)
         if t.num_rows == 0:
-            return t
+            return self._strip_internal(t)
         mask = evaluate_predicate(self.condition, t)
-        return t.take(nonzero_indices(mask))
+        return self._strip_internal(t.take(nonzero_indices(mask)))
+
+    def _strip_internal(self, t: Table) -> Table:
+        """Drop an index scan's internal lineage column once this filter —
+        the delete-prune wrapper, the column's ONLY legitimate consumer —
+        has evaluated: the logical schema hides the column, so nothing
+        above may see it (whole-table operators like Union would otherwise
+        diverge from their logical schema check)."""
+        rel = getattr(self.child, "relation", None)
+        if rel is None or not rel.index_name:
+            return t
+        from .logical import internal_column
+
+        refs = {r.lower() for r in self.condition.references()}
+        drop = [
+            c for c in t.column_names if internal_column(c) and c.lower() in refs
+        ]
+        if not drop:
+            return t
+        return t.select([c for c in t.column_names if c not in drop])
 
     def execute_concat(self, ctx) -> Tuple[Table, np.ndarray]:
         """Filtered bucketed scan, with bucket structure PRESERVED: a filter
@@ -362,6 +408,7 @@ class FilterExec(PhysicalNode):
             new_starts = np.searchsorted(keep, np.asarray(starts))
             table = table.take(keep)
             starts = new_starts
+        table = self._strip_internal(table)
         if key is not None:
             global_filtered_cache().put(key, table, starts)
         return table, starts
@@ -2160,8 +2207,18 @@ def plan_physical(
 
     if isinstance(logical, FilterNode):
         child_required = None
+        refs = sorted(logical.condition.references())
         if required is not None:
-            child_required = list(dict.fromkeys(list(required) + sorted(logical.condition.references())))
+            child_required = list(dict.fromkeys(list(required) + refs))
+        else:
+            # "Everything" excludes a scan's HIDDEN columns (the index lineage
+            # column): a condition referencing one (the delete-prune filter)
+            # must request it explicitly alongside the visible schema.
+            visible = {n.lower() for n in logical.child.output_schema.names}
+            if any(r.lower() not in visible for r in refs):
+                child_required = list(
+                    dict.fromkeys(list(logical.child.output_schema.names) + refs)
+                )
         return FilterExec(logical.condition, plan_physical(logical.child, child_required, case_sensitive))
 
     if isinstance(logical, ProjectNode):
@@ -2254,7 +2311,10 @@ def plan_physical(
         # join keys, listing bucket columns in the same order under the L→R
         # key mapping, with equal bucket counts → no exchange needed. (This
         # is the planner-side re-check of the join rule's compatibility
-        # condition; the rule only rewrites inner joins, but guard anyway.)
+        # condition.) ALL join types ride it: the bucketed probe yields the
+        # verified inner pairs, from which _assemble_join / the match-stats
+        # count derive outer/semi/anti results exactly as the general path
+        # does.
         def _as_bucketed(phys: PhysicalNode) -> Optional[BucketedIndexScanExec]:
             if isinstance(phys, BucketedIndexScanExec):
                 return phys
@@ -2266,7 +2326,7 @@ def plan_physical(
 
         lbucket = _as_bucketed(lphys)
         rbucket = _as_bucketed(rphys)
-        if how == "inner" and lbucket is not None and rbucket is not None:
+        if lbucket is not None and rbucket is not None:
             lspec = lbucket.relation.bucket_spec
             rspec = rbucket.relation.bucket_spec
             # A left key equated to two different right keys (l.a==r.x AND l.a==r.y)
@@ -2310,7 +2370,9 @@ def plan_physical(
                     for a, b in zip(jl, jr)
                 )
                 if kinds_ok:
-                    return SortMergeJoinExec(lphys, rphys, jl, jr, bucketed=True)
+                    return SortMergeJoinExec(
+                        lphys, rphys, jl, jr, bucketed=True, how=how
+                    )
 
         # General path: exchange + sort both sides.
         if isinstance(lphys, BucketedIndexScanExec):

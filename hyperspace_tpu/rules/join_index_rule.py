@@ -164,7 +164,12 @@ class JoinIndexRule:
             cs = session.hs_conf.case_sensitive
 
             def rewrite(node: LogicalPlan) -> LogicalPlan:
-                if not isinstance(node, JoinNode) or node.how != "inner":
+                # ANY join type with an equi-condition, like the reference's
+                # wildcard matcher (`JoinIndexRule.scala:60` `Join(l, r, _,
+                # Some(condition))`): the rewrite only swaps base relations
+                # for covering index scans, which is row-set-preserving and
+                # therefore sound for outer/semi/anti exactly as for inner.
+                if not isinstance(node, JoinNode):
                     return node
                 pairs = extract_equi_join_keys(node.condition)
                 if not pairs:
