@@ -377,6 +377,8 @@ def run_bench(deadline: float = None) -> dict:
 
         # -- index builds ---------------------------------------------------
         def builds():
+            from hyperspace_tpu.telemetry.profiling import build_stages_history
+
             t0 = _now()
             hs.create_index(
                 s.read.parquet(os.path.join(base, "lineitem")),
@@ -387,6 +389,9 @@ def run_bench(deadline: float = None) -> dict:
                 IndexConfig("ordIdx", ["o_orderkey"], ["o_custkey"]),
             )
             d["build_s"] = round(_now() - t0, 3)
+            # Stage-level decode/h2d/sort/write timings + overlap ratio of the
+            # two builds above (the headline metric's builds), newest last.
+            d["build_stages"] = build_stages_history()[-2:]
             ph.checkpoint()
             t0 = _now()
             hs.create_index(
@@ -398,6 +403,7 @@ def run_bench(deadline: float = None) -> dict:
                 IndexConfig("partIdx", ["p_partkey"], ["p_type"]),
             )
             d["build_q14_s"] = round(_now() - t0, 3)
+            d["build_q14_stages"] = build_stages_history()[-2:]
 
         # -- indexed queries (join headline, then the aggregates) -----------
         def indexed_join():

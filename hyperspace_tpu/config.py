@@ -63,6 +63,14 @@ class IndexConstants:
     RESOLUTION_CASE_SENSITIVE = "hyperspace.resolution.caseSensitive"
     RESOLUTION_CASE_SENSITIVE_DEFAULT = False
 
+    # Point-lookup bucket pruning for the filter-index rewrite (north-star
+    # extension; the reference always scanned every index file,
+    # `FilterIndexRule.scala:100-132`). An equality/IN filter on the head
+    # indexed column can only match rows in the literals' hash buckets, so the
+    # substituted scan reads just those `part-<bucket>` files.
+    INDEX_FILTER_BUCKET_PRUNING = "hyperspace.index.filter.bucketPruning"
+    INDEX_FILTER_BUCKET_PRUNING_DEFAULT = True
+
     # Data-skipping extension (north-star; absent from the v0 reference snapshot).
     DATASKIPPING_TARGET_INDEX_DATA_FILE_SIZE = "hyperspace.index.dataskipping.targetIndexDataFileSize"
 
@@ -171,6 +179,13 @@ class HyperspaceConf:
         return self._c.get_bool(
             IndexConstants.RESOLUTION_CASE_SENSITIVE,
             IndexConstants.RESOLUTION_CASE_SENSITIVE_DEFAULT,
+        )
+
+    @property
+    def filter_bucket_pruning(self) -> bool:
+        return self._c.get_bool(
+            IndexConstants.INDEX_FILTER_BUCKET_PRUNING,
+            IndexConstants.INDEX_FILTER_BUCKET_PRUNING_DEFAULT,
         )
 
     @property

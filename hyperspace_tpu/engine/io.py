@@ -8,7 +8,7 @@ file-based signature provider fingerprints.
 from __future__ import annotations
 
 import os
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 import pyarrow as pa
@@ -133,6 +133,116 @@ def _read_json_lines(path: str) -> pa.Table:
     return pa.table({n: pa.array([r[n] for r in rows]) for n in names})
 
 
+def file_columns_for(columns: Optional[List[str]], partitions) -> Optional[List[str]]:
+    """The column subset to request FROM THE FILE for a wanted projection:
+    partition columns are path facts, not file content, so they are stripped
+    here and re-appended per file by `append_partition_columns`."""
+    if partitions is None:
+        return columns
+    spec, _roots = partitions
+    pset = {c.lower() for c in spec.columns}
+    if columns is None:
+        return None
+    file_columns = [c for c in columns if c.lower() not in pset]
+    if not file_columns:
+        # Only partition columns requested: still need row counts, so read
+        # the file's own columns and drop them in the select below.
+        return None
+    return file_columns
+
+
+def file_table(path: str, file_format: str, file_columns: Optional[List[str]]) -> Table:
+    """Decoded table of ONE data file through the per-file scan cache — the
+    shared decode primitive of `read_files` and the pipelined index build.
+
+    The cache stores columns, not column tuples, so a warm file decodes ONLY
+    the columns no earlier projection touched (e.g. an index build over
+    (a, b, c) after a query that scanned (a, b) decodes just c)."""
+    from .scan_cache import global_scan_cache
+
+    t = global_scan_cache().get(path, file_columns)
+    if t is not None:
+        return t
+    return _decode_into_cache(path, file_format, file_columns)
+
+
+def _decode_into_cache(
+    path: str, file_format: str, file_columns: Optional[List[str]]
+) -> Table:
+    """The miss half of `file_table`: decode only the cold columns when the
+    cache can tell which those are, else the full projection. The caller has
+    already counted the miss (no double accounting)."""
+    from .scan_cache import global_scan_cache
+
+    cache = global_scan_cache()
+    missing = cache.missing_columns(path, file_columns)
+    if missing and missing != list(file_columns or []):
+        cache.put(path, missing, _read_one(path, file_format, missing))
+        t = cache.get(path, file_columns, record=False)
+        if t is not None:
+            return t  # assembled: warm columns + the freshly decoded rest
+    t = _read_one(path, file_format, file_columns)
+    cache.put(path, file_columns, t)
+    return t
+
+
+def decorate_file_table(
+    t: Table,
+    path: str,
+    partitions,
+    columns: Optional[List[str]],
+) -> Table:
+    """Apply the per-file post-decode steps of `read_files` to one file's raw
+    table: append hive-partition columns and project to the wanted order."""
+    if partitions is None:
+        return t
+    from .partitioning import append_partition_columns
+
+    spec, roots = partitions
+    t = append_partition_columns(t, spec, roots, path, wanted=columns)
+    if columns is not None:
+        t = t.select(columns)
+    return t
+
+
+def concat_cache_probe(
+    files: List[str],
+    file_format: str,
+    columns: Optional[List[str]],
+    partitions,
+) -> Tuple[Optional[tuple], Optional[Table]]:
+    """(key, cached table or None) for the multi-file concat cache. Key =
+    per-file (path,size,mtime) + columns + partition layout, so any file
+    rewrite (or a different partition interpretation of the same files)
+    invalidates. Shared by `read_files` and the pipelined index build (a warm
+    source concat skips the build's whole decode stage)."""
+    if len(files) <= 1:
+        return None, None
+    from .scan_cache import global_concat_cache
+
+    try:
+        stats = []
+        for p in sorted(files):
+            st = os.stat(p)
+            stats.append((p, st.st_size, int(st.st_mtime * 1000)))
+        part_marker = None
+        if partitions is not None:
+            pspec, proots = partitions
+            part_marker = (tuple(pspec.columns), tuple(pspec.dtypes), tuple(proots))
+        concat_key = (
+            "concat",
+            file_format,
+            tuple(stats),
+            # None (all columns) must not share a key with [] (zero columns).
+            ("<all>",) if columns is None else tuple(columns),
+            part_marker,
+        )
+    except OSError:
+        return None, None
+    hit = global_concat_cache().get(concat_key)
+    return concat_key, hit[0] if hit is not None else None
+
+
 def read_files(
     files: List[str],
     file_format: str,
@@ -145,48 +255,18 @@ def read_files(
     columns are appended per file before the concat."""
     if not files:
         raise HyperspaceException("No data files to read.")
-    from .scan_cache import global_concat_cache, global_scan_cache
+    from .scan_cache import global_concat_cache
 
     # Multi-file concat cache: re-assembling N per-file tables (and re-unioning
     # string dictionaries) per query dominates repeated multi-file scans — e.g.
-    # a filter-index scan over num_buckets small files. Key = per-file
-    # (path,size,mtime) + columns + partition layout, so any file rewrite (or a
-    # different partition interpretation of the same files) invalidates.
-    concat_key = None
-    if len(files) > 1:
-        try:
-            stats = []
-            for p in sorted(files):
-                st = os.stat(p)
-                stats.append((p, st.st_size, int(st.st_mtime * 1000)))
-            part_marker = None
-            if partitions is not None:
-                pspec, proots = partitions
-                part_marker = (tuple(pspec.columns), tuple(pspec.dtypes), tuple(proots))
-            concat_key = (
-                "concat",
-                file_format,
-                tuple(stats),
-                # None (all columns) must not share a key with [] (zero columns).
-                ("<all>",) if columns is None else tuple(columns),
-                part_marker,
-            )
-            hit = global_concat_cache().get(concat_key)
-            if hit is not None:
-                return hit[0]
-        except OSError:
-            concat_key = None
+    # a filter-index scan over num_buckets small files.
+    concat_key, cached = concat_cache_probe(files, file_format, columns, partitions)
+    if cached is not None:
+        return cached
 
-    file_columns = columns
-    if partitions is not None:
-        spec, roots = partitions
-        pset = {c.lower() for c in spec.columns}
-        if columns is not None:
-            file_columns = [c for c in columns if c.lower() not in pset]
-            if not file_columns:
-                # Only partition columns requested: still need row counts, so
-                # read the file's own columns and drop them in the select below.
-                file_columns = None
+    file_columns = file_columns_for(columns, partitions)
+
+    from .scan_cache import global_scan_cache
 
     cache = global_scan_cache()
     ordered = sorted(files)
@@ -195,33 +275,28 @@ def read_files(
     if len(missing) > 1:
         # Decode cache misses concurrently: parquet/csv decode is pyarrow C++ work
         # that releases the GIL, so a thread pool gives real parallelism (SURVEY §7
-        # "overlap decode; don't let the device idle on file I/O").
+        # "overlap decode; don't let the device idle on file I/O"). Fully-warm
+        # scans never pay the pool setup.
         from concurrent.futures import ThreadPoolExecutor
 
         with ThreadPoolExecutor(max_workers=min(16, len(missing))) as pool:
             decoded = list(
                 pool.map(
-                    lambda i: _read_one(ordered[i], file_format, file_columns), missing
+                    lambda i: _decode_into_cache(ordered[i], file_format, file_columns),
+                    missing,
                 )
             )
         for i, t in zip(missing, decoded):
-            cache.put(ordered[i], file_columns, t)
             tables[i] = t
     elif missing:
         i = missing[0]
-        t = _read_one(ordered[i], file_format, file_columns)
-        cache.put(ordered[i], file_columns, t)
-        tables[i] = t
+        tables[i] = _decode_into_cache(ordered[i], file_format, file_columns)
 
     if partitions is not None:
-        from .partitioning import append_partition_columns
-
         tables = [
-            append_partition_columns(t, spec, roots, f, wanted=columns)
+            decorate_file_table(t, f, partitions, columns)
             for f, t in zip(ordered, tables)
         ]
-        if columns is not None:
-            tables = [t.select(columns) for t in tables]
     out = tables[0] if len(tables) == 1 else Table.concat(tables)
     if concat_key is not None:
         global_concat_cache().put(concat_key, out, None)

@@ -898,7 +898,9 @@ class HashAggregateExec(PhysicalNode):
         # state aggregate; probe alone measured 1.15 s at 8M on TPU) runs
         # once per table pair, not once per query. HBM pinning rides the
         # device-memo byte budget. A legitimately-empty join caches None.
-        base_subkey = _pair_subkey(join.left_keys, join.right_keys, left, right)
+        base_subkey = _pair_subkey(
+            join.left_keys, join.right_keys, join.left, join.right, left, right
+        )
         rows_key = _pair_rows_key(join.left, join.right, ctx)
         pairs = _cached_two_table(
             "pairs",
@@ -1435,14 +1437,35 @@ def _probe_ranges_cached(l_rep, r_rep, left: Table, right: Table, subkey, rows_k
     )
 
 
-def _pair_subkey(left_keys, right_keys, left: Table, right: Table) -> tuple:
+def _node_relation_names(node) -> "Optional[List[str]]":
+    """The UNDERLYING relation's schema names of a join side (a bucketed scan
+    or a filter over one); None when the node has no single relation."""
+    rel = getattr(node, "relation", None)
+    if rel is None:
+        rel = getattr(getattr(node, "child", None), "relation", None)
+    if rel is None:
+        return None
+    return list(rel.schema.names)
+
+
+def _pair_subkey(left_keys, right_keys, lnode, rnode, left: Table, right: Table) -> tuple:
     """Join-key component of the pair-cache keys. Spelling-normalized
     (lowercased) ONLY when no schema column case-collides — the same guard as
     `FilterExec._condition_key`: with both 'K' and 'k' present, resolution is
     exact-match-first, so joins on 'K' and on 'k' read DIFFERENT columns and
     must not share a cache entry (the projection-independent rows key would
-    otherwise make them collide)."""
-    names = list(left.column_names) + list(right.column_names)
+    otherwise make them collide).
+
+    The guard reads the UNDERLYING relation schemas (via the exec nodes), not
+    the pruned tables' column names: rows_key-keyed pair entries are shared
+    across PRUNINGS of the same scan, and two prunings of a case-colliding
+    schema can disagree when only one of them kept both spellings. Falls back
+    to the pruned tables' names when a side has no single relation."""
+    l_names = _node_relation_names(lnode)
+    r_names = _node_relation_names(rnode)
+    names = (
+        l_names if l_names is not None else list(left.column_names)
+    ) + (r_names if r_names is not None else list(right.column_names))
     if len({n.lower() for n in names}) != len(set(names)):
         return tuple(left_keys), tuple(right_keys)
     return (
@@ -1853,7 +1876,9 @@ class SortMergeJoinExec(PhysicalNode):
         # (counts, aggregates, collects) skips probe + expansion +
         # verification entirely (~1 s of the 8M CPU Q3 aggregate). The padded
         # reps / block layouts underneath stay cached for the cold paths.
-        subkey = _pair_subkey(self.left_keys, self.right_keys, left, right)
+        subkey = _pair_subkey(
+            self.left_keys, self.right_keys, self.left, self.right, left, right
+        )
         rows_key = _pair_rows_key(self.left, self.right, ctx)
 
         def compute():
@@ -1927,7 +1952,9 @@ class SortMergeJoinExec(PhysicalNode):
         # over these same ROWS (any column pruning, any execution strategy)
         # has already computed and cached the verified pairs — the count is
         # free.
-        subkey = _pair_subkey(self.left_keys, self.right_keys, left, right)
+        subkey = _pair_subkey(
+            self.left_keys, self.right_keys, self.left, self.right, left, right
+        )
         rows_key = _pair_rows_key(self.left, self.right, ctx)
         hit, val = _peek_two_table("pairs", left, right, subkey, rows_key)
         if hit:

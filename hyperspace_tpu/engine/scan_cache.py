@@ -1,10 +1,17 @@
-"""File-scan cache: decoded columnar tables keyed by (path, size, mtime, columns).
+"""File-scan cache: decoded columns keyed by (path, size, mtime, column).
 
 The reference's query path leans on the OS page cache and Spark's in-memory columnar
 caching for repeated scans; here the expensive part is parquet decode + dictionary
-encoding, so caching the decoded `Table` per file is the equivalent lever. Safety
+encoding, so caching the decoded columns per file is the equivalent lever. Safety
 comes from the key: it includes the file's size and mtime, so any rewrite of the file
-invalidates its entry (same freshness contract the file-based signature relies on).
+invalidates its entries (same freshness contract the file-based signature relies on).
+
+Storage granularity is PER COLUMN (parquet is columnar: each column group decodes
+independently), while the get/put API and hit/miss accounting stay table-level.
+That makes warm decodes projection-independent: a query that read (a, b) and a
+later index build that wants (a, b, c) share the a/b decode — the build (or any
+scan) asks `missing_columns` and decodes ONLY c. Before this, every distinct
+column tuple re-decoded the whole set from scratch.
 
 Bounded by approximate bytes with LRU eviction; per-process singleton.
 """
@@ -30,20 +37,35 @@ DEFAULT_CAPACITY_BYTES = int(
 )
 
 
-def _table_nbytes(t: Table) -> int:
-    total = 0
-    for c in t.columns.values():
-        total += c.data.nbytes
-        if c.dictionary is not None:
-            total += c.dictionary.nbytes
+def _column_nbytes(c) -> int:
+    total = c.data.nbytes
+    if c.dictionary is not None:
+        total += c.dictionary.nbytes
+    if c.validity is not None:
+        total += c.validity.nbytes
     return total
 
 
+def _table_nbytes(t: Table) -> int:
+    return sum(_column_nbytes(c) for c in t.columns.values())
+
+
 class ScanCache:
+    """Per-column store behind a table-level get/put API.
+
+    Entry kinds under one (path, size, mtime) freshness base:
+      - ("col", name)  → one decoded Column (+ its byte size)
+      - ("names",)     → the file's full column-name order (for columns=None
+                         requests, which must reproduce the decode order)
+
+    Hit/miss counting is per table-level request (a get that assembles from
+    columns counts ONE hit), so cache-pressure accounting stays comparable to
+    the pre-column-granular cache."""
+
     def __init__(self, capacity_bytes: int = DEFAULT_CAPACITY_BYTES):
         self._capacity = capacity_bytes
         self._lock = threading.Lock()
-        self._entries: "OrderedDict[tuple, Tuple[Table, int]]" = OrderedDict()
+        self._entries: "OrderedDict[tuple, Tuple[object, int]]" = OrderedDict()
         self._bytes = 0
         self.hits = 0
         self.misses = 0
@@ -71,40 +93,87 @@ class ScanCache:
             self._capacity = int(capacity_bytes)
             self._evict_to_capacity_locked()
 
-    def _key(self, path: str, columns: Optional[List[str]]):
+    def _base(self, path: str):
         try:
             st = os.stat(path)
-            # None (all columns) must not share a key with [] (zero columns).
-            cols = ("<all>",) if columns is None else tuple(columns)
-            return (path, st.st_size, int(st.st_mtime * 1000), cols)
+            return (path, st.st_size, int(st.st_mtime * 1000))
         except OSError:
             return None
 
-    def get(self, path: str, columns: Optional[List[str]]) -> Optional[Table]:
-        key = self._key(path, columns)
-        if key is None:
+    def _names_for_locked(self, base, columns: Optional[List[str]]):
+        """The column names a request resolves to (requested order, or the
+        recorded whole-file order for columns=None); None when unknown."""
+        if columns is not None:
+            return list(columns)
+        ent = self._entries.get(base + (("names",),))
+        if ent is None:
+            return None
+        self._entries.move_to_end(base + (("names",),))
+        return list(ent[0])
+
+    def get(
+        self, path: str, columns: Optional[List[str]], record: bool = True
+    ) -> Optional[Table]:
+        """Assemble the requested table from cached columns. `record=False`
+        skips hit/miss accounting (internal re-reads after a partial decode —
+        one user-level request must count exactly once)."""
+        base = self._base(path)
+        if base is None:
             return None
         with self._lock:
-            hit = self._entries.get(key)
-            if hit is None:
-                self.misses += 1
+            names = self._names_for_locked(base, columns)
+            cols = {}
+            if names is not None:
+                for n in names:
+                    ent = self._entries.get(base + (("col", n),))
+                    if ent is None:
+                        cols = None
+                        break
+                    cols[n] = ent[0]
+            else:
+                cols = None
+            if cols is None:
+                if record:
+                    self.misses += 1
                 return None
-            self._entries.move_to_end(key)
-            self.hits += 1
-            return hit[0]
+            for n in names:
+                self._entries.move_to_end(base + (("col", n),))
+            if record:
+                self.hits += 1
+            return Table(cols)
+
+    def missing_columns(self, path: str, columns: Optional[List[str]]) -> Optional[List[str]]:
+        """The subset of `columns` NOT currently cached for this file — the
+        decode-only-what's-cold contract of the pipelined build (and any
+        projection-changing scan). None = can't tell (unknown name set for
+        columns=None, or the file is unstattable): decode everything."""
+        base = self._base(path)
+        if base is None:
+            return None
+        with self._lock:
+            names = self._names_for_locked(base, columns)
+            if names is None:
+                return None
+            return [n for n in names if base + (("col", n),) not in self._entries]
 
     def put(self, path: str, columns: Optional[List[str]], table: Table) -> None:
-        key = self._key(path, columns)
-        if key is None:
-            return
-        size = _table_nbytes(table)
-        if size > self._capacity:
+        base = self._base(path)
+        if base is None:
             return
         with self._lock:
-            if key in self._entries:
-                return
-            self._entries[key] = (table, size)
-            self._bytes += size
+            if columns is None:
+                key = base + (("names",),)
+                if key not in self._entries:
+                    self._entries[key] = (list(table.column_names), 0)
+            for n, c in table.columns.items():
+                key = base + (("col", n),)
+                if key in self._entries:
+                    continue
+                size = _column_nbytes(c)
+                if size > self._capacity:
+                    continue
+                self._entries[key] = (c, size)
+                self._bytes += size
             self._evict_to_capacity_locked()
 
     def clear(self) -> None:

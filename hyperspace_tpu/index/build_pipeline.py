@@ -1,0 +1,644 @@
+"""Pipelined covering-index build: overlap decode, transfer, sort, and writes.
+
+The serial build (`CoveringIndexBuilder.write` before this module) is a chain:
+decode ALL parquet files → concat on host → one bucketize+sort → per-bucket
+writes. At the 8M-row bench scale the device spends ~0.2 s sorting inside a
+~5 s build — everything else is host work the device waits on. The reference
+design hid exactly this behind Spark's pipelined shuffle executors
+(PAPER.md §0); this module is the TPU-native equivalent, shaped like a
+training input pipeline:
+
+1. **Decode pool** (``HYPERSPACE_BUILD_DECODE_THREADS``): source files decode
+   concurrently (pyarrow C++ releases the GIL) through the per-file scan
+   cache, each decoded file split into row chunks of at most
+   ``HYPERSPACE_BUILD_CHUNK_ROWS``.
+2. **Hash / transfer stage**: as each chunk lands, its bucket ids are computed
+   (CPU backend) or its key columns are padded to pow2 rows and
+   ``jax.device_put`` onto the device (device backend) — staging overlaps the
+   remaining decodes instead of serializing after them. Pow2 quantization
+   bounds the set of transfer/compile shapes; the staged buffers are donated
+   to the sort program, so XLA reuses their memory.
+3. **Fused bucketize+sort**: on the device path the bucket hash, chunk
+   concatenation, and the stable variadic sort run as ONE jitted program
+   (`ops.partition.fused_bucketize_sort_perm`), or the Pallas in-VMEM bitonic
+   composite sort for small builds (`pallas_composite_build_sort`). On the
+   CPU backend the permutation comes from the exact same
+   `ops.partition.host_sort_perm` the serial path uses.
+4. **Writer pool** (``HYPERSPACE_BUILD_WRITERS``): per-bucket files gather
+   their rows straight from the decoded chunks via ``perm[lo:hi]`` (no
+   materialized full-table copy) and encode in parallel, overlapped with each
+   other's gathers.
+
+**Determinism contract**: the pipelined build produces BYTE-IDENTICAL index
+files to the serial path, for any thread counts. The global row order is
+fixed by the same (file order, chunk concat order) the serial concat uses;
+bucket hashing is elementwise; the sort permutation comes from the identical
+sort implementation over identical arrays; and bucket rows gathered through
+``perm[lo:hi]`` equal ``sorted_table[lo:hi]`` by construction.
+``HYPERSPACE_BUILD_DECODE_THREADS=1`` bypasses this module entirely and runs
+the pre-pipeline serial code path (`tests/test_build_pipeline.py` pins the
+two to each other).
+
+Stage timings (decode/hash/h2d/sort/write, wall, overlap ratio) are recorded
+via `telemetry.profiling.record_build_stages` and surfaced in `bench.py`'s
+``bench_detail``.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from concurrent.futures import FIRST_EXCEPTION, ThreadPoolExecutor, wait
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..config import IndexConstants
+from ..engine import io as engine_io
+from ..engine.schema import STRING
+from ..engine.table import Column, Table
+from ..exceptions import HyperspaceException
+from ..telemetry.profiling import StageTimings, record_build_stages
+
+ENV_DECODE_THREADS = "HYPERSPACE_BUILD_DECODE_THREADS"
+ENV_WRITERS = "HYPERSPACE_BUILD_WRITERS"
+ENV_CHUNK_ROWS = "HYPERSPACE_BUILD_CHUNK_ROWS"
+
+_DEFAULT_WRITERS = 8
+_DEFAULT_CHUNK_ROWS = 4_000_000
+
+
+@dataclass(frozen=True)
+class PipelineConfig:
+    """Env-tunable pipeline knobs. ``decode_threads == 1`` means "serial
+    fallback": the caller runs the pre-pipeline code path unchanged."""
+
+    decode_threads: int
+    writers: int
+    chunk_rows: int
+
+    @staticmethod
+    def from_env(n_files: int) -> "PipelineConfig":
+        raw = int(os.environ.get(ENV_DECODE_THREADS, "0") or 0)
+        decode = raw if raw > 0 else min(16, max(2, n_files))
+        writers = max(1, int(os.environ.get(ENV_WRITERS, _DEFAULT_WRITERS) or _DEFAULT_WRITERS))
+        chunk_rows = max(
+            1, int(os.environ.get(ENV_CHUNK_ROWS, _DEFAULT_CHUNK_ROWS) or _DEFAULT_CHUNK_ROWS)
+        )
+        return PipelineConfig(decode_threads=decode, writers=writers, chunk_rows=chunk_rows)
+
+    @property
+    def pipelined(self) -> bool:
+        return self.decode_threads != 1
+
+
+def _pow2_ceil(n: int) -> int:
+    return 1 << max(n - 1, 1).bit_length()
+
+
+def _lineage_column(path: str, n: int) -> Column:
+    """The per-file `_data_file_name` column: value-identical to the serial
+    path's `Table.from_pydict({...: [path] * n})` (dictionary [path], codes 0)."""
+    return Column(STRING, np.zeros(n, dtype=np.int32), np.asarray([path]))
+
+
+def _decode_file(
+    path: str,
+    file_format: str,
+    wanted: Optional[List[str]],
+    partitions,
+    lineage: bool,
+) -> Table:
+    """One file's decoded, decorated table — the per-file unit of the serial
+    path (`read_files` semantics incl. partition columns + scan cache), plus
+    the lineage column when enabled."""
+    file_cols = engine_io.file_columns_for(wanted, partitions)
+    t = engine_io.file_table(path, file_format, file_cols)
+    t = engine_io.decorate_file_table(t, path, partitions, wanted)
+    if lineage:
+        cols = dict(t.columns)
+        cols[IndexConstants.DATA_FILE_NAME_COLUMN] = _lineage_column(path, t.num_rows)
+        t = Table(cols)
+    return t
+
+
+def _effective_chunk_rows(cfg: PipelineConfig) -> int:
+    """Sub-file chunking exists to QUANTIZE DEVICE TRANSFERS (bound staging
+    buffer sizes); on the CPU path it would only force re-concatenation
+    copies, so the chunk is the whole file/table there."""
+    from ..ops.backend import use_device_path
+
+    return cfg.chunk_rows if use_device_path() else (1 << 62)
+
+
+def _split_chunks(t: Table, chunk_rows: int) -> List[Table]:
+    """Row-slice a decoded file table into pipeline chunks (numpy views — the
+    chunk boundaries have no effect on output order or values)."""
+    if t.num_rows <= chunk_rows:
+        return [t]
+    out = []
+    for lo in range(0, t.num_rows, chunk_rows):
+        hi = min(lo + chunk_rows, t.num_rows)
+        out.append(
+            Table(
+                {
+                    n: Column(
+                        c.dtype,
+                        c.data[lo:hi],
+                        c.dictionary,
+                        None if c.validity is None else c.validity[lo:hi],
+                    )
+                    for n, c in t.columns.items()
+                }
+            )
+        )
+    return out
+
+
+def _concat_key_columns(chunks: List[Table], key_names: List[str]) -> List[Column]:
+    """Global key columns in concat order. Deliberately THE `Table.concat`
+    implementation (the serial path's concat), restricted to the key columns —
+    the bit-for-bit contract depends on identical union-dictionary/promotion/
+    validity behavior, so there must be exactly one copy of that logic.
+    `Table.concat` returns the single table unchanged (no copies) for the
+    warm one-chunk case."""
+    merged = Table.concat([t.select(key_names) for t in chunks])
+    return [merged.column(n) for n in key_names]
+
+
+def _sort_pipeline(
+    chunks: List[Table],
+    chunk_bucket_ids: List[Optional[np.ndarray]],
+    staged_device: Optional[List[List["object"]]],
+    key_names: List[str],
+    num_buckets: int,
+    stages: StageTimings,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """The sort stage: global (perm, starts) over the chunk concat order."""
+    from ..ops.backend import use_device_path
+    from ..ops.partition import (
+        _sort_perm,
+        _sortable,
+        bucket_starts,
+        fused_bucketize_sort_perm,
+        host_sort_perm,
+        pallas_composite_build_sort,
+    )
+
+    n = sum(t.num_rows for t in chunks)
+    if not use_device_path():
+        with stages.timed("sort"):
+            b_host = (
+                np.concatenate(chunk_bucket_ids)
+                if chunk_bucket_ids
+                else np.empty(0, np.int32)
+            )
+            key_cols = _concat_key_columns(chunks, key_names)
+            perm = host_sort_perm(b_host, key_cols, num_buckets)
+            sorted_b = b_host[perm]
+    elif staged_device is not None:
+        # Numeric keys, staged while decoding: hash+concat+sort in ONE
+        # donated-buffer program (or the Pallas composite sort when the whole
+        # build fits VMEM).
+        with stages.timed("sort"):
+            valid_lens = [t.num_rows for t in chunks]
+            perm = sorted_b = None
+            if len(key_names) == 1 and len(staged_device[0]) >= 1:
+                import jax.numpy as jnp
+
+                from ..ops.hashing import bucket_id
+
+                if _pow2_ceil(max(n, 1)) <= 32768:
+                    key_dev = jnp.concatenate(
+                        [c[:v] for c, v in zip(staged_device[0], valid_lens)]
+                    )
+                    key_cols = _concat_key_columns(chunks, key_names)
+                    b_dev = bucket_id(key_cols, [key_dev], num_buckets)
+                    res = pallas_composite_build_sort(b_dev, key_dev, n, num_buckets)
+                    if res is not None:
+                        perm, sorted_b = res
+            if perm is None:
+                perm, sorted_b = fused_bucketize_sort_perm(
+                    staged_device, valid_lens, num_buckets
+                )
+    else:
+        # Device path, but the keys need host-side union-dictionary encoding
+        # (strings) — replicate the serial device program over the global
+        # key columns.
+        import jax.numpy as jnp
+
+        from ..ops.hashing import bucket_id
+
+        with stages.timed("concat"):
+            key_cols = _concat_key_columns(chunks, key_names)
+        with stages.timed("h2d"):
+            arrs = [jnp.asarray(c.data) for c in key_cols]
+        with stages.timed("sort"):
+            b = bucket_id(key_cols, arrs, num_buckets)
+            perm_d, sorted_b_d = _sort_perm(
+                b, tuple(_sortable(a) for a in arrs), n
+            )
+            perm = np.asarray(perm_d)
+            sorted_b = np.asarray(sorted_b_d)
+    return perm, bucket_starts(sorted_b, num_buckets)
+
+
+class _BucketWriter:
+    """Writer-pool stage: per-bucket gather + parquet encode, GIL-free.
+
+    `prepare()` assembles ONE arrow array per output column over the chunk
+    concatenation — decoded values + null mask, exactly what the serial path's
+    `table_to_arrow` feeds the writer (the dictionary representation never
+    reaches the file). `write_bucket` then gathers `perm[lo:hi]` with
+    `pyarrow.compute.take` and encodes — both C++ paths that release the GIL,
+    so the writer pool runs bucket gathers and encodes truly in parallel
+    (the earlier numpy per-bucket gather serialized the pool on the GIL).
+
+    `prepare()` is designed to run on its own thread OVERLAPPED with the sort
+    stage: the sort only touches the key columns, the writers need them all."""
+
+    def __init__(self, chunks: List[Table], index_data_path: str, stages: StageTimings):
+        self.chunks = chunks
+        self.names = chunks[0].column_names
+        self.index_data_path = index_data_path
+        self.stages = stages
+        self.arrays: Dict[str, "object"] = {}
+
+    def prepare(self) -> None:
+        import pyarrow as pa
+
+        with self.stages.timed("concat"):
+            for name in self.names:
+                cols = [t.column(name) for t in self.chunks]
+                if any(c.validity is not None for c in cols):
+                    if len(cols) == 1:
+                        validity = cols[0].validity
+                    else:
+                        validity = np.concatenate(
+                            [
+                                c.validity
+                                if c.validity is not None
+                                else np.ones(len(c), dtype=bool)
+                                for c in cols
+                            ]
+                        )
+                    mask = ~validity
+                else:
+                    mask = None
+                if cols[0].is_string:
+                    # Decode per chunk through its own dictionary — value-
+                    # identical to the serial union-dictionary decode.
+                    values = np.concatenate([c.dictionary[c.data] for c in cols])
+                elif len(cols) == 1:
+                    values = cols[0].data
+                else:
+                    values = np.concatenate([c.data for c in cols])
+                self.arrays[name] = pa.array(values, mask=mask)
+
+    def write_bucket(self, b: int, lo: int, hi: int) -> None:
+        if hi <= lo:
+            return  # empty bucket: no file (same contract as the serial path)
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        out = pa.table(
+            {n: self.gathered[n].slice(lo, hi - lo) for n in self.names}
+        )
+        pq.write_table(
+            out, os.path.join(self.index_data_path, f"part-{b:05d}.parquet")
+        )
+
+    def run(self, perm: np.ndarray, starts: np.ndarray, pool_size: int) -> None:
+        import pyarrow as pa
+        import pyarrow.compute as pc
+
+        num_buckets = len(starts) - 1
+        with ThreadPoolExecutor(max_workers=pool_size) as pool:
+            # One full gather per column (column-parallel, C++ GIL-free),
+            # then per-bucket ZERO-COPY slices feed the encoders — strictly
+            # less gather work than per-bucket takes (no re-walk of the
+            # bucket index lists) and both stages spread over the pool.
+            idx = pa.array(perm)
+            futs = {
+                n: pool.submit(self._timed_take, pc, self.arrays[n], idx)
+                for n in self.names
+            }
+            self.gathered = {}
+            for n, f in futs.items():
+                self.gathered[n] = f.result()
+                # Release the pre-gather copy as soon as its take resolves:
+                # keeps peak memory at ~one extra full-table copy, like the
+                # serial path's sorted_table.
+                self.arrays.pop(n, None)
+            bfuts = [
+                pool.submit(self._timed_bucket, b, int(starts[b]), int(starts[b + 1]))
+                for b in range(num_buckets)
+            ]
+            done, _ = wait(bfuts, return_when=FIRST_EXCEPTION)
+            for f in done:
+                f.result()  # re-raise the first worker failure
+
+    def _timed_take(self, pc, arr, idx):
+        with self.stages.timed("take"):
+            return pc.take(arr, idx)
+
+    def _timed_bucket(self, b: int, lo: int, hi: int) -> None:
+        with self.stages.timed("write"):
+            self.write_bucket(b, lo, hi)
+
+
+def pipelined_write(
+    files_in_order: List[str],
+    file_format: str,
+    wanted: Optional[List[str]],
+    partitions,
+    lineage: bool,
+    key_names: List[str],
+    num_buckets: int,
+    index_data_path: str,
+    cfg: PipelineConfig,
+) -> dict:
+    """Run the staged build: decode → hash/stage → fused sort → bucket writes.
+    Returns the stage-timing summary (also recorded in telemetry)."""
+    if not files_in_order:
+        raise HyperspaceException("No data files to read.")
+    from ..ops.backend import use_device_path
+
+    stages = StageTimings(mode="pipelined-device" if use_device_path() else "pipelined-cpu")
+    n_files = len(files_in_order)
+
+    # Warm-source shortcut: when the exact concat this build would assemble is
+    # already cached (a prior query or build read the same files + columns),
+    # the whole decode stage collapses to reusing it — the "reuse scan_cache
+    # entries when warm" contract, one level up.
+    if not lineage:
+        _, cached_concat = engine_io.concat_cache_probe(
+            files_in_order, file_format, wanted, partitions
+        )
+        if cached_concat is not None:
+            stages.add("decode", 0.0)
+            return _finish_from_chunks(
+                _split_chunks(cached_concat, _effective_chunk_rows(cfg)),
+                key_names,
+                num_buckets,
+                index_data_path,
+                cfg,
+                stages,
+                n_files,
+            )
+
+    return _decode_and_finish(
+        files_in_order,
+        file_format,
+        wanted,
+        partitions,
+        lineage,
+        key_names,
+        num_buckets,
+        index_data_path,
+        cfg,
+        stages,
+    )
+
+
+def _stage_chunk_device(key_cols: List[Column], stages: StageTimings) -> List["object"]:
+    """Pad a chunk's key arrays to pow2 rows and transfer (device path).
+    Pow2-quantized staging bounds the set of buffer shapes the fused sort
+    program compiles against (and that the compile cache must hold) to log2
+    variety; the buffers are later DONATED to the sort program."""
+    import jax
+
+    with stages.timed("h2d"):
+        bufs = []
+        for c in key_cols:
+            pad_n = _pow2_ceil(len(c.data))
+            host = c.data
+            if pad_n != len(host):
+                host = np.concatenate([host, np.zeros(pad_n - len(host), host.dtype)])
+            bufs.append(jax.device_put(host))
+        return bufs
+
+
+def _hash_chunk(key_cols: List[Column], num_buckets: int, stages: StageTimings) -> np.ndarray:
+    """One chunk's bucket ids (CPU path) — elementwise, so the per-chunk
+    concat equals the serial whole-table hash."""
+    import jax.numpy as jnp
+
+    from ..ops.hashing import bucket_id
+
+    with stages.timed("hash"):
+        arrs = [jnp.asarray(c.data) for c in key_cols]
+        return np.asarray(bucket_id(key_cols, arrs, num_buckets))
+
+
+def _stage_or_hash_chunk(
+    ch: Table,
+    key_names: List[str],
+    num_buckets: int,
+    device: bool,
+    stages: StageTimings,
+):
+    """(staged device buffers | None, bucket ids | None) for one chunk — THE
+    staging decision, shared by the streaming and warm-concat paths so they
+    can never diverge (string keys need host union-dictionary encoding and
+    disqualify the fused device staging)."""
+    key_cols = [ch.column(k) for k in key_names]
+    if device:
+        if any(c.is_string for c in key_cols):
+            return None, None
+        return _stage_chunk_device(key_cols, stages), None
+    return None, _hash_chunk(key_cols, num_buckets, stages)
+
+
+def _finish_from_chunks(
+    chunks: List[Table],
+    key_names: List[str],
+    num_buckets: int,
+    index_data_path: str,
+    cfg: PipelineConfig,
+    stages: StageTimings,
+    n_files: int,
+) -> dict:
+    """Hash/stage the given chunks inline (no decode stage to overlap with),
+    then run the shared sort + write tail."""
+    from ..ops.backend import use_device_path
+
+    device = use_device_path()
+    bucket_ids: List[Optional[np.ndarray]] = []
+    staged: List[Optional[List["object"]]] = []
+    for ch in chunks:
+        bufs, b = _stage_or_hash_chunk(ch, key_names, num_buckets, device, stages)
+        staged.append(bufs)
+        bucket_ids.append(b)
+    staged_device = None
+    if device and chunks and all(b is not None for b in staged):
+        staged_device = [[bufs[k] for bufs in staged] for k in range(len(key_names))]
+    return _sort_write_summarize(
+        chunks,
+        bucket_ids,
+        staged_device,
+        key_names,
+        num_buckets,
+        index_data_path,
+        cfg,
+        stages,
+        n_files,
+    )
+
+
+def _decode_and_finish(
+    files_in_order: List[str],
+    file_format: str,
+    wanted: Optional[List[str]],
+    partitions,
+    lineage: bool,
+    key_names: List[str],
+    num_buckets: int,
+    index_data_path: str,
+    cfg: PipelineConfig,
+    stages: StageTimings,
+) -> dict:
+    n_files = len(files_in_order)
+    from ..ops.backend import use_device_path
+
+    # Per-file decoded tables land at their file's slot so the chunk order is
+    # deterministic regardless of decode completion order.
+    file_tables: List[Optional[Table]] = [None] * n_files
+    hash_q: "queue.Queue[int | None]" = queue.Queue()
+
+    def decode_one(i: int) -> None:
+        with stages.timed("decode"):
+            file_tables[i] = _decode_file(
+                files_in_order[i], file_format, wanted, partitions, lineage
+            )
+        hash_q.put(i)
+
+    device = use_device_path()
+    # Chunk state, filled by the hash/stage worker in completion order (the
+    # values are per-chunk and order-independent; chunk identity is the slot).
+    chunk_lists: List[Optional[List[Table]]] = [None] * n_files
+    chunk_buckets: Dict[Tuple[int, int], np.ndarray] = {}
+    staged: Dict[Tuple[int, int], List["object"]] = {}
+
+    hash_err: List[BaseException] = []
+
+    def hash_worker() -> None:
+        """Single consumer overlapping per-chunk hash/transfer with the
+        remaining decodes; jax dispatch stays single-threaded."""
+        done = 0
+        while done < n_files:
+            i = hash_q.get()
+            if i is None:
+                return  # abort: a decode worker failed
+            t = file_tables[i]
+            chunks = _split_chunks(t, _effective_chunk_rows(cfg))
+            chunk_lists[i] = chunks
+            for j, ch in enumerate(chunks):
+                bufs, b = _stage_or_hash_chunk(
+                    ch, key_names, num_buckets, device, stages
+                )
+                if bufs is not None:
+                    staged[(i, j)] = bufs
+                if b is not None:
+                    chunk_buckets[(i, j)] = b
+            done += 1
+
+    def hash_worker_guarded() -> None:
+        try:
+            hash_worker()
+        except BaseException as e:  # surfaced after join — never swallowed
+            hash_err.append(e)
+
+    hasher = threading.Thread(target=hash_worker_guarded, daemon=True)
+    hasher.start()
+    try:
+        with ThreadPoolExecutor(max_workers=min(cfg.decode_threads, n_files)) as pool:
+            futs = [pool.submit(decode_one, i) for i in range(n_files)]
+            done, _ = wait(futs, return_when=FIRST_EXCEPTION)
+            for f in done:
+                f.result()  # re-raise the first decode failure
+    except BaseException:
+        hash_q.put(None)  # unblock the hash worker before propagating
+        raise
+    hasher.join()
+    if hash_err:
+        raise hash_err[0]
+
+    chunks: List[Table] = [c for cl in chunk_lists for c in (cl or [])]
+    bucket_ids: List[Optional[np.ndarray]] = [
+        chunk_buckets.get((i, j))
+        for i, cl in enumerate(chunk_lists)
+        for j in range(len(cl or []))
+    ]
+    staged_device = None
+    if device:
+        ordered = [
+            staged.get((i, j))
+            for i, cl in enumerate(chunk_lists)
+            for j in range(len(cl or []))
+        ]
+        if all(bufs is not None for bufs in ordered) and ordered:
+            # [key column][chunk] layout for the fused program.
+            staged_device = [
+                [bufs[k] for bufs in ordered] for k in range(len(key_names))
+            ]
+    return _sort_write_summarize(
+        chunks,
+        bucket_ids,
+        staged_device,
+        key_names,
+        num_buckets,
+        index_data_path,
+        cfg,
+        stages,
+        n_files,
+    )
+
+
+def _sort_write_summarize(
+    chunks: List[Table],
+    bucket_ids: List[Optional[np.ndarray]],
+    staged_device,
+    key_names: List[str],
+    num_buckets: int,
+    index_data_path: str,
+    cfg: PipelineConfig,
+    stages: StageTimings,
+    n_files: int,
+) -> dict:
+    os.makedirs(index_data_path, exist_ok=True)
+    writer = _BucketWriter(chunks, index_data_path, stages)
+    prep_err: List[BaseException] = []
+
+    def prep_guarded() -> None:
+        try:
+            writer.prepare()
+        except BaseException as e:
+            prep_err.append(e)
+
+    # Arrow-array assembly (all columns) overlaps the sort (key columns only).
+    prep = threading.Thread(target=prep_guarded, daemon=True)
+    prep.start()
+    perm, starts = _sort_pipeline(
+        chunks, bucket_ids, staged_device, key_names, num_buckets, stages
+    )
+    prep.join()
+    if prep_err:
+        raise prep_err[0]
+
+    writer.run(perm, starts, cfg.writers)
+
+    summary = stages.summary()
+    summary.update(
+        {
+            "rows": int(perm.shape[0]),
+            "files": n_files,
+            "chunks": len(chunks),
+            "decode_threads": cfg.decode_threads,
+            "writers": cfg.writers,
+        }
+    )
+    record_build_stages(summary)
+    return summary

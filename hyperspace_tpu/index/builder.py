@@ -107,21 +107,87 @@ class CoveringIndexBuilder(IndexerBuilder):
         return engine_io.read_files(files, rel.file_format, wanted, partitions=partitions)
 
     def write(self, df: DataFrame, index_config: IndexConfig, index_data_path: str) -> None:
-        indexed, _ = self._resolved_columns(df, index_config)
-        table = self._prepare_index_table(df, index_config)
+        """The bucketed build. Routed three ways:
+
+        - mesh build (distributed all_to_all) when a device mesh applies;
+        - the staged PIPELINE (`index/build_pipeline.py`) by default — decode,
+          transfer, fused bucketize+sort and bucket writes overlap;
+        - the pre-pipeline SERIAL chain under
+          ``HYPERSPACE_BUILD_DECODE_THREADS=1`` (the bit-for-bit reference
+          the pipeline is pinned to by `tests/test_build_pipeline.py`).
+
+        Any failure removes the partially-written index data directory, so an
+        aborted build never leaves files for a later `Content.from_directory`
+        inventory to pick up (the log entry stays uncommitted either way)."""
+        try:
+            self._write_routed(df, index_config, index_data_path)
+        except BaseException:
+            import shutil
+
+            shutil.rmtree(index_data_path, ignore_errors=True)
+            raise
+
+    def _write_routed(
+        self, df: DataFrame, index_config: IndexConfig, index_data_path: str
+    ) -> None:
+        from .build_pipeline import PipelineConfig, pipelined_write
+
+        indexed, included = self._resolved_columns(df, index_config)
         num_buckets = self._session.hs_conf.num_buckets
+        rel = df.plan.relation
+        cfg = PipelineConfig.from_env(len(rel.files))
+        if cfg.pipelined and not self._mesh_may_apply(rel):
+            lineage = self._session.hs_conf.lineage_enabled
+            wanted = indexed + included
+            if lineage:
+                wanted = wanted + self._missing_partition_columns(rel, wanted)
+            partitions = (
+                None
+                if rel.partition_spec is None
+                else (rel.partition_spec, rel.root_paths)
+            )
+            files_in_order = (
+                # Lineage reads per file in inventory order; the plain path
+                # rides `read_files`, which sorts — the pipeline's chunk
+                # order must match the serial concat order exactly.
+                [f.path for f in rel.files]
+                if lineage
+                else sorted(f.path for f in rel.files)
+            )
+            pipelined_write(
+                files_in_order,
+                rel.file_format,
+                wanted,
+                partitions,
+                lineage,
+                indexed,
+                num_buckets,
+                index_data_path,
+                cfg,
+            )
+            return
+
+        from ..telemetry.profiling import StageTimings, record_build_stages
+
+        stages = StageTimings(mode="serial")
+        with stages.timed("decode"):
+            table = self._prepare_index_table(df, index_config)
         mesh = self._session.mesh_for(table.num_rows)
         if mesh is not None:
-            # Cluster-wide build (the reference's repartition+bucketed-write runs on
-            # the whole Spark cluster, `CreateActionBase.scala:119-140`): rows ride
-            # an all_to_all over the mesh; identical hash → identical index files.
-            from ..parallel.table_ops import distributed_bucketize_table
+            stages.mode = "mesh"
+        with stages.timed("sort"):
+            if mesh is not None:
+                # Cluster-wide build (the reference's repartition+bucketed-write
+                # runs on the whole Spark cluster, `CreateActionBase.scala:119-140`):
+                # rows ride an all_to_all over the mesh; identical hash →
+                # identical index files.
+                from ..parallel.table_ops import distributed_bucketize_table
 
-            sorted_table, starts = distributed_bucketize_table(
-                mesh, table, indexed, num_buckets
-            )
-        else:
-            sorted_table, starts = bucketize_table(table, indexed, num_buckets)
+                sorted_table, starts = distributed_bucketize_table(
+                    mesh, table, indexed, num_buckets
+                )
+            else:
+                sorted_table, starts = bucketize_table(table, indexed, num_buckets)
         os.makedirs(index_data_path, exist_ok=True)
         import numpy as np
         from concurrent.futures import ThreadPoolExecutor
@@ -139,8 +205,35 @@ class CoveringIndexBuilder(IndexerBuilder):
         # bucket files concurrently keeps the build from serializing on host I/O
         # (SURVEY §7 — the executors of the reference's bucketed write ran
         # cluster-wide for the same reason).
-        with ThreadPoolExecutor(max_workers=8) as pool:
-            list(pool.map(write_bucket, range(num_buckets)))
+        with stages.timed("write"):
+            with ThreadPoolExecutor(max_workers=cfg.writers) as pool:
+                list(pool.map(write_bucket, range(num_buckets)))
+        summary = stages.summary()
+        summary["rows"] = table.num_rows
+        record_build_stages(summary)
+
+    def _mesh_may_apply(self, rel: SourceRelation) -> bool:
+        """Whether the distributed mesh build could claim this source — decided
+        BEFORE decoding (the pipeline wants to stream chunks, the mesh build
+        wants the whole table). Parquet row counts come from the footers; for
+        formats without cheap counts the answer is conservatively True, which
+        routes to the legacy path where `mesh_for(table.num_rows)` decides
+        exactly as before."""
+        if not self._session.hs_conf.distributed_enabled:
+            return False
+        import jax
+
+        if len(jax.devices()) < 2:
+            return False
+        if rel.file_format not in ("parquet", "delta"):
+            return True
+        try:
+            import pyarrow.parquet as pq
+
+            est = sum(pq.ParquetFile(f.path).metadata.num_rows for f in rel.files)
+        except Exception:
+            return True
+        return self._session.mesh_for(est) is not None
 
     # -- metadata derivation (reference CreateActionBase.scala:41-117) ------
 

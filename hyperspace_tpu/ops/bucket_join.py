@@ -338,9 +338,7 @@ def probe_ranges(ls, rs, l_len, r_len):
     vmap'd-searchsorted probe; the CPU backend probes on host (numpy
     searchsorted, ~4x the XLA-CPU probe). Any Pallas failure is recorded once
     and falls back permanently — an index problem must never break a query."""
-    from .backend import use_device_path
-
-    from .backend import pallas_maybe_wanted
+    from .backend import pallas_maybe_wanted, use_device_path
 
     # Cheap pre-gate before touching pallas at all: importing
     # jax.experimental.pallas costs ~1 s on first use, and on the plain CPU

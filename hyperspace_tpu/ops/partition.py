@@ -76,6 +76,28 @@ def _composite_sort_host(
     return np.argsort(comp)
 
 
+def host_sort_perm(b_host: np.ndarray, cols, num_buckets: int) -> np.ndarray:
+    """The CPU-backend permutation by (bucket, keys...): single-lane composite
+    sort when eligible, else lexsort. ONE implementation shared by the serial
+    build path and the pipelined build's sort stage — the bit-for-bit
+    reproducibility contract between them rides on this being the same code
+    over the same arrays (np.argsort/np.lexsort are unstable, so even an
+    equivalent reformulation could permute equal-key rows differently)."""
+    perm_host = _composite_sort_host(b_host, cols, num_buckets)
+    if perm_host is None:
+        lanes = tuple(
+            c.data.astype(np.int32) if c.data.dtype == np.bool_ else c.data
+            for c in reversed(cols)
+        ) + (b_host,)
+        perm_host = np.lexsort(lanes)
+    return perm_host
+
+
+def bucket_starts(sorted_b_host: np.ndarray, num_buckets: int) -> np.ndarray:
+    """Bucket start offsets (length num_buckets+1) of a bucket-sorted id array."""
+    return np.searchsorted(sorted_b_host, np.arange(num_buckets + 1))
+
+
 def bucketize_table(
     table: Table, bucket_columns: Sequence[str], num_buckets: int
 ) -> Tuple[Table, np.ndarray]:
@@ -95,13 +117,7 @@ def bucketize_table(
         # design is for the TPU, where lax.sort is the right primitive. The
         # output contract (permutation by (bucket, keys...)) is identical.
         b_host = np.asarray(b)
-        perm_host = _composite_sort_host(b_host, cols, num_buckets)
-        if perm_host is None:
-            lanes = tuple(
-                c.data.astype(np.int32) if c.data.dtype == np.bool_ else c.data
-                for c in reversed(cols)
-            ) + (b_host,)
-            perm_host = np.lexsort(lanes)
+        perm_host = host_sort_perm(b_host, cols, num_buckets)
         sorted_b_host = b_host[perm_host]
     else:
         perm, sorted_b = _sort_perm(
@@ -109,5 +125,124 @@ def bucketize_table(
         )
         perm_host = np.asarray(perm)
         sorted_b_host = np.asarray(sorted_b)
-    starts = np.searchsorted(sorted_b_host, np.arange(num_buckets + 1))
+    starts = bucket_starts(sorted_b_host, num_buckets)
     return table.take(perm_host), starts
+
+
+# -- fused bucketize+sort for the pipelined build (device path) --------------
+#
+# The serial device path runs TWO dispatches (bucket-id hash, then the
+# variadic sort); on a relay-backed TPU each dispatch is a round-trip. The
+# pipelined build stages pow2-padded chunk buffers onto the device as files
+# decode, then runs hash + concat + sort as ONE jitted program over the whole
+# chunk group, with every staging buffer donated (the build owns them; XLA
+# reuses their HBM for the sort operands). Numeric keys only — string keys
+# need the union-dictionary re-encoding that happens on host anyway.
+
+from functools import lru_cache
+
+
+@lru_cache(maxsize=64)
+def _fused_sort_program(n_keys: int, n_chunks: int, num_buckets: int):
+    from .hashing import _SEED1, _mix_combine, fmix32, hash_device_values
+
+    def impl(valid_lens, *flat):
+        # flat layout: key column 0's chunks, then column 1's chunks, ...
+        # Pad rows ride INSIDE the sort with a sentinel bucket id (they sort
+        # last; lax.sort is stable, so real rows keep their relative —
+        # i.e. unpadded-concat — order), which keeps the program's compile
+        # shapes a function of the pow2-quantized buffer shapes ONLY: the
+        # actual row counts are traced operands, not static values.
+        cols = []
+        for k in range(n_keys):
+            cols.append(jnp.concatenate(flat[k * n_chunks : (k + 1) * n_chunks]))
+        starts = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32), jnp.cumsum(valid_lens).astype(jnp.int32)]
+        )
+        real_parts, gidx_parts = [], []
+        for i in range(n_chunks):
+            li = jnp.arange(int(flat[i].shape[0]), dtype=jnp.int32)
+            real = li < valid_lens[i]
+            real_parts.append(real)
+            # Each real row carries its UNPADDED global index; pad rows get a
+            # sentinel (they land past the first n outputs anyway).
+            gidx_parts.append(jnp.where(real, starts[i] + li, jnp.int32(2**31 - 1)))
+        real = jnp.concatenate(real_parts)
+        gidx = jnp.concatenate(gidx_parts)
+        h = None
+        for arr in cols:
+            hc = hash_device_values(arr, _SEED1)
+            h = hc if h is None else fmix32(_mix_combine(h, hc))
+        b = jnp.where(
+            real, (h % jnp.uint32(num_buckets)).astype(jnp.int32), jnp.int32(num_buckets)
+        )
+        operands = (b, *(_sortable(a) for a in cols), gidx)
+        res = jax.lax.sort(operands, num_keys=1 + n_keys)
+        return res[-1], res[0]  # (permutation, sorted bucket ids) incl. pad tail
+
+    return jax.jit(impl, donate_argnums=tuple(range(1, 1 + n_keys * n_chunks)))
+
+
+def fused_bucketize_sort_perm(
+    chunk_arrays: List[List[jnp.ndarray]], valid_lens: Sequence[int], num_buckets: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """One-dispatch bucketize+sort over staged device chunks.
+
+    `chunk_arrays[k][i]` = key column k's chunk i (device array, possibly
+    pow2-padded beyond `valid_lens[i]`). All chunk buffers are DONATED —
+    callers must not reuse them (best-effort: XLA reuses their memory where
+    aliasing allows). Returns host (perm, sorted_bucket_ids) of length
+    sum(valid_lens); identical ordering to the serial device path (`lax.sort`
+    is stable, and the hash math is the same ops `bucket_id` runs)."""
+    n_keys = len(chunk_arrays)
+    n_chunks = len(chunk_arrays[0])
+    n = int(sum(int(v) for v in valid_lens))
+    fn = _fused_sort_program(n_keys, n_chunks, int(num_buckets))
+    flat = [c for col in chunk_arrays for c in col]
+    perm, sorted_b = fn(jnp.asarray(list(valid_lens), dtype=jnp.int32), *flat)
+    return np.asarray(perm)[:n], np.asarray(sorted_b)[:n]
+
+
+def pallas_composite_build_sort(
+    b_dev, key_dev, n: int, num_buckets: int
+) -> "Tuple[np.ndarray, np.ndarray] | None":
+    """Small-build fast path: pack (bucket, key, row) into ONE int64 composite
+    and sort it with the Pallas in-VMEM bitonic kernel (`ops/pallas_sort`) —
+    the whole O(log² n) network in a single HBM round-trip instead of the
+    multi-stage XLA variadic sort. The row-index tiebreaker in the low bits
+    makes the unstable bitonic network reproduce the STABLE (bucket, key)
+    order exactly, so the output contract matches `_sort_perm` bit-for-bit.
+    Returns None when out of budget (shape, dtype, or int64 headroom)."""
+    from .pallas_sort import pallas_sort_wanted, record_sort_failure, sort_padded_with_order
+
+    key_dev = jnp.asarray(key_dev)
+    if not jnp.issubdtype(key_dev.dtype, jnp.integer):
+        return None
+    n_pad = 1 << max(int(n) - 1, 1).bit_length()
+    if not pallas_sort_wanted(1, n_pad):
+        return None
+    if n == 0:
+        return np.empty(0, np.int32), np.empty(0, np.int32)
+    k64 = key_dev.astype(jnp.int64)
+    lo = int(jax.device_get(k64.min()))
+    hi = int(jax.device_get(k64.max()))
+    span = hi - lo + 1
+    # Composite headroom: (num_buckets+1) * span * n_pad must fit signed 64.
+    if span > (1 << 62) // max((num_buckets + 1) * n_pad, 1):
+        return None
+    try:
+        iota = jnp.arange(n, dtype=jnp.int64)
+        comp = (
+            b_dev.astype(jnp.int64) * jnp.int64(span) + (k64 - jnp.int64(lo))
+        ) * jnp.int64(n_pad) + iota
+        pad_val = jnp.int64(num_buckets) * jnp.int64(span) * jnp.int64(n_pad)
+        padded = jnp.full((1, n_pad), pad_val, dtype=jnp.int64).at[0, :n].set(comp)
+        sorted_keys, order = sort_padded_with_order(padded)
+        perm = np.asarray(order[0, :n]).astype(np.int32)
+        sorted_b = np.asarray(
+            (sorted_keys[0, :n] // jnp.int64(n_pad)) // jnp.int64(span)
+        ).astype(np.int32)
+        return perm, sorted_b
+    except Exception as e:  # Mosaic lowering/runtime problems
+        record_sort_failure(e)
+        return None

@@ -94,7 +94,14 @@ class FilterIndexRule:
                     return node
                 chosen = rank(usable)
                 best = chosen.entry
-                index_child: LogicalPlan = ScanNode(_index_relation(best))
+                pruned_files = None
+                if session.hs_conf.filter_bucket_pruning:
+                    pruned_files = _bucket_pruned_files(
+                        best, filt.condition, session.hs_conf.case_sensitive
+                    )
+                index_child: LogicalPlan = ScanNode(
+                    _index_relation(best, files=pruned_files)
+                )
                 if chosen.deleted:
                     # Delete tolerance: prune rows of vanished source files by
                     # lineage BEFORE the output projection drops the column.
@@ -146,17 +153,128 @@ class FilterIndexRule:
             return plan
 
 
+def _head_equality_values(condition, head: str, case_sensitive: bool):
+    """Literal values v such that `condition` implies head == v: a top-level
+    conjunct of the form `head == lit` (either orientation) or
+    `head IN [lits]`. None = no such conjunct (no pruning). Conservative by
+    construction: only AND-descent, only plain literals."""
+    from ..engine.expr import BinaryOp, Col, IsIn, Lit
+
+    def is_head(e) -> bool:
+        return isinstance(e, Col) and resolve(e.name, [head], case_sensitive) is not None
+
+    stack = [condition]
+    while stack:
+        e = stack.pop()
+        if isinstance(e, BinaryOp) and e.op == "and":
+            stack += [e.left, e.right]
+            continue
+        if isinstance(e, BinaryOp) and e.op == "==":
+            for a, b in ((e.left, e.right), (e.right, e.left)):
+                if is_head(a) and isinstance(b, Lit):
+                    return [b.value]
+        if isinstance(e, IsIn) and is_head(e.child):
+            return list(e.values)
+    return None
+
+
+def _bucket_of_literal(value, dtype: str, num_buckets: int):
+    """The hash bucket a literal of the head column lands in at BUILD time, or
+    None when the literal can't be put in the column's canonical hash space
+    (then pruning must not apply). Uses the exact `ops.hashing.bucket_id`
+    machinery the build uses, so build and prune can never disagree."""
+    import numpy as np
+
+    from ..engine.schema import BOOL, FLOAT64, INT32, INT64, STRING
+    from ..engine.table import Column
+
+    if isinstance(value, bool):
+        arr = np.asarray([value]) if dtype == BOOL else None
+    elif dtype == STRING:
+        arr = np.asarray([value]) if isinstance(value, str) else None
+    elif dtype in (INT32, INT64):
+        # Integers hash from their int64 bit pattern; an integral float
+        # literal equals the same int rows, a fractional or out-of-int64-range
+        # one equals none (skip pruning rather than model the empty set).
+        if isinstance(value, (int, np.integer)) or (
+            isinstance(value, float) and float(value).is_integer()
+        ):
+            v = int(value)
+            arr = (
+                np.asarray([v], dtype=np.int64)
+                if -(2**63) <= v < 2**63
+                else None
+            )
+        else:
+            arr = None
+    elif dtype == FLOAT64:
+        arr = (
+            np.asarray([float(value)], dtype=np.float64)
+            if isinstance(value, (int, float, np.integer, np.floating))
+            else None
+        )
+    else:
+        arr = None  # float32 storage widens before hashing; literal space differs
+    if arr is None:
+        return None
+    import jax.numpy as jnp
+
+    from ..ops.hashing import bucket_id
+
+    col = Column.from_values(arr)
+    return int(np.asarray(bucket_id([col], [jnp.asarray(col.data)], num_buckets))[0])
+
+
+def _bucket_pruned_files(entry: IndexLogEntry, condition, case_sensitive: bool):
+    """The subset of index data files a head-column point lookup can touch:
+    rows with head == v live ONLY in v's hash bucket (the build's partitioning
+    invariant), so every other `part-<bucket>` file is skippable. None = no
+    pruning (no usable equality conjunct, unhashable literal, or an index
+    file outside the `part-<bucket>` naming contract, e.g. after compaction)."""
+    import os as _os
+    import re
+
+    from ..engine.schema import Schema
+
+    values = _head_equality_values(condition, entry.indexed_columns[0], case_sensitive)
+    if values is None:
+        return None
+    schema = Schema.from_json_string(entry.schema_json)
+    head = resolve(entry.indexed_columns[0], schema.names, case_sensitive)
+    if head is None:
+        return None
+    dtype = schema.field(head).dtype
+    num_buckets = entry.num_buckets
+    buckets = set()
+    for v in values:
+        b = _bucket_of_literal(v, dtype, num_buckets)
+        if b is None:
+            return None
+        buckets.add(b)
+    kept = []
+    for f in index_files_as_statuses(entry):
+        m = re.match(r"part-(\d+)\.parquet$", _os.path.basename(f.path))
+        if m is None:
+            return None  # unexpected layout: never prune what we can't place
+        if int(m.group(1)) in buckets:
+            kept.append(f)
+    return kept
+
+
 def rank(candidates):
     """FilterIndexRanker: exact-match candidates beat hybrid-scan ones (less
     source-file drift first), then first (reference ranking TODO at :202-208)."""
     return sorted(candidates, key=lambda c: len(c.appended) + len(c.deleted))[0]
 
 
-def _index_relation(entry: IndexLogEntry, with_bucket_spec: bool = False) -> SourceRelation:
+def _index_relation(
+    entry: IndexLogEntry, with_bucket_spec: bool = False, files=None
+) -> SourceRelation:
     """Build the substituted relation over the index's own data files.
 
     No BucketSpec for filter scans (parallelism over all files, reference :100-132);
-    the join rule passes with_bucket_spec=True."""
+    the join rule passes with_bucket_spec=True. `files` restricts the scan to a
+    subset (bucket pruning) — the relation is tagged so explain shows the prune."""
     from ..engine.logical import BucketSpec
     from ..engine.schema import Schema
 
@@ -167,11 +285,16 @@ def _index_relation(entry: IndexLogEntry, with_bucket_spec: bool = False) -> Sou
             bucket_columns=tuple(entry.indexed_columns),
             sort_columns=tuple(entry.indexed_columns),
         )
+    all_files = index_files_as_statuses(entry)
+    pruned_by = []
+    if files is not None and len(files) < len(all_files):
+        pruned_by = ["FilterIndexRule:bucket"]
     return SourceRelation(
         root_paths=[entry.index_location()],
         file_format="parquet",
         schema=Schema.from_json_string(entry.schema_json),
-        files=index_files_as_statuses(entry),
+        files=all_files if files is None else files,
         bucket_spec=spec,
         index_name=entry.name,
+        pruned_by=pruned_by,
     )

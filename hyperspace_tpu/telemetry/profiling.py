@@ -10,12 +10,90 @@ without profiler support), so they are safe to leave in production paths.
 
 The bench consumes this via `BENCH_PROFILE_DIR=/path python bench.py`, which
 traces the device section; `block_until_ready` wall deltas in the bench JSON
-remain the machine-readable summary (device_time_s / utilization)."""
+remain the machine-readable summary (device_time_s / utilization).
+
+`StageTimings` + `record_build_stages` are the HOST-side counterpart for the
+pipelined index build: per-stage busy time (decode/hash/h2d/sort/take/write),
+wall-clock, and the overlap ratio of each build, surfaced through bench.py's
+`bench_detail.build_stages` (see docs/build-pipeline.md)."""
 
 from __future__ import annotations
 
 import contextlib
-from typing import Iterator, Optional
+import threading
+import time
+from collections import deque
+from typing import Dict, Iterator, Optional
+
+
+class StageTimings:
+    """Thread-safe per-stage wall-clock accumulator for a pipelined operation.
+
+    Stages run CONCURRENTLY (that is the point of the pipeline), so per-stage
+    sums measure busy time across workers, not a wall-clock partition:
+    `overlap_ratio = sum(stage_s) / wall_s` > 1 means stages genuinely ran on
+    top of each other, ~1 means the pipeline degenerated to a serial chain."""
+
+    def __init__(self, mode: str = ""):
+        self._lock = threading.Lock()
+        self._stages: Dict[str, float] = {}
+        self._counts: Dict[str, int] = {}
+        self.mode = mode
+        self._t0 = time.monotonic()
+        self._wall: Optional[float] = None
+
+    def add(self, stage: str, seconds: float) -> None:
+        with self._lock:
+            self._stages[stage] = self._stages.get(stage, 0.0) + float(seconds)
+            self._counts[stage] = self._counts.get(stage, 0) + 1
+
+    @contextlib.contextmanager
+    def timed(self, stage: str) -> Iterator[None]:
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self.add(stage, time.monotonic() - t0)
+
+    def finish(self) -> None:
+        if self._wall is None:
+            self._wall = time.monotonic() - self._t0
+
+    def summary(self) -> dict:
+        self.finish()
+        with self._lock:
+            wall = self._wall or 0.0
+            busy = sum(self._stages.values())
+            out = {f"{k}_s": round(v, 4) for k, v in sorted(self._stages.items())}
+            out["wall_s"] = round(wall, 4)
+            out["overlap_ratio"] = round(busy / wall, 3) if wall > 0 else None
+            out["mode"] = self.mode
+            out["stage_counts"] = dict(sorted(self._counts.items()))
+            return out
+
+
+# Most recent index-build stage summaries (newest last), consumed by
+# bench.py's bench_detail. Bounded: telemetry must never grow with the
+# number of builds a long-lived session performs.
+_BUILD_STAGES: "deque[dict]" = deque(maxlen=16)
+_build_stages_lock = threading.Lock()
+
+
+def record_build_stages(summary: dict) -> None:
+    with _build_stages_lock:
+        _BUILD_STAGES.append(dict(summary))
+
+
+def last_build_stages() -> Optional[dict]:
+    """The most recent build's stage summary (None if no build ran yet)."""
+    with _build_stages_lock:
+        return dict(_BUILD_STAGES[-1]) if _BUILD_STAGES else None
+
+
+def build_stages_history() -> list:
+    """Stage summaries of the last few builds, oldest first."""
+    with _build_stages_lock:
+        return [dict(d) for d in _BUILD_STAGES]
 
 
 @contextlib.contextmanager

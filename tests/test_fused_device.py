@@ -520,21 +520,55 @@ def test_count_reuses_pairs_cached_by_aggregate(dev_session, tmp_path):
         ph.SortMergeJoinExec._reconciled_reps = orig
 
 
+class _FakeRelNode:
+    """Stub exec node exposing only what `_node_relation_names` reads."""
+
+    class _Rel:
+        class _Schema:
+            def __init__(self, names):
+                self.names = names
+
+        def __init__(self, names):
+            self.schema = self._Schema(names)
+
+    def __init__(self, names):
+        self.relation = self._Rel(names)
+
+
 def test_pair_subkey_preserves_case_on_colliding_schemas():
     """With both 'K' and 'k' in scope, joins on col('K') and col('k') read
     DIFFERENT columns (resolution is exact-match-first) and must not share a
-    pairs-cache entry under the projection-independent rows key."""
+    pairs-cache entry under the projection-independent rows key. The guard
+    keys off the UNDERLYING RELATION schemas: pair entries are shared across
+    prunings of the same scan, so a pruning that dropped one of the colliding
+    spellings must still key exactly (ADVICE round 5)."""
     from hyperspace_tpu.engine import physical as ph
     from hyperspace_tpu.engine.table import Table
 
     plain_l = Table.from_pydict({"k": np.array([1]), "v": np.array([2])})
     plain_r = Table.from_pydict({"dk": np.array([1])})
-    assert ph._pair_subkey(["K"], ["dk"], plain_l, plain_r) == (("k",), ("dk",))
+    ln = _FakeRelNode(["k", "v"])
+    rn = _FakeRelNode(["dk"])
+    assert ph._pair_subkey(["K"], ["dk"], ln, rn, plain_l, plain_r) == (
+        ("k",),
+        ("dk",),
+    )
 
-    collide_l = Table.from_pydict({"K": np.array([1]), "k": np.array([2])})
-    a = ph._pair_subkey(["K"], ["dk"], collide_l, plain_r)
-    b = ph._pair_subkey(["k"], ["dk"], collide_l, plain_r)
+    # Case-colliding RELATION schema, but a pruned table that kept only one
+    # spelling: the guard must still see the collision and keep exact keys.
+    collide_node = _FakeRelNode(["K", "k"])
+    pruned_l = Table.from_pydict({"K": np.array([1])})
+    a = ph._pair_subkey(["K"], ["dk"], collide_node, rn, pruned_l, plain_r)
+    b = ph._pair_subkey(["k"], ["dk"], collide_node, rn, pruned_l, plain_r)
     assert a != b  # exact spellings kept: no shared entry
+    assert a == (("K",), ("dk",))
+
+    # Fallback without a relation (no single underlying scan): the pruned
+    # tables' own names decide, as before.
+    collide_l = Table.from_pydict({"K": np.array([1]), "k": np.array([2])})
+    a = ph._pair_subkey(["K"], ["dk"], object(), object(), collide_l, plain_r)
+    b = ph._pair_subkey(["k"], ["dk"], object(), object(), collide_l, plain_r)
+    assert a != b
 
 
 def test_repeated_count_probes_once(dev_session, tmp_path):
