@@ -29,6 +29,40 @@ _FORMAT_EXTENSIONS = {
     "orc": (".orc",),
 }
 
+#: Decode-pool width knob, shared by every concurrent file decode in the
+#: engine: `read_files`, the streaming chunk iterator, the bucketed-scan
+#: cache warmer, and the pipelined index build (`index/build_pipeline.py`
+#: imports this name) — ONE threading contract for build and query.
+ENV_DECODE_THREADS = "HYPERSPACE_BUILD_DECODE_THREADS"
+
+#: How many files the streaming chunk iterator may hold in flight ahead of
+#: the consumer — the BINDING memory bound (the decode pool is capped at this
+#: depth). Default 16 matches `read_files`' behavior of decoding every cold
+#: file concurrently; memory-constrained deployments lower it.
+ENV_PREFETCH_FILES = "HYPERSPACE_QUERY_PREFETCH_FILES"
+_DEFAULT_PREFETCH_FILES = 16
+
+
+def decode_pool_size(n_files: int) -> int:
+    """Worker count for decoding `n_files` cold files: honors
+    ``HYPERSPACE_BUILD_DECODE_THREADS`` (``1`` = the serial path, >1 = an
+    explicit cap), defaulting to ``min(16, n_files)``."""
+    raw = int(os.environ.get(ENV_DECODE_THREADS, "0") or 0)
+    if raw == 1:
+        return 1
+    if raw > 1:
+        return min(raw, n_files)
+    return min(16, n_files)
+
+
+def prefetch_depth() -> int:
+    """In-flight file budget of the streaming chunk iterator (≥1)."""
+    return max(
+        1,
+        int(os.environ.get(ENV_PREFETCH_FILES, _DEFAULT_PREFETCH_FILES)
+            or _DEFAULT_PREFETCH_FILES),
+    )
+
 
 def list_data_files(path: str, file_format: str, fs: Optional[FileSystem] = None) -> List[FileStatus]:
     """Resolve the data files of a root path (file or directory, recursive), applying
@@ -205,6 +239,87 @@ def decorate_file_table(
     return t
 
 
+def warm_file_cache(
+    paths: List[str], file_format: str, file_columns: Optional[List[str]]
+) -> None:
+    """Concurrently decode the cache-cold files among `paths` into the per-file
+    scan cache (shared decode-pool contract). Callers that must consume files
+    in a fixed order one at a time (the bucketed index scan) call this first so
+    the serial consumption loop runs fully warm — cold indexed reads previously
+    decoded every bucket file back-to-back on one thread."""
+    from .scan_cache import global_scan_cache
+
+    cache = global_scan_cache()
+    missing = [p for p in paths if cache.missing_columns(p, file_columns) != []]
+    workers = decode_pool_size(len(missing)) if missing else 0
+    if len(missing) > 1 and workers > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            list(
+                pool.map(
+                    lambda p: _decode_into_cache(p, file_format, file_columns),
+                    missing,
+                )
+            )
+
+
+def iter_file_tables(
+    files: List[str],
+    file_format: str,
+    columns: Optional[List[str]] = None,
+    partitions=None,
+    on_decode=None,
+):
+    """Ordered per-file table iterator with bounded decode prefetch — the
+    read-side twin of the build pipeline's decode stage. Files decode on a
+    pool (shared `decode_pool_size` contract, through the per-column scan
+    cache) up to `prefetch_depth()` files ahead of the consumer, and are
+    yielded in sorted-file order so downstream results are independent of
+    decode completion order. A decode failure propagates at the failed file's
+    yield point; already-submitted decodes finish into the cache harmlessly
+    (the cache only ever stores successful decodes — no poisoned entries).
+
+    `on_decode(seconds)` observes each file's decode wall time (telemetry)."""
+    if not files:
+        return
+    import time as _time
+    from concurrent.futures import ThreadPoolExecutor
+
+    file_columns = file_columns_for(columns, partitions)
+    ordered = sorted(files)
+
+    def decode_one(path: str) -> Table:
+        t0 = _time.monotonic()
+        t = file_table(path, file_format, file_columns)
+        if on_decode is not None:
+            on_decode(_time.monotonic() - t0)
+        return t
+
+    # The prefetch depth is the binding in-flight bound: more decode workers
+    # than undelivered-file slots could only grow resident memory past it.
+    depth = prefetch_depth()
+    workers = min(decode_pool_size(len(ordered)), depth)
+    if workers <= 1:
+        for f in ordered:
+            yield decorate_file_table(decode_one(f), f, partitions, columns)
+        return
+    from collections import deque
+
+    pool = ThreadPoolExecutor(max_workers=workers)
+    try:
+        pending: "deque" = deque()
+        i = 0
+        while i < len(ordered) or pending:
+            while i < len(ordered) and len(pending) < depth:
+                pending.append((ordered[i], pool.submit(decode_one, ordered[i])))
+                i += 1
+            f, fut = pending.popleft()
+            yield decorate_file_table(fut.result(), f, partitions, columns)
+    finally:
+        pool.shutdown(wait=False, cancel_futures=True)
+
+
 def concat_cache_probe(
     files: List[str],
     file_format: str,
@@ -272,14 +387,17 @@ def read_files(
     ordered = sorted(files)
     tables: List[Optional[Table]] = [cache.get(f, file_columns) for f in ordered]
     missing = [i for i, t in enumerate(tables) if t is None]
-    if len(missing) > 1:
+    workers = decode_pool_size(len(missing)) if missing else 0
+    if len(missing) > 1 and workers > 1:
         # Decode cache misses concurrently: parquet/csv decode is pyarrow C++ work
         # that releases the GIL, so a thread pool gives real parallelism (SURVEY §7
         # "overlap decode; don't let the device idle on file I/O"). Fully-warm
-        # scans never pay the pool setup.
+        # scans never pay the pool setup. The worker count rides the shared
+        # HYPERSPACE_BUILD_DECODE_THREADS contract (`decode_pool_size`), so
+        # `=1` forces the serial path here exactly as it does for the build.
         from concurrent.futures import ThreadPoolExecutor
 
-        with ThreadPoolExecutor(max_workers=min(16, len(missing))) as pool:
+        with ThreadPoolExecutor(max_workers=workers) as pool:
             decoded = list(
                 pool.map(
                     lambda i: _decode_into_cache(ordered[i], file_format, file_columns),
@@ -288,9 +406,9 @@ def read_files(
             )
         for i, t in zip(missing, decoded):
             tables[i] = t
-    elif missing:
-        i = missing[0]
-        tables[i] = _decode_into_cache(ordered[i], file_format, file_columns)
+    else:
+        for i in missing:
+            tables[i] = _decode_into_cache(ordered[i], file_format, file_columns)
 
     if partitions is not None:
         tables = [

@@ -245,6 +245,13 @@ class BucketedConcatCache:
             self._bytes += size
             self._evict_to_capacity_locked()
 
+    def clear(self) -> None:
+        """Drop every entry (bench cold-path measurement; stats counters keep
+        accumulating so lifetime accounting stays monotonic)."""
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+
 
 _BUCKETED = BucketedConcatCache()
 

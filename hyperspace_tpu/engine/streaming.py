@@ -1,0 +1,159 @@
+"""Streaming scan→filter→aggregate executor: the read-side pipeline.
+
+`bench` r05 measured the materialized aggregate path decoding and concatenating
+the FULL multi-file table on the host before any reduction starts (cold indexed
+reads spent 1.34 s of 1.35 s in I/O; the 8M scan aggregate materialized ~500 MB
+it immediately reduced away). This module mirrors `index/build_pipeline.py` on
+the query side:
+
+1. **Decode** — `engine.io.iter_file_tables` feeds per-file tables in sorted
+   order through the per-column scan cache, with a bounded decode pool
+   (shared ``HYPERSPACE_BUILD_DECODE_THREADS`` contract) running up to
+   ``HYPERSPACE_QUERY_PREFETCH_FILES`` files ahead of the consumer.
+2. **Chunk** — each file splits into row slices of at most
+   ``HYPERSPACE_QUERY_CHUNK_ROWS`` (numpy views; chunk boundaries never change
+   values or output order).
+3. **Filter / projections** — `FilterExec`/`ProjectExec`/`WithColumnExec`
+   apply per chunk through their `execute_stream` generators, so selective
+   filters shrink chunks before any reduction.
+4. **Reduce with carry** — `ops.aggregate.StreamAggregator` reduces every
+   chunk to per-group partial states (the fused jitted hash/sort/segment
+   programs on the device path, `reduceat` on the CPU backend) and carries the
+   accumulators across chunks, merging by exact key records. The full concat
+   is never materialized.
+
+``HYPERSPACE_QUERY_STREAMING=0`` disables the whole path: every aggregate runs
+today's materialized execution byte-for-byte. Streamed results equal the
+materialized path's exactly for integer/count/min/max outputs and to
+float-associativity rounding for float sum/avg (docs/query-pipeline.md).
+
+Per-stage busy timings (decode/eval/partial/merge), wall clock, and the
+overlap ratio ride `telemetry.profiling.record_query_stages` and surface in
+``bench.py``'s ``bench_detail.query_stages``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from .table import Column, Table
+
+ENV_QUERY_STREAMING = "HYPERSPACE_QUERY_STREAMING"
+ENV_QUERY_CHUNK_ROWS = "HYPERSPACE_QUERY_CHUNK_ROWS"
+_DEFAULT_QUERY_CHUNK_ROWS = 4_000_000
+
+
+def streaming_enabled() -> bool:
+    """Default ON; ``HYPERSPACE_QUERY_STREAMING=0`` is the materialized
+    fallback (preserves the pre-streaming execution exactly)."""
+    return os.environ.get(ENV_QUERY_STREAMING, "") != "0"
+
+
+def query_chunk_rows() -> int:
+    return max(
+        1,
+        int(
+            os.environ.get(ENV_QUERY_CHUNK_ROWS, _DEFAULT_QUERY_CHUNK_ROWS)
+            or _DEFAULT_QUERY_CHUNK_ROWS
+        ),
+    )
+
+
+def split_chunks(t: Table, chunk_rows: int) -> List[Table]:
+    """Row-slice a table into pipeline chunks (numpy views — chunk boundaries
+    have no effect on output order or values). Same slicing as the build
+    pipeline's `_split_chunks`."""
+    if t.num_rows <= chunk_rows:
+        return [t]
+    out = []
+    for lo in range(0, t.num_rows, chunk_rows):
+        hi = min(lo + chunk_rows, t.num_rows)
+        out.append(
+            Table(
+                {
+                    n: Column(
+                        c.dtype,
+                        c.data[lo:hi],
+                        c.dictionary,
+                        None if c.validity is None else c.validity[lo:hi],
+                    )
+                    for n, c in t.columns.items()
+                }
+            )
+        )
+    return out
+
+
+def compact_mask_indices(mask):
+    """Surviving row indices of a chunk's predicate mask. The whole-table
+    filter's `nonzero_indices` compiles one program PER SURVIVOR COUNT
+    (`jnp.nonzero(size=n)`) — fine once per query, ~0.3 s of XLA-CPU compile
+    per CHUNK here, where every chunk survives differently. CPU backend:
+    plain numpy (the mask is host-resident anyway). Device path: pow2-capped
+    `size` so compiles stay log2-bounded."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..ops.backend import use_device_path
+
+    if not use_device_path():
+        return np.nonzero(np.asarray(mask))[0]
+    mask = jnp.asarray(mask)
+    n = int(mask.sum())
+    if n == 0:
+        return np.empty(0, np.int64)
+    cap = 1 << max(n - 1, 1).bit_length()
+    return np.asarray(jnp.nonzero(mask, size=cap, fill_value=0)[0])[:n]
+
+
+def timed(stages, name: str):
+    """`stages.timed(name)`, or a no-op context when telemetry is off."""
+    if stages is None:
+        import contextlib
+
+        return contextlib.nullcontext()
+    return stages.timed(name)
+
+
+def stream_aggregate(agg_exec, ctx) -> Optional[Table]:
+    """Run a `HashAggregateExec` over its child's chunk stream with the
+    chunk-carry aggregator. Returns None only when no chunk arrived (the
+    caller owns the fallback); faults mid-stream propagate — the scan cache
+    only ever holds successful decodes, so a failed query poisons nothing."""
+    from ..ops.aggregate import StreamAggregator, _empty_result
+    from ..ops.backend import use_device_path
+    from ..telemetry.profiling import StageTimings, record_query_stages
+
+    import numpy as np
+
+    stages = StageTimings(
+        mode="stream-device" if use_device_path() else "stream-cpu"
+    )
+    agg = StreamAggregator(agg_exec.group_keys, agg_exec.aggs, stages=stages)
+    # 0-row schema template accumulated across ALL chunks: the empty-input
+    # result must carry the same concat-PROMOTED dtypes (mixed-width files,
+    # union dictionaries) the materialized path would produce.
+    template: Optional[Table] = None
+    none_idx = np.empty(0, np.int64)
+    n_chunks = 0
+    for chunk in agg_exec.child.execute_stream(ctx, stages):
+        zero = chunk.take(none_idx)
+        template = zero if template is None else Table.concat([template, zero])
+        n_chunks += 1
+        agg.add_chunk(chunk)
+    out = agg.finalize()
+    if out is None:
+        if template is None:
+            return None  # nothing streamed: caller falls back
+        out = _empty_result(template, agg_exec.group_keys, agg_exec.aggs)
+    summary = stages.summary()
+    summary.update(
+        {
+            "chunks": n_chunks,
+            "rows": agg.rows,
+            "groups": out.num_rows,
+        }
+    )
+    record_query_stages(summary)
+    return out

@@ -62,7 +62,11 @@ from ..engine.table import Column, Table
 from ..exceptions import HyperspaceException
 from ..telemetry.profiling import StageTimings, record_build_stages
 
-ENV_DECODE_THREADS = "HYPERSPACE_BUILD_DECODE_THREADS"
+# The decode-pool knob is defined in engine.io (`decode_pool_size`) — ONE
+# threading contract shared by the build pipeline, `read_files`, and the
+# streaming query executor; re-exported here for existing importers.
+from ..engine.io import ENV_DECODE_THREADS
+
 ENV_WRITERS = "HYPERSPACE_BUILD_WRITERS"
 ENV_CHUNK_ROWS = "HYPERSPACE_BUILD_CHUNK_ROWS"
 
@@ -81,8 +85,11 @@ class PipelineConfig:
 
     @staticmethod
     def from_env(n_files: int) -> "PipelineConfig":
-        raw = int(os.environ.get(ENV_DECODE_THREADS, "0") or 0)
-        decode = raw if raw > 0 else min(16, max(2, n_files))
+        # Shared parse (`engine.io.decode_pool_size`): `1` = serial fallback,
+        # explicit values cap at the file count. The build floors n_files at 2
+        # so the default still pipelines single-file sources (the
+        # decode-threads value doubles as the pipelined-vs-serial flag here).
+        decode = engine_io.decode_pool_size(max(2, n_files))
         writers = max(1, int(os.environ.get(ENV_WRITERS, _DEFAULT_WRITERS) or _DEFAULT_WRITERS))
         chunk_rows = max(
             1, int(os.environ.get(ENV_CHUNK_ROWS, _DEFAULT_CHUNK_ROWS) or _DEFAULT_CHUNK_ROWS)

@@ -173,6 +173,44 @@ def _count_distinct_per_group(
     return vals
 
 
+def _canon_distinct_traced(x):
+    """Traced twin of `_distinct_values`' canonicalization (all NaNs one value,
+    -0.0 == +0.0, floats viewed as int64 bit patterns) so the device
+    count-distinct compares the same value identities the host oracle does."""
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        d = x.astype(jnp.float64)
+        d = jnp.where(jnp.isnan(d), jnp.float64(np.nan), d)
+        d = jnp.where(d == 0.0, jnp.float64(0.0), d)
+        return jax.lax.bitcast_convert_type(d, jnp.int64)
+    if x.dtype == jnp.bool_:
+        return x.astype(jnp.int32)
+    return x
+
+
+@_partial(jax.jit, static_argnums=(0, 1))
+def _count_distinct_dev_jit(n_groups: int, has_valid: bool, gid, perm, x, valid=None):
+    """Per-group exact distinct counts ON DEVICE, for rows already run through
+    the group-id program (`gid`/`perm` from `_group_ids_fused`): sort each
+    group's values adjacent (invalid slots to the back of their group), count
+    first-of-run valid slots per group. Exactness matches the host
+    `_count_distinct_per_group` (actual canonicalized values, never hashes)."""
+    xs = _canon_distinct_traced(x)[perm]
+    v = valid[perm] if has_valid else jnp.ones(xs.shape[0], bool)
+    # lexsort: LAST key is primary → (value, invalid-last, group).
+    order = jnp.lexsort((xs, ~v, gid))
+    sg = gid[order]
+    sx = xs[order]
+    sv = v[order]
+    first = jnp.concatenate(
+        [jnp.ones(1, bool), (sg[1:] != sg[:-1]) | (sx[1:] != sx[:-1])]
+    )
+    # Valids sort before invalids within a group, so a valid slot never follows
+    # an invalid one of the same group: `first & valid` counts distinct valids.
+    return jax.ops.segment_sum(
+        (first & sv).astype(jnp.int64), sg, num_segments=n_groups
+    )
+
+
 def _global_aggregate(table: Table, aggs: Sequence[AggTriple]) -> Table:
     """No group keys: one output row (SQL global aggregate; empty input gives
     count=0 and NULL sum/min/max/avg)."""
@@ -300,10 +338,12 @@ def _segment_reduce_host(
     over the sorted rows at the group-start offsets. The device branch's
     `_seg_reduce_jit` on XLA-CPU pays an upload per 8M-row column plus a
     single-threaded segment scatter — measured ~0.65 s per aggregate at 8M,
-    vs ~0.1 s for the gather+reduceat pair here. Same (values, validity)
-    contract as `_segment_reduce`."""
+    vs ~0.1 s for the gather+reduceat pair here. Returns (values, n_valid):
+    the per-group non-null input counts, from which callers derive the
+    all-null-group validity (and which the streaming carry accumulates)."""
     if fn == "count" and col is None:
-        return seg_rows.astype(np.int64), None
+        n_rows = seg_rows.astype(np.int64)
+        return n_rows, n_rows
     assert col is not None
     has_valid = col.validity is not None
     sv = col.validity[perm] if has_valid else None
@@ -313,8 +353,7 @@ def _segment_reduce_host(
         else seg_rows.astype(np.int64)
     )
     if fn == "count":
-        return n_valid, None
-    any_valid = n_valid > 0
+        return n_valid, n_valid
     xs = col.data[perm]
     if fn in ("sum", "avg"):
         acc = xs.astype(_acc_dtype(xs.dtype))
@@ -322,15 +361,15 @@ def _segment_reduce_host(
             acc = np.where(sv, acc, 0)
         s = np.add.reduceat(acc, starts)
         if fn == "sum":
-            return s, any_valid
-        return s.astype(np.float64) / np.maximum(n_valid, 1), any_valid
+            return s, n_valid
+        return s.astype(np.float64) / np.maximum(n_valid, 1), n_valid
     # min/max: mask nulls to the opposite extreme; all-null groups are invalid.
     if xs.dtype == np.bool_:
         xs = xs.astype(np.int32)
     if has_valid:
         xs = np.where(sv, xs, _minmax_fill(xs.dtype, fn))
     op = np.minimum if fn == "min" else np.maximum
-    return op.reduceat(xs, starts), any_valid
+    return op.reduceat(xs, starts), n_valid
 
 
 def _key_records(table: Table, group_keys) -> np.ndarray:
@@ -507,19 +546,14 @@ def hash_aggregate_device(
 _DIRECT_CELL_BUDGET = 1 << 22
 
 
-def _direct_host_aggregate(
-    table: Table, group_keys, key_cols, aggs: Sequence[AggTriple]
-) -> Optional[Table]:
-    """Sort-free host aggregation for bounded-range integer/dictionary keys:
-    each key tuple maps to a dense cell id (mixed-radix over per-key value
-    ranges) and every aggregate is one `np.bincount` pass — no 8M-row argsort
-    (measured 0.58 s of the 8M CPU Q3 aggregate) and no representative-row
-    gather (key values are reconstructed from the cell id). Returns None
-    whenever the shape doesn't apply — the sort path is always correct:
-    float or null-able keys, unbounded ranges, or min/max aggregates (which
-    have no vectorized direct-address form; `ufunc.at` is slower than the
-    sort)."""
-    n = table.num_rows
+def _direct_layout(key_cols, aggs: Sequence[AggTriple]):
+    """Eligibility + cell layout of the direct-address host aggregation:
+    (los, ranges, datas, strides, cells), or None when the shape doesn't
+    apply (float or null-able keys, unbounded ranges, min/max aggregates).
+    The ONE home of this decision: `_direct_host_aggregate` takes it over the
+    full key columns, and the streaming finalizer re-derives it over the
+    carried group keys (whose value ranges/dictionaries equal the full
+    columns') to reproduce the same output order."""
     for _, fn, _ in aggs:
         if fn in ("min", "max"):
             return None
@@ -534,6 +568,8 @@ def _direct_host_aggregate(
             data = data.astype(np.int64)
             lo, hi = 0, 1
         elif np.issubdtype(data.dtype, np.integer):
+            if len(data) == 0:
+                return None
             lo, hi = int(data.min()), int(data.max())
         else:
             return None
@@ -545,11 +581,29 @@ def _direct_host_aggregate(
         cells *= r
         if cells > _DIRECT_CELL_BUDGET:
             return None
-
-    # Mixed-radix cell id per row: last key fastest (row-major).
+    # Mixed-radix cell id strides: last key fastest (row-major).
     strides = [1] * len(ranges)
     for i in range(len(ranges) - 2, -1, -1):
         strides[i] = strides[i + 1] * ranges[i + 1]
+    return los, ranges, datas, strides, cells
+
+
+def _direct_host_aggregate(
+    table: Table, group_keys, key_cols, aggs: Sequence[AggTriple]
+) -> Optional[Table]:
+    """Sort-free host aggregation for bounded-range integer/dictionary keys:
+    each key tuple maps to a dense cell id (mixed-radix over per-key value
+    ranges) and every aggregate is one `np.bincount` pass — no 8M-row argsort
+    (measured 0.58 s of the 8M CPU Q3 aggregate) and no representative-row
+    gather (key values are reconstructed from the cell id). Returns None
+    whenever the shape doesn't apply (`_direct_layout`) — the sort path is
+    always correct."""
+    n = table.num_rows
+    layout = _direct_layout(key_cols, aggs)
+    if layout is None:
+        return None
+    los, ranges, datas, strides, cells = layout
+
     gid0 = np.zeros(n, np.int64)
     for data, lo, st in zip(datas, los, strides):
         gid0 += (data.astype(np.int64) - lo) * st
@@ -686,6 +740,20 @@ def hash_aggregate(table: Table, group_keys, aggs: Sequence[AggTriple]) -> Table
         col = table.column(col_name) if col_name is not None else None
         dtype = result_dtype(fn, None if col is None else col.dtype)
         if fn == "count_distinct":
+            if device:
+                # The group-id program already ran on device: keep the distinct
+                # dedup there too (sort-adjacent + first-of-run counting on
+                # actual values) instead of pulling gid/perm and the column to
+                # the host. The host path below stays the pinned oracle.
+                has_v = col.validity is not None
+                args = (device_array(col.data),)
+                if has_v:
+                    args = args + (device_array(col.validity),)
+                vals = np.asarray(
+                    _count_distinct_dev_jit(int(n_groups), has_v, gid, perm, *args)
+                )
+                reduced.append((out_name, fn, col, dtype, vals, None))
+                continue
             # Exact distinct: dedupe (group, value) pairs on host (same exactness
             # contract as the collision-repair path).
             if gid_of_row is None:
@@ -700,9 +768,10 @@ def hash_aggregate(table: Table, group_keys, aggs: Sequence[AggTriple]) -> Table
         if device:
             vals, validity = _segment_reduce(fn, col, gid, perm, n_groups, seg_rows)
         else:
-            vals, validity = _segment_reduce_host(
+            vals, n_valid = _segment_reduce_host(
                 fn, col, perm_np, starts_np, seg_rows_np
             )
+            validity = None if fn == "count" else n_valid > 0
         reduced.append((out_name, fn, col, dtype, vals, validity))
 
     # Representative row of each group → materialize the key columns on host.
@@ -723,3 +792,476 @@ def hash_aggregate(table: Table, group_keys, aggs: Sequence[AggTriple]) -> Table
     for out_name, fn, col, dtype, vals, validity in reduced:
         out[out_name] = _out_column(fn, col, dtype, vals, validity)
     return Table(out)
+
+
+# ---------------------------------------------------------------------------
+# Streaming chunk-carry aggregation (the read-side pipeline's reduce stage)
+# ---------------------------------------------------------------------------
+
+#: Aggregate functions the chunk-carry stream supports. count_distinct is
+#: excluded by design: its state is a per-group value SET, not a scalar.
+STREAMING_AGG_FNS = ("count", "sum", "avg", "min", "max")
+
+_STATE_PREFIX = "__hs_"
+
+
+def streaming_agg_supported(group_keys, aggs: Sequence[AggTriple]) -> bool:
+    """Whether this GROUP BY shape can run as a chunk-carry stream: grouped
+    (global aggregates keep the one-pass host path), scalar-state functions
+    only, and no group key colliding with the internal state-column names."""
+    if not group_keys:
+        return False
+    if any(fn not in STREAMING_AGG_FNS for _, fn, _ in aggs):
+        return False
+    return not any(str(k).startswith(_STATE_PREFIX) for k in group_keys)
+
+
+def _pow2_ceil(n: int) -> int:
+    return 1 << max(n - 1, 1).bit_length()
+
+
+def _pad_repeat_first(a: np.ndarray, cap: int) -> np.ndarray:
+    """Pad to `cap` rows by REPEATING the first row: pad slots join a real
+    group (they duplicate real key values) and are masked out of every
+    reduction by the row-validity lane — the same padding contract as the
+    fused join→aggregate's compacted pair arrays."""
+    if len(a) == cap:
+        return a
+    return np.concatenate([a, np.broadcast_to(a[:1], (cap - len(a),))])
+
+
+# Per-arity compiled reducers (the arity is the flat lane count; donation
+# wants a static argnum tuple, so each arity gets its own jitted wrapper).
+_STREAM_REDUCE_FNS: dict = {}
+
+
+def _stream_reduce_fn(n_flat: int, donate: bool):
+    """ALL of one chunk's segment reductions as ONE compiled program, with a
+    row-validity lane ANDed into every aggregate (pad slots and, when a
+    caller fuses a filter, masked-out rows contribute nothing). `n_seg` is
+    pow2-quantized by the caller so growing group counts share programs.
+    With `donate`, the one-shot staged chunk lanes (and gid/perm/row_valid)
+    are donated so XLA reuses their buffers across chunks."""
+    key = (n_flat, donate)
+    fn = _STREAM_REDUCE_FNS.get(key)
+    if fn is not None:
+        return fn
+
+    def body(specs, n_seg, gid, perm, row_valid, *flat):
+        out = []
+        i = 0
+        for sfn, has_valid in specs:
+            x = flat[i]
+            i += 1
+            v = row_valid
+            if has_valid:
+                v = flat[i] & row_valid
+                i += 1
+            out.extend(_seg_reduce_body(sfn, n_seg, True, gid, perm, x, v))
+        return tuple(out)
+
+    donate_argnums = tuple(range(2, 5 + n_flat)) if donate else ()
+    fn = jax.jit(body, static_argnums=(0, 1), donate_argnums=donate_argnums)
+    _STREAM_REDUCE_FNS[key] = fn
+    return fn
+
+
+class StreamAggregator:
+    """Chunk-carry GROUP BY: feed table chunks with `add_chunk`, read the
+    final aggregate with `finalize`.
+
+    Each chunk reduces to per-group PARTIAL STATES through the same machinery
+    the one-pass `hash_aggregate` uses (key64 hash-sort, adjacent-ACTUAL-value
+    boundaries, segment reductions — host `reduceat` on the CPU backend, the
+    fused jitted programs on the device path with pow2-quantized chunk shapes
+    and donated staging buffers). States are (value, n_valid) pairs — avg
+    carries (sum, count) — packaged as a small state TABLE (group keys + state
+    columns), and carried states merge by exact key records (`_key_records`
+    over the concatenated state tables, so string codes re-encode through
+    union dictionaries and a 64-bit hash collision can never merge two
+    groups). Merging is deferred until pending partials outgrow the carry
+    (compaction), which keeps memory bounded without re-sorting the carry per
+    chunk; the left-to-right chunk fold order is preserved regardless of
+    compaction cadence, so results do not depend on prefetch/thread counts.
+
+    Float sum/avg accumulate per chunk then across chunks, which reorders the
+    additions relative to the one-pass path — results match it exactly for
+    integer/count/min/max outputs and to float-associativity rounding for
+    float sums (docs/query-pipeline.md).
+
+    `finalize` emits groups in the one-pass path's output order: the
+    direct-address cell order when `hash_aggregate`'s host fast path would
+    have taken it (`_direct_layout` on the carried keys reproduces the same
+    decision), else ascending key64."""
+
+    def __init__(self, group_keys, aggs: Sequence[AggTriple], stages=None):
+        self.group_keys = list(group_keys)
+        self.aggs = [tuple(a) for a in aggs]
+        if not streaming_agg_supported(self.group_keys, self.aggs):
+            raise HyperspaceException("aggregate shape not streamable")
+        self._stages = stages
+        self._carry: Optional[Table] = None
+        self._pending: list = []
+        self._pending_rows = 0
+        self._in_dtypes: list = [None] * len(self.aggs)
+        self.chunks = 0
+        self.rows = 0
+
+    def _timed(self, stage: str):
+        if self._stages is None:
+            import contextlib
+
+            return contextlib.nullcontext()
+        return self._stages.timed(stage)
+
+    # -- per-chunk partial ---------------------------------------------------
+
+    def add_chunk(self, t: Table) -> None:
+        # Dtype tracking BEFORE the empty-chunk return: a mixed-width source
+        # whose wider-typed file is empty (or fully filtered) still promotes
+        # in the one-pass path's concat, so it must promote here too.
+        for i, (_out, fn, cname) in enumerate(self.aggs):
+            if cname is not None:
+                # Track the PROMOTED input dtype across chunks — a mixed-width
+                # multi-file source promotes in the one-pass path's concat, so
+                # the streamed result dtype must promote identically.
+                cur = self._in_dtypes[i]
+                new = t.column(cname).dtype
+                if cur is None or cur == new or STRING in (cur, new):
+                    self._in_dtypes[i] = new if cur is None else cur
+                else:
+                    from ..engine.schema import dtype_from_numpy
+
+                    self._in_dtypes[i] = dtype_from_numpy(
+                        np.promote_types(np.dtype(cur), np.dtype(new))
+                    )
+        if t.num_rows == 0:
+            return
+        from .backend import use_device_path
+
+        with self._timed("partial"):
+            partial = (
+                self._partial_device(t) if use_device_path() else self._partial_host(t)
+            )
+        self.chunks += 1
+        self.rows += t.num_rows
+        self._pending.append(partial)
+        self._pending_rows += partial.num_rows
+        carry_rows = self._carry.num_rows if self._carry is not None else 0
+        if self._pending_rows >= max(1 << 16, carry_rows):
+            with self._timed("merge"):
+                self._compact()
+
+    def _state_table(self, rep_keys: Table, states: list) -> Table:
+        """Assemble the state-layout table: group keys + per-agg value/count
+        columns (value codes of all-null groups clamped to 0 so string state
+        columns always index their dictionaries)."""
+        out = dict(rep_keys.columns)
+        for i, (vals_col, n_valid) in enumerate(states):
+            if vals_col is not None:
+                out[f"{_STATE_PREFIX}v{i}"] = vals_col
+            out[f"{_STATE_PREFIX}n{i}"] = Column(
+                INT64, np.asarray(n_valid, np.int64).copy()
+            )
+        return Table(out)
+
+    def _pack_state_col(
+        self, fn: str, vals: np.ndarray, n_valid: np.ndarray, dictionary
+    ) -> Column:
+        anyv = n_valid > 0
+        if dictionary is not None:
+            codes = np.where(anyv, vals, 0).astype(np.int32)
+            return Column(STRING, codes, dictionary, anyv.copy())
+        data = np.where(anyv, vals, np.zeros((), dtype=np.asarray(vals).dtype))
+        from ..engine.schema import dtype_from_numpy
+
+        return Column(dtype_from_numpy(data.dtype), data, None, anyv.copy())
+
+    def _partial_host(self, t: Table) -> Table:
+        from .join import stable_argsort_host
+
+        n = t.num_rows
+        key_cols = [t.column(k) for k in self.group_keys]
+        layout = _direct_layout(key_cols, self.aggs)
+        if layout is not None:
+            # Bounded-range keys: the chunk partial is a handful of bincount
+            # passes instead of a per-chunk hash-sort — the same trade
+            # `_direct_host_aggregate` makes for the one-pass path.
+            return self._partial_host_direct(t, key_cols, layout)
+        k64 = key64(key_cols, [device_array(c.data) for c in key_cols])
+        perm = stable_argsort_host(k64)
+        flat_host, has_valid = [], []
+        for c in key_cols:
+            flat_host.append(c.data)
+            has_valid.append(c.validity is not None)
+            if c.validity is not None:
+                flat_host.append(c.validity)
+        _boundary, gid = _group_ids_body(tuple(has_valid), perm, flat_host, xp=np)
+        starts = np.nonzero(_boundary)[0]
+        seg_rows = np.diff(np.append(starts, n))
+        rep_keys = t.select(self.group_keys).take(perm[starts])
+        states = []
+        for _out, fn, cname in self.aggs:
+            col = t.column(cname) if cname is not None else None
+            sfn = "sum" if fn == "avg" else fn
+            vals, n_valid = _segment_reduce_host(sfn, col, perm, starts, seg_rows)
+            if fn == "count":
+                states.append((None, n_valid))
+                continue
+            states.append(
+                (
+                    self._pack_state_col(
+                        fn, vals, n_valid, col.dictionary if col.is_string else None
+                    ),
+                    n_valid,
+                )
+            )
+        return self._state_table(rep_keys, states)
+
+    def _partial_host_direct(self, t: Table, key_cols, layout) -> Table:
+        """Direct-address chunk partial: dense mixed-radix cells + bincount
+        reductions (`_direct_layout` already proved eligibility: null-free
+        bounded int/bool/dictionary keys, no min/max). State contract is
+        identical to the sort-based partial; only the internal group order of
+        the partial differs, which the record-keyed merge erases."""
+        n = t.num_rows
+        los, ranges, datas, strides, cells = layout
+        gid0 = np.zeros(n, np.int64)
+        for data, lo, st in zip(datas, los, strides):
+            gid0 += (data.astype(np.int64) - lo) * st
+        counts = np.bincount(gid0, minlength=cells)
+        present = np.nonzero(counts)[0]
+        counts_p = counts[present].astype(np.int64)
+
+        rep_cols = {}
+        for k, c, lo, rng, st in zip(
+            self.group_keys, key_cols, los, ranges, strides
+        ):
+            vals = lo + (present // st) % rng
+            if c.is_string:
+                rep_cols[k] = Column(
+                    STRING, vals.astype(np.int32), c.dictionary, None
+                )
+            else:
+                rep_cols[k] = Column(c.dtype, vals.astype(c.data.dtype), None, None)
+
+        states = []
+        for _out, fn, cname in self.aggs:
+            col = t.column(cname) if cname is not None else None
+            if fn == "count" and col is None:
+                states.append((None, counts_p))
+                continue
+            valid = col.validity
+            if valid is None:
+                nv = counts_p
+            else:
+                nv = np.bincount(gid0[valid], minlength=cells)[present].astype(
+                    np.int64
+                )
+            if fn == "count":
+                states.append((None, nv))
+                continue
+            # sum / avg state (avg carries its sum): exact int64 accumulation
+            # for ints (bincount weights are float64 and would round past
+            # 2^53), float64 bincount for floats.
+            data = col.data
+            if np.issubdtype(data.dtype, np.floating):
+                w = data.astype(np.float64)
+                g = gid0
+                if valid is not None:
+                    w, g = w[valid], g[valid]
+                s = np.bincount(g, weights=w, minlength=cells)[present]
+            else:
+                acc = data.astype(np.int64)
+                g = gid0
+                if valid is not None:
+                    acc, g = acc[valid], g[valid]
+                s = np.zeros(cells, np.int64)
+                np.add.at(s, g, acc)
+                s = s[present]
+            states.append((self._pack_state_col(fn, s, nv, None), nv))
+        return self._state_table(Table(rep_cols), states)
+
+    def _partial_device(self, t: Table) -> Table:
+        """Device twin of `_partial_host`: pow2-padded staged lanes, the fused
+        group-id program, then every reduction in one compiled (and
+        buffer-donating, off-CPU) program quantized to pow2 segment counts."""
+        n = t.num_rows
+        cap = _pow2_ceil(n)
+        key_cols = [t.column(k) for k in self.group_keys]
+        staged_keys = [
+            jax.device_put(_pad_repeat_first(c.data, cap)) for c in key_cols
+        ]
+        k64 = key64(key_cols, staged_keys)
+        flat, has_valid = [], []
+        staged_valid = []
+        for c, arr in zip(key_cols, staged_keys):
+            flat.append(arr)
+            has_valid.append(c.validity is not None)
+            if c.validity is not None:
+                sv = jax.device_put(_pad_repeat_first(c.validity, cap))
+                staged_valid.append(sv)
+                flat.append(sv)
+            else:
+                staged_valid.append(None)
+        perm, boundary, gid = _group_ids_fused(tuple(has_valid), k64, *flat)
+        n_groups = int(gid[-1]) + 1  # the one scalar sync per chunk
+        n_seg = _pow2_ceil(n_groups)
+        rep_rows = perm[jnp.nonzero(boundary, size=n_seg, fill_value=0)[0]]
+
+        # Representative key rows (gathered BEFORE the reduce so its donated
+        # buffers are never read afterwards).
+        rep_cols = {}
+        for k, c, arr, sv in zip(
+            self.group_keys, key_cols, staged_keys, staged_valid
+        ):
+            data = np.asarray(arr[rep_rows])[:n_groups]
+            v = (
+                None
+                if sv is None
+                else np.asarray(sv[rep_rows], dtype=bool)[:n_groups].copy()
+            )
+            if c.is_string:
+                codes = data.astype(np.int32)
+                if v is not None:
+                    codes = np.where(v, codes, 0).astype(np.int32)
+                rep_cols[k] = Column(STRING, codes, c.dictionary, v)
+            else:
+                if v is not None:
+                    data = np.where(v, data, np.zeros((), dtype=data.dtype))
+                rep_cols[k] = Column(c.dtype, data.astype(c.data.dtype), None, v)
+
+        specs, lanes = [], []
+        for _out, fn, cname in self.aggs:
+            col = t.column(cname) if cname is not None else None
+            sfn = "sum" if fn == "avg" else fn
+            if fn == "count" and col is None:
+                # count(*): the row-validity lane IS the data.
+                specs.append(("count", False))
+                lanes.append(jnp.zeros(cap, jnp.int32))
+                continue
+            specs.append((sfn, col.validity is not None))
+            lanes.append(jax.device_put(_pad_repeat_first(col.data, cap)))
+            if col.validity is not None:
+                lanes.append(jax.device_put(_pad_repeat_first(col.validity, cap)))
+        row_valid = jnp.arange(cap) < n
+        donate = jax.default_backend() != "cpu"
+        results = jax.device_get(
+            _stream_reduce_fn(len(lanes), donate)(
+                tuple(specs), n_seg, gid, perm, row_valid, *lanes
+            )
+        )
+        states = []
+        for i, (_out, fn, cname) in enumerate(self.aggs):
+            vals = np.asarray(results[2 * i])[:n_groups]
+            n_valid = np.asarray(results[2 * i + 1])[:n_groups]
+            if fn == "count":
+                states.append((None, n_valid))
+                continue
+            col = t.column(cname)
+            states.append(
+                (
+                    self._pack_state_col(
+                        fn, vals, n_valid, col.dictionary if col.is_string else None
+                    ),
+                    n_valid,
+                )
+            )
+        return self._state_table(Table(rep_cols), states)
+
+    # -- carry merge ---------------------------------------------------------
+
+    def _compact(self) -> None:
+        parts = ([self._carry] if self._carry is not None else []) + self._pending
+        self._pending = []
+        self._pending_rows = 0
+        if not parts:
+            return
+        if len(parts) == 1:
+            self._carry = parts[0]
+            return
+        # Concat re-encodes string keys AND string min/max states over union
+        # dictionaries, so codes are comparable across chunks.
+        pt = Table.concat(parts)
+        recs = _key_records(pt, self.group_keys)
+        uniq, first_idx, inverse = np.unique(
+            recs, return_index=True, return_inverse=True
+        )
+        n_groups = len(uniq)
+        out = dict(pt.select(self.group_keys).take(first_idx).columns)
+        for i, (_out, fn, _cname) in enumerate(self.aggs):
+            contrib = pt.column(f"{_STATE_PREFIX}n{i}").data
+            nv = np.zeros(n_groups, np.int64)
+            np.add.at(nv, inverse, contrib)
+            out[f"{_STATE_PREFIX}n{i}"] = Column(INT64, nv)
+            if fn == "count":
+                continue
+            vcol = pt.column(f"{_STATE_PREFIX}v{i}")
+            mask = contrib > 0
+            sfn = "sum" if fn == "avg" else fn
+            if sfn == "sum":
+                acc = np.zeros(n_groups, vcol.data.dtype)
+                # np.add.at folds in row order (carry first, then chunks in
+                # arrival order) — the float fold stays left-to-right across
+                # any compaction cadence.
+                np.add.at(acc, inverse[mask], vcol.data[mask])
+            else:
+                acc = np.full(
+                    n_groups,
+                    _minmax_fill(vcol.data.dtype, sfn),
+                    vcol.data.dtype,
+                )
+                op = np.minimum if sfn == "min" else np.maximum
+                op.at(acc, inverse[mask], vcol.data[mask])
+            out[f"{_STATE_PREFIX}v{i}"] = self._pack_state_col(
+                fn, acc, nv, vcol.dictionary if vcol.is_string else None
+            )
+        self._carry = Table(out)
+
+    # -- finalize ------------------------------------------------------------
+
+    def _output_order(self, key_cols) -> np.ndarray:
+        """Group output order of the ONE-PASS path: the direct-address cell
+        order when its host fast path would have applied (the carried keys
+        reproduce the same layout decision), ascending key64 otherwise."""
+        from .backend import use_device_path
+
+        if not use_device_path():
+            layout = _direct_layout(key_cols, self.aggs)
+            if layout is not None:
+                los, _ranges, datas, strides, _cells = layout
+                gid0 = np.zeros(len(key_cols[0]), np.int64)
+                for data, lo, st in zip(datas, los, strides):
+                    gid0 += (data.astype(np.int64) - lo) * st
+                return np.argsort(gid0, kind="stable")
+        k64 = np.asarray(
+            key64(key_cols, [device_array(c.data) for c in key_cols])
+        )
+        return np.argsort(k64, kind="stable")
+
+    def finalize(self) -> Optional[Table]:
+        """The aggregate over everything streamed so far; None when no chunk
+        carried rows (the caller owns the empty-input result shape)."""
+        with self._timed("merge"):
+            self._compact()
+        if self._carry is None:
+            return None
+        carry = self._carry
+        key_cols = [carry.column(k) for k in self.group_keys]
+        with self._timed("finalize"):
+            order = self._output_order(key_cols)
+            out = {}
+            for k in self.group_keys:
+                out[k] = carry.column(k).take(order)
+            for i, (out_name, fn, _cname) in enumerate(self.aggs):
+                nv = carry.column(f"{_STATE_PREFIX}n{i}").data[order]
+                dtype = result_dtype(fn, self._in_dtypes[i])
+                if fn == "count":
+                    out[out_name] = _out_column(fn, None, dtype, nv, None)
+                    continue
+                vcol = carry.column(f"{_STATE_PREFIX}v{i}").take(order)
+                vals = vcol.data
+                if fn == "avg":
+                    vals = vals.astype(np.float64) / np.maximum(nv, 1)
+                out[out_name] = _out_column(fn, vcol, dtype, vals, nv > 0)
+        return Table(out)

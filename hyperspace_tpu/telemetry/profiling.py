@@ -72,10 +72,11 @@ class StageTimings:
             return out
 
 
-# Most recent index-build stage summaries (newest last), consumed by
-# bench.py's bench_detail. Bounded: telemetry must never grow with the
-# number of builds a long-lived session performs.
+# Most recent index-build / streaming-query stage summaries (newest last),
+# consumed by bench.py's bench_detail. Bounded: telemetry must never grow with
+# the number of builds/queries a long-lived session performs.
 _BUILD_STAGES: "deque[dict]" = deque(maxlen=16)
+_QUERY_STAGES: "deque[dict]" = deque(maxlen=16)
 _build_stages_lock = threading.Lock()
 
 
@@ -94,6 +95,27 @@ def build_stages_history() -> list:
     """Stage summaries of the last few builds, oldest first."""
     with _build_stages_lock:
         return [dict(d) for d in _BUILD_STAGES]
+
+
+def record_query_stages(summary: dict) -> None:
+    """Per-stage timings of one streaming query execution (decode/filter/
+    partial/merge busy time + wall + overlap ratio) — the read-side twin of
+    `record_build_stages`, surfaced through bench.py's
+    ``bench_detail.query_stages``."""
+    with _build_stages_lock:
+        _QUERY_STAGES.append(dict(summary))
+
+
+def last_query_stages() -> Optional[dict]:
+    """The most recent streaming query's stage summary (None if none ran)."""
+    with _build_stages_lock:
+        return dict(_QUERY_STAGES[-1]) if _QUERY_STAGES else None
+
+
+def query_stages_history() -> list:
+    """Stage summaries of the last few streaming queries, oldest first."""
+    with _build_stages_lock:
+        return [dict(d) for d in _QUERY_STAGES]
 
 
 @contextlib.contextmanager

@@ -1,0 +1,467 @@
+"""Streaming scan→filter→aggregate executor: equivalence, fault, and carry
+semantics (ISSUE 2 tentpole).
+
+The contract under test: with ``HYPERSPACE_QUERY_STREAMING`` on (the default),
+a grouped aggregate over a multi-file scan runs chunked with accumulator carry
+and equals the materialized path — exactly for integer/count/min/max/string
+outputs and group order, to float-associativity rounding for float sum/avg.
+``HYPERSPACE_QUERY_STREAMING=0`` is the byte-identical materialized fallback.
+A decoder fault mid-stream fails the query cleanly and poisons no scan-cache
+entries. The general-join pairs memo (the same PR's satellite perf fix) is
+covered at the bottom.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu.engine import HyperspaceSession, col
+from hyperspace_tpu.engine import io as engine_io
+from hyperspace_tpu.engine.table import Table
+
+
+def _rows_close(a, b, tol=1e-9):
+    assert len(a) == len(b), (len(a), len(b))
+    for ra, rb in zip(a, b):
+        assert len(ra) == len(rb)
+        for x, y in zip(ra, rb):
+            if isinstance(x, float) and isinstance(y, float):
+                assert abs(x - y) <= tol * max(1.0, abs(x)), (ra, rb)
+            else:
+                assert x == y, (ra, rb)
+
+
+def _write_files(base, name, data, n_files):
+    n = len(next(iter(data.values())))
+    per = (n + n_files - 1) // n_files
+    for i in range(n_files):
+        sl = slice(i * per, min((i + 1) * per, n))
+        if sl.start >= n:
+            break
+        engine_io.write_parquet(
+            Table.from_pydict({k: list(v[sl]) for k, v in data.items()}),
+            os.path.join(base, name, f"part-{i:05d}.parquet"),
+        )
+
+
+N_FILES = 6
+
+
+@pytest.fixture()
+def stream_session(tmp_path):
+    rng = np.random.RandomState(7)
+    n = 6000
+    grp = rng.randint(0, 40, n).astype(np.int64)
+    sgrp = np.array([f"g{i:02d}" if i % 7 else None for i in grp], dtype=object)
+    amount = rng.randint(-50, 50, n).astype(object)
+    amount[::11] = None
+    price = (rng.rand(n) * 100).astype(object)
+    price[::13] = None
+    tag = np.array([f"t{i % 17:02d}" for i in rng.randint(0, 999, n)], dtype=object)
+    tag[::19] = None
+    flag = rng.randint(0, 2, n).astype(bool)
+    _write_files(
+        str(tmp_path),
+        "src",
+        {
+            "grp": grp,
+            "sgrp": sgrp,
+            "amount": amount,
+            "price": price,
+            "tag": tag,
+            "flag": flag,
+        },
+        N_FILES,
+    )
+    s = HyperspaceSession(warehouse=str(tmp_path))
+    return s, os.path.join(str(tmp_path), "src")
+
+
+ALL_AGGS = dict(
+    rows=("*", "count"),
+    n=("amount", "count"),
+    s=("amount", "sum"),
+    sp=("price", "sum"),
+    a=("price", "avg"),
+    lo=("amount", "min"),
+    hi=("amount", "max"),
+    tmin=("tag", "min"),
+    tmax=("tag", "max"),
+    fmin=("flag", "min"),
+)
+
+
+def _on_off(monkeypatch, q):
+    """(streamed result, materialized result, streaming stage summary)."""
+    from hyperspace_tpu.telemetry.profiling import last_query_stages
+
+    monkeypatch.setenv("HYPERSPACE_QUERY_STREAMING", "1")
+    before = last_query_stages()
+    streamed = q().collect()
+    stages = last_query_stages()
+    ran_stream = stages is not None and stages is not before and stages != before
+    monkeypatch.setenv("HYPERSPACE_QUERY_STREAMING", "0")
+    materialized = q().collect()
+    return streamed, materialized, (stages if ran_stream else None)
+
+
+class TestStreamingEquivalence:
+    def test_every_agg_fn_multi_file(self, stream_session, monkeypatch):
+        s, src = stream_session
+
+        def q():
+            return s.read.parquet(src).group_by("grp").agg(**ALL_AGGS)
+
+        streamed, materialized, stages = _on_off(monkeypatch, q)
+        assert stages is not None, "streaming path did not run"
+        assert stages["chunks"] == N_FILES
+        assert stages["stage_counts"]["partial"] == N_FILES
+        _rows_close(streamed.sorted_rows(), materialized.sorted_rows())
+        # Group ORDER matches the one-pass path too (same key64/direct order).
+        assert [r[0] for r in streamed.rows()] == [
+            r[0] for r in materialized.rows()
+        ]
+
+    def test_string_null_group_keys_and_multi_key(self, stream_session, monkeypatch):
+        s, src = stream_session
+
+        def q():
+            return (
+                s.read.parquet(src)
+                .group_by("sgrp", "flag")
+                .agg(n=("*", "count"), s=("amount", "sum"), t=("tag", "max"))
+            )
+
+        streamed, materialized, stages = _on_off(monkeypatch, q)
+        assert stages is not None
+        _rows_close(streamed.sorted_rows(), materialized.sorted_rows())
+
+    def test_filter_withcolumn_project_chain(self, stream_session, monkeypatch):
+        s, src = stream_session
+
+        def q():
+            return (
+                s.read.parquet(src)
+                .filter((col("amount") > -20) & col("tag").is_not_null())
+                .with_column("rev", col("price") * (1 - col("amount") / 100))
+                .select("grp", "rev", "amount")
+                .group_by("grp")
+                .agg(r=("rev", "sum"), lo=("amount", "min"))
+            )
+
+        streamed, materialized, stages = _on_off(monkeypatch, q)
+        assert stages is not None
+        _rows_close(streamed.sorted_rows(), materialized.sorted_rows())
+
+    def test_empty_chunks_mid_stream(self, stream_session, monkeypatch):
+        """A filter wiping out entire files leaves empty chunks mid-stream."""
+        s, src = stream_session
+
+        def q():
+            # grp values are spread over all files; a tight range keeps few rows.
+            return (
+                s.read.parquet(src)
+                .filter(col("amount") == 17)
+                .group_by("grp")
+                .agg(n=("*", "count"), s=("amount", "sum"))
+            )
+
+        streamed, materialized, stages = _on_off(monkeypatch, q)
+        assert stages is not None
+        _rows_close(streamed.sorted_rows(), materialized.sorted_rows())
+        # count+sum over bounded null-free int keys: the one-pass host path
+        # takes the direct-address order, and streaming must reproduce it.
+        assert [r[0] for r in streamed.rows()] == [
+            r[0] for r in materialized.rows()
+        ]
+
+    def test_all_rows_filtered_empty_result(self, stream_session, monkeypatch):
+        s, src = stream_session
+
+        def q():
+            return (
+                s.read.parquet(src)
+                .filter(col("amount") == 10_000)  # matches nothing
+                .group_by("grp")
+                .agg(n=("*", "count"), s=("amount", "sum"), t=("tag", "min"))
+            )
+
+        streamed, materialized, _ = _on_off(monkeypatch, q)
+        assert streamed.num_rows == 0 == materialized.num_rows
+        assert streamed.column_names == materialized.column_names
+        assert streamed.schema.names == materialized.schema.names
+        assert [f.dtype for f in streamed.schema.fields] == [
+            f.dtype for f in materialized.schema.fields
+        ]
+
+    def test_mixed_width_promotion_with_filtered_file(self, tmp_path, monkeypatch):
+        """A wider-typed file whose rows are entirely filtered out must still
+        promote the result dtype, exactly as the materialized path's concat
+        does — including for the all-rows-filtered empty result."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        s = HyperspaceSession(warehouse=str(tmp_path))
+        src = tmp_path / "mixed"
+        os.makedirs(src)
+        pq.write_table(
+            pa.table(
+                {
+                    "g": pa.array([1, 2, 1], type=pa.int64()),
+                    "x": pa.array([5, 6, 7], type=pa.int32()),
+                    "keep": pa.array([1, 1, 1], type=pa.int64()),
+                }
+            ),
+            str(src / "part-00000.parquet"),
+        )
+        pq.write_table(
+            pa.table(
+                {
+                    "g": pa.array([2, 3], type=pa.int64()),
+                    "x": pa.array([8, 9], type=pa.int64()),
+                    "keep": pa.array([0, 0], type=pa.int64()),
+                }
+            ),
+            str(src / "part-00001.parquet"),
+        )
+
+        def q(keep):
+            return (
+                s.read.parquet(str(src))
+                .filter(col("keep") == keep)
+                .group_by("g")
+                .agg(hi=("x", "max"), sx=("x", "sum"))
+            )
+
+        streamed, materialized, stages = _on_off(monkeypatch, lambda: q(1))
+        assert stages is not None
+        assert streamed.sorted_rows() == materialized.sorted_rows()
+        assert [f.dtype for f in streamed.schema.fields] == [
+            f.dtype for f in materialized.schema.fields
+        ]
+        # All rows filtered: the empty result's schema still promotes.
+        streamed_e, materialized_e, _ = _on_off(monkeypatch, lambda: q(7))
+        assert streamed_e.num_rows == 0 == materialized_e.num_rows
+        assert [f.dtype for f in streamed_e.schema.fields] == [
+            f.dtype for f in materialized_e.schema.fields
+        ]
+
+    def test_chunk_rows_splitting(self, stream_session, monkeypatch):
+        """Sub-file chunking (HYPERSPACE_QUERY_CHUNK_ROWS) changes nothing."""
+        s, src = stream_session
+        from hyperspace_tpu.telemetry.profiling import last_query_stages
+
+        def q():
+            return s.read.parquet(src).group_by("grp").agg(**ALL_AGGS)
+
+        monkeypatch.setenv("HYPERSPACE_QUERY_STREAMING", "1")
+        monkeypatch.setenv("HYPERSPACE_QUERY_CHUNK_ROWS", "137")
+        chunked = q().collect()
+        assert last_query_stages()["chunks"] > N_FILES
+        monkeypatch.delenv("HYPERSPACE_QUERY_CHUNK_ROWS")
+        whole = q().collect()
+        _rows_close(chunked.sorted_rows(), whole.sorted_rows())
+
+
+class TestStreamingGating:
+    def test_single_file_source_stays_materialized(self, tmp_path, monkeypatch):
+        s = HyperspaceSession(warehouse=str(tmp_path))
+        s.write_parquet({"g": [1, 2, 1], "x": [1.0, 2.0, 3.0]}, str(tmp_path / "one"))
+        from hyperspace_tpu.telemetry.profiling import last_query_stages
+
+        monkeypatch.setenv("HYPERSPACE_QUERY_STREAMING", "1")
+        before = last_query_stages()
+        rows = (
+            s.read.parquet(str(tmp_path / "one"))
+            .group_by("g")
+            .agg(s=("x", "sum"))
+            .sorted_rows()
+        )
+        assert rows == [(1, 4.0), (2, 2.0)]
+        assert last_query_stages() == before  # no streaming run recorded
+
+    def test_count_distinct_falls_back(self, stream_session, monkeypatch):
+        s, src = stream_session
+        from hyperspace_tpu.telemetry.profiling import last_query_stages
+
+        monkeypatch.setenv("HYPERSPACE_QUERY_STREAMING", "1")
+        before = last_query_stages()
+
+        def q():
+            return (
+                s.read.parquet(src)
+                .group_by("grp")
+                .agg(d=("tag", "count_distinct"), n=("*", "count"))
+            )
+
+        streamed_era = q().collect()
+        assert last_query_stages() == before  # materialized path handled it
+        monkeypatch.setenv("HYPERSPACE_QUERY_STREAMING", "0")
+        assert streamed_era.sorted_rows() == q().collect().sorted_rows()
+
+    def test_env_zero_disables(self, stream_session, monkeypatch):
+        s, src = stream_session
+        from hyperspace_tpu.telemetry.profiling import last_query_stages
+
+        monkeypatch.setenv("HYPERSPACE_QUERY_STREAMING", "0")
+        before = last_query_stages()
+        s.read.parquet(src).group_by("grp").agg(n=("*", "count")).collect()
+        assert last_query_stages() == before
+
+
+class TestDecodePoolContract:
+    def test_decode_pool_size_honors_shared_knob(self, monkeypatch):
+        """Satellite: `read_files`/streaming/build share ONE threading knob;
+        `=1` is the serial path, explicit values cap the pool."""
+        from hyperspace_tpu.engine.io import decode_pool_size
+
+        monkeypatch.delenv("HYPERSPACE_BUILD_DECODE_THREADS", raising=False)
+        assert decode_pool_size(40) == 16
+        assert decode_pool_size(3) == 3
+        monkeypatch.setenv("HYPERSPACE_BUILD_DECODE_THREADS", "1")
+        assert decode_pool_size(40) == 1
+        monkeypatch.setenv("HYPERSPACE_BUILD_DECODE_THREADS", "4")
+        assert decode_pool_size(40) == 4
+        assert decode_pool_size(2) == 2
+
+    def test_streaming_serial_threads_equivalent(self, stream_session, monkeypatch):
+        s, src = stream_session
+
+        def q():
+            return s.read.parquet(src).group_by("grp").agg(**ALL_AGGS)
+
+        monkeypatch.setenv("HYPERSPACE_QUERY_STREAMING", "1")
+        monkeypatch.setenv("HYPERSPACE_BUILD_DECODE_THREADS", "1")
+        serial = q().collect()
+        monkeypatch.setenv("HYPERSPACE_BUILD_DECODE_THREADS", "3")
+        pooled = q().collect()
+        # Same fold order regardless of thread count: EXACT equality, floats
+        # included.
+        assert serial.rows() == pooled.rows()
+
+
+class TestStreamingFaults:
+    def test_decoder_fault_fails_clean_no_poisoned_cache(
+        self, stream_session, monkeypatch
+    ):
+        s, src = stream_session
+        from hyperspace_tpu.engine.scan_cache import global_scan_cache
+
+        global_scan_cache().clear()
+        files = sorted(os.listdir(src))
+        victim = os.path.join(src, files[3])
+        real = engine_io._read_one
+
+        def boom(path, file_format, columns=None):
+            if path == victim:
+                raise RuntimeError("injected decode fault")
+            return real(path, file_format, columns)
+
+        monkeypatch.setenv("HYPERSPACE_QUERY_STREAMING", "1")
+        monkeypatch.setattr(engine_io, "_read_one", boom)
+
+        def q():
+            return (
+                s.read.parquet(src)
+                .group_by("grp")
+                .agg(n=("*", "count"), s=("amount", "sum"))
+            )
+
+        with pytest.raises(RuntimeError, match="injected decode fault"):
+            q().collect()
+        # The failed file left nothing behind; cached neighbors are intact.
+        assert global_scan_cache().missing_columns(victim, ["grp", "amount"]) != []
+        monkeypatch.setattr(engine_io, "_read_one", real)
+        streamed = q().collect()
+        monkeypatch.setenv("HYPERSPACE_QUERY_STREAMING", "0")
+        _rows_close(streamed.sorted_rows(), q().collect().sorted_rows())
+
+
+class TestCountDistinctDevice:
+    def test_device_matches_host_oracle(self, monkeypatch):
+        """Satellite: grouped count_distinct runs on the device when the
+        group-id program did; the host path stays the pinned oracle."""
+        monkeypatch.setenv("HYPERSPACE_FORCE_DEVICE_OPS", "1")
+        from hyperspace_tpu.ops.aggregate import _host_aggregate, hash_aggregate
+
+        rng = np.random.RandomState(5)
+        n = 500
+        vals = rng.rand(n).astype(object)
+        vals[::9] = None
+        vals[1::9] = float("nan")
+        vals[2::9] = 0.0
+        vals[3::9] = -0.0
+        t = Table.from_pydict(
+            {
+                "k": rng.randint(0, 7, n).tolist(),
+                "f": list(vals),
+                "s": [f"u{i % 13}" for i in rng.randint(0, 40, n)],
+                "i": rng.randint(0, 9, n).tolist(),
+            }
+        )
+        aggs = [
+            ("df", "count_distinct", "f"),
+            ("ds", "count_distinct", "s"),
+            ("di", "count_distinct", "i"),
+        ]
+        got = hash_aggregate(t, ["k"], aggs)
+        exp = _host_aggregate(t, ["k"], aggs)
+        assert got.sorted_rows() == exp.sorted_rows()
+
+
+class TestGeneralJoinPairsMemo:
+    def test_pairs_computed_once_across_queries(self, tmp_path, monkeypatch):
+        """Steady-state general (non-bucketed) joins reuse the verified pair
+        memo instead of re-running the host sort+probe per query."""
+        import hyperspace_tpu.ops.join as ops_join
+        from hyperspace_tpu.engine import physical
+
+        s = HyperspaceSession(warehouse=str(tmp_path))
+        rng = np.random.RandomState(2)
+        n = 20_000
+        _write_files(
+            str(tmp_path),
+            "fact",
+            {
+                "k": rng.randint(0, 500, n).astype(np.int64),
+                "v": rng.randint(0, 100, n).astype(np.int64),
+            },
+            3,
+        )
+        # Two files per side: the memo keys on table identity, and only
+        # multi-file concats are object-stable across queries (single-file
+        # reads assemble a fresh Table from the per-column cache each time).
+        _write_files(
+            str(tmp_path),
+            "dim",
+            {
+                "dk": np.arange(500, dtype=np.int64),
+                "w": rng.randint(0, 9, 500).astype(np.int64),
+            },
+            2,
+        )
+
+        def q():
+            f = s.read.parquet(str(tmp_path / "fact"))
+            d = s.read.parquet(str(tmp_path / "dim"))
+            return (
+                f.join(d, col("k") == col("dk"))
+                .group_by("w")
+                .agg(s=("v", "sum"), n=("*", "count"))
+            )
+
+        calls = {"n": 0}
+        real = ops_join.merge_join_pairs
+
+        def counted(lk, rk):
+            calls["n"] += 1
+            return real(lk, rk)
+
+        monkeypatch.setattr(physical, "merge_join_pairs", counted)
+        first = q().collect().sorted_rows()
+        after_first = calls["n"]
+        assert after_first >= 1
+        second = q().collect().sorted_rows()
+        assert calls["n"] == after_first  # memo hit: no re-probe
+        _rows_close(first, second)
