@@ -471,6 +471,9 @@ def run_bench(deadline: float = None) -> dict:
 
         ph.run("stream_agg", stream_agg)
 
+        # -- streamed bucketed-join→aggregate (classed probe + chunked gather)
+        ph.run("join_stream", lambda: d.update(_join_stream_section(s, base, col, runs)))
+
         # -- workload variants (string join / filter / data skipping / hybrid)
         ph.run("variants", lambda: d.__setitem__(
             "variants", _variant_section(s, base, col, runs, hs)
@@ -539,6 +542,65 @@ def _stream_agg_section(s, base, col, runs) -> dict:
     return out
 
 
+def _join_stream_section(s, base, col, runs) -> dict:
+    """The streamed join→aggregate's own shape — the Q3 aggregate over the
+    covering indexes — measured COLD (scan caches + device memos cleared) with
+    streaming on vs the materialized fallback, plus the warm streamed p50.
+    `join_stages` records the per-stage busy times, overlap ratio, class/
+    outlier counts and pallas fallback counters of the streamed cold run."""
+    from hyperspace_tpu.engine.physical import clear_device_memos
+    from hyperspace_tpu.engine.scan_cache import (
+        global_bucketed_cache,
+        global_concat_cache,
+        global_scan_cache,
+    )
+    from hyperspace_tpu.hyperspace import enable_hyperspace
+    from hyperspace_tpu.telemetry.profiling import last_join_stages
+
+    enable_hyperspace(s)
+
+    def qja():
+        l = s.read.parquet(os.path.join(base, "lineitem"))
+        o = s.read.parquet(os.path.join(base, "orders"))
+        return (
+            l.join(o, col("orderkey") == col("o_orderkey"))
+            .with_column("revenue", col("price") * (1 - col("discount")))
+            .group_by("o_custkey")
+            .agg(revenue=("revenue", "sum"), n=("qty", "count"))
+        )
+
+    env_key = "HYPERSPACE_QUERY_STREAMING"
+    saved = os.environ.get(env_key)
+
+    def run_cold(streaming: bool) -> float:
+        clear_device_memos()
+        global_scan_cache().clear()
+        global_concat_cache().clear()
+        global_bucketed_cache().clear()
+        os.environ[env_key] = "1" if streaming else "0"
+        t0 = _now()
+        qja().collect()
+        return round(_now() - t0, 3)
+
+    out = {}
+    try:
+        out["join_stream_cold_s"] = run_cold(True)
+        out["join_stages"] = last_join_stages()
+        out["join_mat_cold_s"] = run_cold(False)
+        os.environ[env_key] = "1"
+        clear_device_memos()
+        qja().collect()  # warm the pairs memo for the steady-state p50
+        out["join_stream_warm_p50_s"] = round(
+            timed_p50(lambda: qja().collect(), runs), 3
+        )
+    finally:
+        if saved is None:
+            os.environ.pop(env_key, None)
+        else:
+            os.environ[env_key] = saved
+    return out
+
+
 def _cache_section() -> dict:
     from hyperspace_tpu.engine.physical import device_cache_stats
     from hyperspace_tpu.engine.scan_cache import (
@@ -547,13 +609,18 @@ def _cache_section() -> dict:
         global_scan_cache,
     )
 
+    from hyperspace_tpu.telemetry.profiling import pallas_fallback_summary
+
     return {
         "cache_stats": {
             "scan": global_scan_cache().stats(),
             "bucketed_concat": global_bucketed_cache().stats(),
             "concat": global_concat_cache().stats(),
             "device_memo": device_cache_stats(),
-        }
+        },
+        # Session-level Pallas fallback counters: a silent host fallback of
+        # the probe/sort kernels is a measurement hazard — surface it.
+        "pallas_fallbacks": pallas_fallback_summary(),
     }
 
 
@@ -747,6 +814,80 @@ def _variant_section(s, base, col, runs, hs) -> dict:
         d = s.read.parquet(os.path.join(base, "dim_hybrid"))
         return l.join(d, col("hk") == col("hk2")).select("hv", "hw")
 
+    # Skewed-key join: 40% of rows on ONE hot string key. The pre-classed
+    # dense layout pads every bucket to the hot bucket's pow2 cap (a ~33x
+    # padded-area blowup at this shape); the size-classed executor isolates
+    # the hot bucket (outlier host merge / its own class) and pads the rest
+    # tightly. Executor-isolated cold p50s: scan caches stay warm, device
+    # memos cleared per run, measured classed vs dense on the same data.
+    from hyperspace_tpu.engine.physical import clear_device_memos as _clear_memos
+    from hyperspace_tpu.ops.bucket_join import ENV_SIZE_CLASSES as _ENV_SC
+
+    n_hot = int(n * 0.4)
+    sk = np.array([f"sk-{i % 20000:05d}" for i in range(n)])
+    sk[:n_hot] = "sk-HOT"
+    rng.shuffle(sk)
+    s.write_parquet(
+        {"sk": sk, "sv": rng.randint(1, 9, n).astype(np.int64)},
+        os.path.join(base, "li_skew"),
+    )
+    s.write_parquet(
+        {
+            "sk2": np.array([f"sk-{i:05d}" for i in range(20000)] + ["sk-HOT"]),
+            "sw": rng.randint(1, 99, 20001).astype(np.int64),
+        },
+        os.path.join(base, "dim_skew"),
+    )
+    hs.create_index(
+        s.read.parquet(os.path.join(base, "li_skew")),
+        IndexConfig("vSkL", ["sk"], ["sv"]),
+    )
+    hs.create_index(
+        s.read.parquet(os.path.join(base, "dim_skew")),
+        IndexConfig("vSkD", ["sk2"], ["sw"]),
+    )
+
+    def qk():
+        l = s.read.parquet(os.path.join(base, "li_skew"))
+        dim = s.read.parquet(os.path.join(base, "dim_skew"))
+        return l.join(dim, col("sk") == col("sk2")).select("sv", "sw")
+
+    disable_hyperspace(s)
+    scan_rows = qk().count()
+    out["skew_join_scan_p50_s"] = p50(lambda: qk().count())
+    enable_hyperspace(s)
+    expected_skew = qk().count()  # also warms the scan/bucketed caches
+    out["skew_join_correct"] = expected_skew == scan_rows
+
+    saved_sc = os.environ.get(_ENV_SC)
+
+    def exec_cold_p50(classed: bool, n_runs: int) -> float:
+        os.environ[_ENV_SC] = "1" if classed else "0"
+        times = []
+        for _ in range(n_runs):
+            _clear_memos()
+            t0 = _now()
+            assert qk().count() == expected_skew
+            times.append(_now() - t0)
+        return round(float(np.percentile(times, 50)), 4)
+
+    try:
+        out["skew_exec_classed_p50_s"] = exec_cold_p50(True, runs)
+        # The dense layout is known-slow at this shape: two runs bound the
+        # bench budget while still giving a median.
+        out["skew_exec_dense_p50_s"] = exec_cold_p50(False, 2)
+    finally:
+        if saved_sc is None:
+            os.environ.pop(_ENV_SC, None)
+        else:
+            os.environ[_ENV_SC] = saved_sc
+    if out["skew_exec_classed_p50_s"] > 0:
+        out["skew_classed_speedup"] = round(
+            out["skew_exec_dense_p50_s"] / out["skew_exec_classed_p50_s"], 2
+        )
+    qk().count()  # warm pairs memo
+    out["skew_join_indexed_p50_s"] = p50(lambda: qk().count())
+
     disable_hyperspace(s)
     qh().count()
     out["hybrid_scan_p50_s"] = p50(lambda: qh().count())
@@ -809,22 +950,46 @@ def _device_section(s, base, col, runs, backend) -> dict:
 
     out = {}
 
-    # (a) pad+sort kernel (the build-side rep constructor), measured fresh.
-    from hyperspace_tpu.ops.bucket_join import pad_buckets_by_hash
+    # (a) pad+sort: the PRODUCTION rep constructor — the size-classed build
+    # (both sides, per-class matrices + outlier split), with the per-class
+    # breakdown, plus the pre-classed dense kernel as the reference point.
+    from hyperspace_tpu.ops.backend import use_device_path
+    from hyperspace_tpu.ops.bucket_join import (
+        build_classed_plan,
+        pad_buckets_by_hash,
+    )
     from hyperspace_tpu.ops.hashing import key64
 
     import jax.numpy as jnp
 
     key_cols = [left.column(c) for c in join_exec.left_keys]
     k64 = key64(key_cols, [jnp.asarray(c.data) for c in key_cols])
-    jax.block_until_ready(k64)
+    r_key_cols = [right.column(c) for c in join_exec.right_keys]
+    rk64 = key64(r_key_cols, [jnp.asarray(c.data) for c in r_key_cols])
+    jax.block_until_ready((k64, rk64))
+    k64_np, rk64_np = np.asarray(k64), np.asarray(rk64)
+    device = use_device_path()
 
-    def pad_once():
+    def pad_classed_once(timings=None):
+        plan = build_classed_plan(
+            k64_np, rk64_np, l_starts, r_starts, "hash",
+            device=device, timings=timings,
+        )
+        if device:
+            jax.block_until_ready([seg.l.keys for seg in plan.segments])
+        return plan
+
+    breakdown = []
+    pad_classed_once(breakdown)  # warm compiles + one-run class breakdown
+    out["pad_sort_p50_s"] = round(timed_p50(pad_classed_once, runs), 5)
+    out["pad_sort_classes"] = breakdown
+
+    def pad_dense_once():
         rep = pad_buckets_by_hash(k64, l_starts)
         jax.block_until_ready(rep.keys)
 
-    pad_once()  # compile
-    out["pad_sort_p50_s"] = round(timed_p50(pad_once, runs), 5)
+    pad_dense_once()  # compile
+    out["pad_sort_dense_p50_s"] = round(timed_p50(pad_dense_once, runs), 5)
 
     # (b) the XLA probe production dispatches.
     def xla_probe():

@@ -29,10 +29,13 @@ _FORMAT_EXTENSIONS = {
     "orc": (".orc",),
 }
 
-#: Decode-pool width knob, shared by every concurrent file decode in the
+#: Decode-pool width knob, shared by every concurrent worker stage in the
 #: engine: `read_files`, the streaming chunk iterator, the bucketed-scan
-#: cache warmer, and the pipelined index build (`index/build_pipeline.py`
-#: imports this name) — ONE threading contract for build and query.
+#: cache warmer, the pipelined index build (`index/build_pipeline.py`
+#: imports this name), and the streamed join→aggregate's payload
+#: gather/eval workers (`engine/streaming.stream_join_aggregate`) — ONE
+#: threading contract for build and query, and ``=1`` forces every one of
+#: them serial (the determinism-test configuration).
 ENV_DECODE_THREADS = "HYPERSPACE_BUILD_DECODE_THREADS"
 
 #: How many files the streaming chunk iterator may hold in flight ahead of

@@ -33,6 +33,11 @@ class SourceRelation:
     # Set when this relation is an index scan substituted by a rewrite rule:
     bucket_spec: Optional["BucketSpec"] = None
     index_name: Optional[str] = None
+    # The substituting index's LOG ENTRY id: advances on every refresh/vacuum/
+    # optimize, so engine memos keyed on it (the join pair caches) can never
+    # serve results computed against a superseded index generation. Excluded
+    # from value equality (serde round-trips don't carry it).
+    log_entry_id: Optional[int] = field(default=None, compare=False)
     # Hybrid Scan: source files appended after the index was built, merged in at
     # execution time (shuffle-union into buckets for the join path):
     hybrid_append: Optional["HybridAppend"] = None

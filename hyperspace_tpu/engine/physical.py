@@ -305,10 +305,14 @@ class BucketedIndexScanExec(PhysicalNode):
 
     def rows_token(self, ctx=None) -> tuple:
         """Identity of this scan's ROW SET AND ORDER, independent of column
-        pruning: the file inventory (+ hybrid-append inventory). Two prunings
-        of the same scan concat the same buckets in the same order, so join
-        pair indices computed against one apply verbatim to the other — the
-        pairs cache keys on this, not on the (column-pruned) table identity."""
+        pruning: the index log entry id + the file inventory (+ hybrid-append
+        inventory). Two prunings of the same scan concat the same buckets in
+        the same order, so join pair indices computed against one apply
+        verbatim to the other — the pairs cache keys on this, not on the
+        (column-pruned) table identity. The log entry id leads: it advances on
+        EVERY refresh/vacuum/optimize, so a rebuilt index can never serve
+        stale pair indices even if its rewritten files alias the
+        (path, size, mtime-ms) stats of the old ones."""
         ha = self.relation.hybrid_append
         ha_key = ()
         if ha is not None:
@@ -317,6 +321,7 @@ class BucketedIndexScanExec(PhysicalNode):
                 tuple(ha.root_paths),
             )
         return (
+            ("log", self.relation.index_name, getattr(self.relation, "log_entry_id", None)),
             tuple((f.path, f.size, f.modified_time) for f in self.relation.files),
             ha_key,
         )
@@ -929,10 +934,47 @@ class HashAggregateExec(PhysicalNode):
         out = self._try_fused_join_agg(ctx)
         if out is not None:
             return out
+        out = self._try_stream_join_agg(ctx)
+        if out is not None:
+            return out
         out = self._try_stream_agg(ctx)
         if out is not None:
             return out
         return hash_aggregate(self.child.execute(ctx), self.group_keys, self.aggs)
+
+    def _try_stream_join_agg(self, ctx) -> Optional[Table]:
+        """Streamed bucketed-join→aggregate: when this aggregate sits on a
+        chain of WithColumn/Project operators over a bucketed INNER join,
+        verified pair chunks flow straight into the chunk-carry
+        `StreamAggregator` — payload gathers + expression evaluation run
+        per chunk (overlapped on the shared decode-pool contract) and the
+        full join output never materializes (`engine.streaming.
+        stream_join_aggregate`). Returns None whenever the shape doesn't
+        apply or ``HYPERSPACE_QUERY_STREAMING=0`` — the materialized path is
+        always correct. Shape problems fall back; execution errors propagate
+        (and leave no partial pair memo behind)."""
+        from ..ops.aggregate import streaming_agg_supported
+        from ..ops.bucket_join import size_classes_enabled
+        from .streaming import stream_join_aggregate, streaming_enabled
+
+        if not streaming_enabled() or not size_classes_enabled():
+            return None
+        if not self.group_keys or not streaming_agg_supported(
+            self.group_keys, self.aggs
+        ):
+            return None
+        chain: List[PhysicalNode] = []
+        node = self.child
+        while isinstance(node, (WithColumnExec, ProjectExec)):
+            chain.append(node)
+            node = node.child
+        if not (
+            isinstance(node, SortMergeJoinExec)
+            and node.bucketed
+            and node.how == "inner"
+        ):
+            return None
+        return stream_join_aggregate(self, node, chain, ctx)
 
     def _try_stream_agg(self, ctx) -> Optional[Table]:
         """Streaming chunk-carry execution: when this aggregate sits on a
@@ -1290,12 +1332,17 @@ _key64_cache: Dict[int, tuple] = {}
 _padded_cache: Dict[int, tuple] = {}
 _verify_cache: Dict[tuple, tuple] = {}
 _pairs_cache: Dict[tuple, tuple] = {}
+_classed_cache: Dict[tuple, tuple] = {}  # size-classed join plans (two-table)
 _CACHES = {
     "k64": _key64_cache,
     "pad": _padded_cache,
     "ver": _verify_cache,
     "pairs": _pairs_cache,
+    "cpad": _classed_cache,
 }
+# Two-table-entry tags ((wr_left, wr_right, value) structure); the rest hold
+# per-table entries ((weakref, {subkey: val})).
+_TWO_TABLE_TAGS = ("ver", "pairs", "cpad")
 _CACHE_TAGS = {id(_key64_cache): "k64", id(_padded_cache): "pad"}
 
 # Concurrent queries (thread-local active sessions) share these memos; the
@@ -1350,9 +1397,21 @@ def _touch(tag, key) -> None:
 
 
 def _entry_nbytes(tag: str, ent) -> int:
-    if tag in ("ver", "pairs"):  # two-table entries: (wr_left, wr_right, value)
+    if tag in _TWO_TABLE_TAGS:  # two-table entries: (wr_left, wr_right, value)
         return _val_nbytes(ent[2])
     return sum(_val_nbytes(v) for v in ent[1].values())
+
+
+def clear_device_memos() -> None:
+    """Drop EVERY device-side memo (key64/padded/classed reps, verify lanes,
+    pairs). The bench's cold-executor measurements use this to re-run the
+    probe/pad pipeline from scratch without tearing down scan caches."""
+    global _device_cache_bytes
+    with _cache_lock:
+        for c in _CACHES.values():
+            c.clear()
+        _recency.clear()
+        _device_cache_bytes = 0
 
 
 def _drop_entry(tag: str, key) -> None:
@@ -1546,6 +1605,96 @@ def _probe_ranges_cached(l_rep, r_rep, left: Table, right: Table, subkey, rows_k
 
     return _cached_two_table(
         "pairs", left, right, ("probe", l_rep.mode) + subkey, compute, rows_key
+    )
+
+
+def _value_mode_column(table: Table, keys: List[str]):
+    """The single join-key Column when the side is even ELIGIBLE for value
+    mode (one numeric non-bool null-free key); None otherwise. The data-level
+    checks (NaN, in-bucket sortedness) happen in `value_mode_vals`."""
+    if len(keys) != 1:
+        return None
+    c = table.column(keys[0])
+    if c.is_string or c.data.dtype == np.bool_ or getattr(c, "validity", None) is not None:
+        return None
+    return c
+
+
+def _classed_plan_cached(
+    self_join, left: Table, right: Table, l_starts, r_starts, subkey, rows_key
+):
+    """The joint size-classed layout of one bucketed join pair, cached per
+    table pair (tag "cpad", same byte budget/lifetime as the dense padded
+    reps). The mode decision is JOINT by construction: both sides go
+    value-direct only when both qualify (single numeric null-free key, sorted
+    buckets, no NaN); otherwise both pad by key64 hash."""
+    from ..ops.backend import use_device_path
+    from ..ops.bucket_join import (
+        _outlier_factor,
+        build_classed_plan,
+        value_mode_vals,
+    )
+
+    l_keys, r_keys = self_join.left_keys, self_join.right_keys
+
+    def compute():
+        device = use_device_path()
+        lc = _value_mode_column(left, l_keys)
+        rc = _value_mode_column(right, r_keys)
+        if lc is not None and rc is not None:
+            lv = value_mode_vals(lc.data, l_starts)
+            rv = value_mode_vals(rc.data, r_starts)
+            if lv is not None and rv is not None:
+                plan = build_classed_plan(
+                    lv, rv, l_starts, r_starts, "value", device=device
+                )
+                if plan is not None:
+                    return plan
+        lk = np.asarray(_table_key64(left, list(l_keys)))
+        rk = np.asarray(_table_key64(right, list(r_keys)))
+        return build_classed_plan(lk, rk, l_starts, r_starts, "hash", device=device)
+
+    # The outlier factor is a PLAN INPUT (it decides the partition), so it
+    # rides the subkey: flipping HYPERSPACE_JOIN_OUTLIER_FACTOR mid-session
+    # must rebuild the plan, not serve the old partition until eviction.
+    return _cached_two_table(
+        "cpad", left, right, ("cplan", _outlier_factor()) + subkey, compute, rows_key
+    )
+
+
+def _classed_ranges_cached(plan, left: Table, right: Table, subkey, rows_key):
+    """Classed probe output through the pairs memo — the classed analogue of
+    `_probe_ranges_cached` (distinct subkey marker, so a mid-session flip of
+    HYPERSPACE_JOIN_SIZE_CLASSES can never hand a dense consumer a classed
+    value or vice versa)."""
+    from ..ops.bucket_join import probe_classed
+
+    return _cached_two_table(
+        "pairs",
+        left,
+        right,
+        ("cprobe", plan.mode) + subkey,
+        lambda: probe_classed(plan),
+        rows_key,
+    )
+
+
+def _relation_sig(node) -> Optional[tuple]:
+    """Identity of a join side's UNDERLYING relation for the general-path
+    pairs memo: index log entry id + source-file signature. Table-identity
+    keying alone cannot distinguish a refreshed/vacuumed index whose rewritten
+    files alias the (path, size, mtime-ms) stats of the old ones — the log
+    entry id ALWAYS advances across refresh/vacuum/optimize, so stale pair
+    indices can never serve a rebuilt table."""
+    while node is not None and getattr(node, "relation", None) is None:
+        node = getattr(node, "child", None)
+    rel = getattr(node, "relation", None)
+    if rel is None:
+        return None
+    return (
+        rel.index_name,
+        getattr(rel, "log_entry_id", None),
+        tuple((f.path, f.size, f.modified_time) for f in rel.files),
     )
 
 
@@ -1959,8 +2108,15 @@ class SortMergeJoinExec(PhysicalNode):
         # — so the host sort+probe+verify (2.4 s of the 8M CPU Q3 aggregate,
         # re-run per query before this) computes once per table pair. Entries
         # ride the shared device-memo byte budget and die with their tables.
-        subkey = ("general",) + _pair_subkey(
-            self.left_keys, self.right_keys, self.left, self.right, lt, rt
+        # The per-side relation signatures (index log entry id + file
+        # inventory) re-key the memo across index refresh/vacuum even when
+        # the producing Table object's identity survives.
+        subkey = (
+            ("general",)
+            + _pair_subkey(
+                self.left_keys, self.right_keys, self.left, self.right, lt, rt
+            )
+            + (_relation_sig(self.left), _relation_sig(self.right))
         )
         li, ri = _cached_two_table(
             "pairs",
@@ -2033,15 +2189,31 @@ class SortMergeJoinExec(PhysicalNode):
                 if l_blocks is not None and r_blocks is not None:
                     pairs = probe_dist_blocks(mesh, l_blocks, r_blocks)
             if pairs is None:
-                l_rep, r_rep = self._reconciled_reps(
-                    left, right, l_starts, r_starts
+                from ..ops.bucket_join import (
+                    classed_pairs,
+                    size_classes_enabled,
                 )
-                # Ranges through the probe memo: a count on the same rows has
-                # usually probed already — this pair expansion starts there.
-                ranges = _probe_ranges_cached(
-                    l_rep, r_rep, left, right, subkey, rows_key
-                )
-                pairs = probe_padded(l_rep, r_rep, ranges=ranges)
+
+                if size_classes_enabled():
+                    # Skew-aware layout: per-capacity-class padded probes +
+                    # host merges for oversized outlier buckets, expanded to
+                    # bucket-major host pairs. Ranges ride the probe memo — a
+                    # count on the same rows has usually probed already.
+                    plan = _classed_plan_cached(
+                        self, left, right, l_starts, r_starts, subkey, rows_key
+                    )
+                    ranges = _classed_ranges_cached(
+                        plan, left, right, subkey, rows_key
+                    )
+                    pairs = classed_pairs(plan, ranges)
+                else:
+                    l_rep, r_rep = self._reconciled_reps(
+                        left, right, l_starts, r_starts
+                    )
+                    ranges = _probe_ranges_cached(
+                        l_rep, r_rep, left, right, subkey, rows_key
+                    )
+                    pairs = probe_padded(l_rep, r_rep, ranges=ranges)
             return _verify_pairs(
                 left, right, self.left_keys, self.right_keys, pairs[0], pairs[1]
             )
@@ -2103,6 +2275,32 @@ class SortMergeJoinExec(PhysicalNode):
         )
         if mesh is not None:
             return None  # the sharded probe owns mesh-scale execution
+        from ..ops.bucket_join import size_classes_enabled
+
+        if size_classes_enabled():
+            plan = _classed_plan_cached(
+                self, left, right, l_starts, r_starts, subkey, rows_key
+            )
+            if plan.mode != "value" and not use_device_path():
+                return None  # hash-mode CPU counts ride the host pairs path
+            if plan.mode == "value":
+                # Value-direct classed probe counts are exact (outlier merges
+                # included); repeat counts read `total` off the cached ranges.
+                ranges = _classed_ranges_cached(
+                    plan, left, right, subkey, rows_key
+                )
+                return ranges.total
+            pairs = _cached_two_table(
+                "pairs",
+                left,
+                right,
+                ("dev",) + subkey,
+                lambda: self._device_pairs_compacted(
+                    left, right, l_starts, r_starts, subkey, rows_key
+                ),
+                rows_key=rows_key,
+            )
+            return 0 if pairs is None else int(pairs[2])
         l_rep, r_rep = self._reconciled_reps(left, right, l_starts, r_starts)
         if l_rep.mode != "value" and not use_device_path():
             # Hash-mode counts on the CPU backend take the host expansion path;
@@ -2240,37 +2438,61 @@ class SortMergeJoinExec(PhysicalNode):
             _compact_pairs_dev,
             _counts_total,
             _expand_pairs_dev,
+            classed_pairs_dev,
+            probe_classed,
             probe_keys_promoted,
             probe_orientation,
             probe_ranges,
+            size_classes_enabled,
         )
 
-        l_rep, r_rep = self._reconciled_reps(left, right, l_starts, r_starts)
-        a, b, swapped = probe_orientation(l_rep, r_rep)
-        if subkey is not None:
-            lo, counts = _probe_ranges_cached(
-                l_rep, r_rep, left, right, subkey, rows_key
+        if size_classes_enabled():
+            plan = _classed_plan_cached(
+                self, left, right, l_starts, r_starts,
+                subkey if subkey is not None else (), rows_key,
             )
+            if subkey is not None:
+                ranges = _classed_ranges_cached(
+                    plan, left, right, subkey, rows_key
+                )
+            else:
+                ranges = probe_classed(plan)
+            total = ranges.total
+            if total == 0:
+                return None
+            expanded = classed_pairs_dev(plan, ranges)
+            if expanded is None:
+                return None
+            li, ri, valid = expanded
+            out_cap = int(li.shape[0])
+            has_order = plan.mode == "hash"
         else:
-            ak, bk = probe_keys_promoted(a.keys, b.keys)
-            lo, counts = probe_ranges(ak, bk, a.lengths, b.lengths)
-        total = int(_counts_total(counts))
-        if total == 0:
-            return None
-        out_cap = _cap_pow2(total)
-        has_order = l_rep.mode == "hash"
-        dummy = jnp.zeros((1, 1), dtype=jnp.int64)
-        ai, bi, valid = _expand_pairs_dev(
-            out_cap,
-            has_order,
-            lo,
-            counts,
-            device_array(a.starts),
-            device_array(b.starts),
-            device_array(a.order) if has_order else dummy,
-            device_array(b.order) if has_order else dummy,
-        )
-        li, ri = (bi, ai) if swapped else (ai, bi)
+            l_rep, r_rep = self._reconciled_reps(left, right, l_starts, r_starts)
+            a, b, swapped = probe_orientation(l_rep, r_rep)
+            if subkey is not None:
+                lo, counts = _probe_ranges_cached(
+                    l_rep, r_rep, left, right, subkey, rows_key
+                )
+            else:
+                ak, bk = probe_keys_promoted(a.keys, b.keys)
+                lo, counts = probe_ranges(ak, bk, a.lengths, b.lengths)
+            total = int(_counts_total(counts))
+            if total == 0:
+                return None
+            out_cap = _cap_pow2(total)
+            has_order = l_rep.mode == "hash"
+            dummy = jnp.zeros((1, 1), dtype=jnp.int64)
+            ai, bi, valid = _expand_pairs_dev(
+                out_cap,
+                has_order,
+                lo,
+                counts,
+                device_array(a.starts),
+                device_array(b.starts),
+                device_array(a.order) if has_order else dummy,
+                device_array(b.order) if has_order else dummy,
+            )
+            li, ri = (bi, ai) if swapped else (ai, bi)
         if has_order:
             # Hash candidates: exact-equality + null-key verification on device.
             lanes, flat = _verify_lanes(left, right, self.left_keys, self.right_keys)

@@ -27,6 +27,12 @@ today's materialized execution byte-for-byte. Streamed results equal the
 materialized path's exactly for integer/count/min/max outputs and to
 float-associativity rounding for float sum/avg (docs/query-pipeline.md).
 
+`stream_join_aggregate` (below) is the JOIN-side twin: a grouped aggregate
+over a bucketed inner join streams verified pair chunks — gather + expression
+chain per chunk on the shared decode-pool contract — straight into the same
+`StreamAggregator`, so the join output never materializes whole
+(docs/join-pipeline.md).
+
 Per-stage busy timings (decode/eval/partial/merge), wall clock, and the
 overlap ratio ride `telemetry.profiling.record_query_stages` and surface in
 ``bench.py``'s ``bench_detail.query_stages``.
@@ -42,6 +48,11 @@ from .table import Column, Table
 ENV_QUERY_STREAMING = "HYPERSPACE_QUERY_STREAMING"
 ENV_QUERY_CHUNK_ROWS = "HYPERSPACE_QUERY_CHUNK_ROWS"
 _DEFAULT_QUERY_CHUNK_ROWS = 4_000_000
+#: Pair-chunk size of the streamed join→aggregate (rows of JOIN OUTPUT per
+#: chunk). Smaller than the scan chunk default: each join chunk materializes
+#: every payload column of both sides for its pair slice.
+ENV_JOIN_CHUNK_ROWS = "HYPERSPACE_JOIN_CHUNK_ROWS"
+_DEFAULT_JOIN_CHUNK_ROWS = 2_000_000
 
 
 def streaming_enabled() -> bool:
@@ -56,6 +67,16 @@ def query_chunk_rows() -> int:
         int(
             os.environ.get(ENV_QUERY_CHUNK_ROWS, _DEFAULT_QUERY_CHUNK_ROWS)
             or _DEFAULT_QUERY_CHUNK_ROWS
+        ),
+    )
+
+
+def join_chunk_rows() -> int:
+    return max(
+        1,
+        int(
+            os.environ.get(ENV_JOIN_CHUNK_ROWS, _DEFAULT_JOIN_CHUNK_ROWS)
+            or _DEFAULT_JOIN_CHUNK_ROWS
         ),
     )
 
@@ -156,4 +177,257 @@ def stream_aggregate(agg_exec, ctx) -> Optional[Table]:
         }
     )
     record_query_stages(summary)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Streamed bucketed-join → aggregate (the write-side twin: join pair chunks
+# flow straight into the chunk-carry aggregator; the joined table never
+# materializes whole)
+# ---------------------------------------------------------------------------
+
+
+def _resolve_source_columns(left: Table, right: Table, chain, names):
+    """Resolve aggregate names over the join's output naming (left wins the
+    unsuffixed name; colliding right columns answer to `<name>_r`, exactly
+    `_assemble_join`'s rule) to SOURCE Column objects. None when any name is
+    shadowed by a withColumn in the chain (computed — no source column) or
+    does not resolve uniquely."""
+    from .physical import WithColumnExec
+
+    shadowed = {
+        op.col_name.lower() for op in chain if isinstance(op, WithColumnExec)
+    }
+    out_names = dict(left.columns)
+    for n, c in right.columns.items():
+        out_names[n if n not in out_names else f"{n}_r"] = c
+    cols = []
+    for name in names:
+        if name.lower() in shadowed:
+            return None
+        c = out_names.get(name)
+        if c is None:
+            ci = [k for k in out_names if k.lower() == name.lower()]
+            if len(ci) != 1:
+                return None
+            c = out_names[ci[0]]
+        cols.append(c)
+    return cols
+
+
+def _agg_input_dtype(name: str, left: Table, right: Table, chain):
+    """Declared dtype of one aggregate input over the join output: the
+    shadowing withColumn's DECLARED dtype when the chain computes it, else the
+    source column's dtype; None when unresolvable."""
+    from .physical import WithColumnExec
+
+    for op in chain:
+        if isinstance(op, WithColumnExec) and op.col_name.lower() == name.lower():
+            return op.dtype
+    cols = _resolve_source_columns(left, right, (), [name])
+    return cols[0].dtype if cols is not None else None
+
+
+def _float_fold_free(agg_exec, left: Table, right: Table, chain) -> bool:
+    """True when every sum/avg input is PROVABLY non-float: integer partial
+    states accumulate exactly, so the chunked fold is bitwise-equal to the
+    one-pass fold regardless of chunk boundaries — the admission condition
+    for the RECORD-MERGE carry, whose hash-sorted partials would reorder a
+    float fold even within one chunk. Float sums stream only through the
+    direct-cells hint, where the per-chunk fold is the one-pass bincount
+    verbatim: bitwise-identical to the materialized fallback when the stream
+    fits one chunk (every test-scale shape), and within float-associativity
+    rounding once multiple chunks fold partial cell sums (the documented
+    streaming contract, docs/join-pipeline.md — same contract as PR 2's
+    scan-side stream)."""
+    for _out, fn, cname in agg_exec.aggs:
+        if fn in ("sum", "avg") and cname is not None:
+            dtype = _agg_input_dtype(cname, left, right, chain)
+            if dtype is None or dtype in ("float32", "float64"):
+                return False
+    return True
+
+
+def stream_join_aggregate(agg_exec, join_exec, chain, ctx) -> Optional[Table]:
+    """Run a `HashAggregateExec` over a bucketed INNER join as a chunk-carry
+    stream: verified pair chunks gather their payload columns and evaluate the
+    WithColumn/Project chain PER CHUNK (on a bounded worker pool riding the
+    shared decode-pool contract, overlapping the next chunk's verification and
+    the aggregator's fold), and reduce into `StreamAggregator` — with the
+    direct-address cells fast path when the SOURCE group-key columns qualify.
+    The full join output never materializes.
+
+    The verified pairs and classed probe ranges are inserted into the engine
+    memos ONLY after every chunk streamed successfully — a mid-stream fault
+    (e.g. a failing gather) propagates cleanly and caches nothing partial, so
+    the retry recomputes from scratch. Returns None when the shape doesn't
+    apply (caller falls back to the materialized path)."""
+    import numpy as np
+
+    from ..exceptions import HyperspaceException
+    from ..ops import bucket_join as bj
+    from ..ops.aggregate import StreamAggregator, _empty_result, direct_stream_hint
+    from ..telemetry.profiling import StageTimings, record_join_stages
+    from . import io as engine_io
+    from . import physical as phys
+
+    try:
+        left, l_starts = join_exec.left.execute_concat(ctx)
+        right, r_starts = join_exec.right.execute_concat(ctx)
+    except HyperspaceException:
+        return None
+    if left.num_rows == 0 or right.num_rows == 0:
+        return None  # the materialized fallback is trivially cheap here
+    if (
+        ctx.session is not None
+        and ctx.session.mesh_for(left.num_rows + right.num_rows) is not None
+    ):
+        return None  # the sharded probe owns mesh-scale execution
+
+    group_keys = agg_exec.group_keys
+    src_keys = _resolve_source_columns(left, right, chain, group_keys)
+    hint = (
+        direct_stream_hint(src_keys, agg_exec.aggs) if src_keys is not None else None
+    )
+    if hint is None and not _float_fold_free(agg_exec, left, right, chain):
+        # The record-merge carry would reorder a float fold even at one
+        # chunk; without the direct-cells hint those shapes stay
+        # materialized (always byte-identical).
+        return None
+
+    stages = StageTimings(mode="join-stream")
+    subkey = phys._pair_subkey(
+        join_exec.left_keys,
+        join_exec.right_keys,
+        join_exec.left,
+        join_exec.right,
+        left,
+        right,
+    )
+    rows_key = phys._pair_rows_key(join_exec.left, join_exec.right, ctx)
+
+    # Warm path: an earlier query (count/collect/materialized aggregate) on
+    # these rows already cached the VERIFIED pairs — start at the gathers.
+    verified, cached = phys._peek_two_table("pairs", left, right, subkey, rows_key)
+    plan = ranges = None
+    ranges_hit = False
+    if verified:
+        li_all, ri_all = cached
+    else:
+        with stages.timed("pad"):
+            plan = phys._classed_plan_cached(
+                join_exec, left, right, l_starts, r_starts, subkey, rows_key
+            )
+        ranges_hit, ranges = phys._peek_two_table(
+            "pairs", left, right, ("cprobe", plan.mode) + subkey, rows_key
+        )
+        if not ranges_hit:
+            with stages.timed("probe"):
+                ranges = bj.probe_classed(plan)
+        with stages.timed("expand"):
+            li_all, ri_all = bj.classed_pairs(plan, ranges)
+
+    agg = StreamAggregator(group_keys, agg_exec.aggs, stages=stages, direct_hint=hint)
+
+    n = int(len(li_all))
+    chunk_rows = join_chunk_rows()
+    slices = [
+        (lo, min(lo + chunk_rows, n)) for lo in range(0, n, chunk_rows)
+    ] or [(0, 0)]
+    lkeys, rkeys = join_exec.left_keys, join_exec.right_keys
+    verified_parts: List[tuple] = []
+    template: Optional[Table] = None
+
+    def build_chunk(lo: int, hi: int):
+        from .physical import WithColumnExec, _assemble_join, _verify_pairs
+
+        li_c, ri_c = li_all[lo:hi], ri_all[lo:hi]
+        if not verified:
+            with stages.timed("verify"):
+                li_c, ri_c = _verify_pairs(left, right, lkeys, rkeys, li_c, ri_c)
+        with stages.timed("gather"):
+            t = _assemble_join(left, right, li_c, ri_c, "inner")
+        with stages.timed("eval"):
+            for op in reversed(chain):  # innermost (closest to the join) first
+                t = (
+                    op._apply(t)
+                    if isinstance(op, WithColumnExec)
+                    else t.select(op.column_names)
+                )
+        return li_c, ri_c, t
+
+    none_idx = np.empty(0, np.int64)
+
+    def consume(res) -> None:
+        nonlocal template
+        li_c, ri_c, t = res
+        if not verified:
+            verified_parts.append((li_c, ri_c))
+        if template is None:
+            template = t.take(none_idx)
+        agg.add_chunk(t)
+
+    workers = min(2, engine_io.decode_pool_size(len(slices)))
+    if workers <= 1 or len(slices) == 1:
+        for lo, hi in slices:
+            consume(build_chunk(lo, hi))
+    else:
+        from collections import deque
+        from concurrent.futures import ThreadPoolExecutor
+
+        pool = ThreadPoolExecutor(max_workers=workers)
+        try:
+            pending: "deque" = deque()
+            i = 0
+            while i < len(slices) or pending:
+                # Depth-bounded: at most workers+1 chunks in flight keeps
+                # resident chunk memory bounded while the NEXT chunk's
+                # verify/gather overlaps this one's aggregator fold.
+                while i < len(slices) and len(pending) < workers + 1:
+                    pending.append(pool.submit(build_chunk, *slices[i]))
+                    i += 1
+                consume(pending.popleft().result())
+        finally:
+            pool.shutdown(wait=False, cancel_futures=True)
+
+    # EVERY chunk streamed successfully: NOW (and only now) populate the
+    # memos, so warm queries — streamed or materialized, counts included —
+    # start from the verified pairs exactly as after a materialized run.
+    if not verified:
+        if verified_parts:
+            li_v = np.concatenate([p[0] for p in verified_parts])
+            ri_v = np.concatenate([p[1] for p in verified_parts])
+        else:
+            li_v = ri_v = np.empty(0, np.int64)
+        phys._cached_two_table(
+            "pairs", left, right, subkey, lambda: (li_v, ri_v), rows_key=rows_key
+        )
+        if plan is not None and ranges is not None and not ranges_hit:
+            phys._cached_two_table(
+                "pairs",
+                left,
+                right,
+                ("cprobe", plan.mode) + subkey,
+                lambda: ranges,
+                rows_key=rows_key,
+            )
+
+    out = agg.finalize()
+    if out is None:
+        if template is None:
+            return None
+        out = _empty_result(template, group_keys, agg_exec.aggs)
+    summary = stages.summary()
+    summary.update(
+        {
+            "chunks": agg.chunks,
+            "pairs": n,
+            "groups": out.num_rows,
+            "direct_cells": hint is not None,
+            "classes": None if plan is None else len(plan.segments),
+            "outliers": None if plan is None else int(len(plan.outlier_ids)),
+            "join_mode": None if plan is None else plan.mode,
+        }
+    )
+    record_join_stages(summary)
     return out

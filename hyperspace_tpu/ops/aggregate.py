@@ -820,6 +820,27 @@ def _pow2_ceil(n: int) -> int:
     return 1 << max(n - 1, 1).bit_length()
 
 
+def direct_stream_hint(key_cols, aggs: Sequence[AggTriple]):
+    """Direct-address layout hint for a `StreamAggregator` whose chunks are
+    GATHERS of known source columns (the streamed join→aggregate): when the
+    SOURCE key columns qualify for the direct-address layout, every chunk of
+    gathered values is guaranteed inside the source's value ranges, so the
+    stream can accumulate straight into one dense cell array — no per-chunk
+    state tables and no record-keyed carry merge at all. Returns
+    (los, ranges, strides, cells, key_meta) or None; key_meta reconstructs
+    the output key columns from cell ids exactly like
+    `_direct_host_aggregate` does."""
+    layout = _direct_layout(key_cols, aggs)
+    if layout is None:
+        return None
+    los, ranges, _datas, strides, cells = layout
+    key_meta = [
+        (c.dtype, c.dictionary if c.is_string else None, c.data.dtype)
+        for c in key_cols
+    ]
+    return los, ranges, strides, cells, key_meta
+
+
 def _pad_repeat_first(a: np.ndarray, cap: int) -> np.ndarray:
     """Pad to `cap` rows by REPEATING the first row: pad slots join a real
     group (they duplicate real key values) and are masked out of every
@@ -894,7 +915,9 @@ class StreamAggregator:
     have taken it (`_direct_layout` on the carried keys reproduces the same
     decision), else ascending key64."""
 
-    def __init__(self, group_keys, aggs: Sequence[AggTriple], stages=None):
+    def __init__(
+        self, group_keys, aggs: Sequence[AggTriple], stages=None, direct_hint=None
+    ):
         self.group_keys = list(group_keys)
         self.aggs = [tuple(a) for a in aggs]
         if not streaming_agg_supported(self.group_keys, self.aggs):
@@ -906,6 +929,11 @@ class StreamAggregator:
         self._in_dtypes: list = [None] * len(self.aggs)
         self.chunks = 0
         self.rows = 0
+        # Direct-address cells mode (`direct_stream_hint`): dense accumulators
+        # over the hinted cell space replace the state-table carry entirely.
+        self._direct = direct_hint
+        self._dcounts: Optional[np.ndarray] = None
+        self._dstates: Optional[list] = None
 
     def _timed(self, stage: str):
         if self._stages is None:
@@ -937,6 +965,12 @@ class StreamAggregator:
                     )
         if t.num_rows == 0:
             return
+        if self._direct is not None:
+            with self._timed("partial"):
+                self._add_chunk_direct(t)
+            self.chunks += 1
+            self.rows += t.num_rows
+            return
         from .backend import use_device_path
 
         with self._timed("partial"):
@@ -951,6 +985,104 @@ class StreamAggregator:
         if self._pending_rows >= max(1 << 16, carry_rows):
             with self._timed("merge"):
                 self._compact()
+
+    def _direct_gid(self, t: Table) -> np.ndarray:
+        los, _ranges, strides, _cells, _meta = self._direct
+        gid0 = np.zeros(t.num_rows, np.int64)
+        for k, lo, st in zip(self.group_keys, los, strides):
+            c = t.column(k)
+            if c.validity is not None:
+                # The hint promised null-free source keys; a null here means
+                # the chunks are NOT gathers of the hinted columns — fail
+                # loudly rather than mis-bin.
+                raise HyperspaceException("direct-hint chunk carries null keys")
+            data = c.data
+            if data.dtype == np.bool_:
+                data = data.astype(np.int64)
+            gid0 += (data.astype(np.int64) - lo) * st
+        return gid0
+
+    def _add_chunk_direct(self, t: Table) -> None:
+        """Chunk fold in direct-address cells mode: one bincount pass per
+        aggregate into persistent dense accumulators — the streamed twin of
+        `_direct_host_aggregate`'s passes, with identical per-cell arithmetic
+        (exact int64 sums; float64 bincount sums to associativity rounding)."""
+        cells = self._direct[3]
+        gid0 = self._direct_gid(t)
+        if self._dcounts is None:
+            self._dcounts = np.zeros(cells, np.int64)
+            self._dstates = [
+                [None, None] for _ in self.aggs
+            ]  # per agg: [nv_cells, val_cells]
+        self._dcounts += np.bincount(gid0, minlength=cells)
+        for i, (_out, fn, cname) in enumerate(self.aggs):
+            col = t.column(cname) if cname is not None else None
+            state = self._dstates[i]
+            if fn == "count" and col is None:
+                continue  # count(*) reads self._dcounts
+            valid = col.validity
+            if valid is None:
+                # All rows valid: the per-cell valid counts stay derivable
+                # from _dcounts until some chunk introduces nulls.
+                if state[0] is not None:
+                    state[0] += np.bincount(gid0, minlength=cells)
+            else:
+                if state[0] is None:
+                    # First null-bearing chunk: every earlier chunk was
+                    # all-valid, so their per-cell valid counts equal the row
+                    # counts accumulated so far minus THIS chunk's rows
+                    # (_dcounts already folded it above).
+                    state[0] = self._dcounts - np.bincount(gid0, minlength=cells)
+                state[0] += np.bincount(gid0[valid], minlength=cells)
+            if fn == "count":
+                continue
+            data = col.data
+            g = gid0
+            if valid is not None:
+                data, g = data[valid], g[valid]
+            if np.issubdtype(data.dtype, np.floating):
+                s = np.bincount(g, weights=data.astype(np.float64), minlength=cells)
+            else:
+                # Exact int64 accumulation (bincount weights are float64 and
+                # would round sums past 2^53).
+                s = np.zeros(cells, np.int64)
+                np.add.at(s, g, data.astype(np.int64))
+            if state[1] is None:
+                state[1] = s
+            else:
+                if state[1].dtype != s.dtype:
+                    common = np.promote_types(state[1].dtype, s.dtype)
+                    state[1] = state[1].astype(common)
+                    s = s.astype(common)
+                state[1] += s
+
+    def _finalize_direct(self) -> Optional[Table]:
+        if self._dcounts is None or self.rows == 0:
+            return None
+        los, ranges_, strides, _cells, key_meta = self._direct
+        present = np.nonzero(self._dcounts)[0]
+        counts_p = self._dcounts[present]
+        out = {}
+        for k, (dtype, dictionary, np_dtype), lo, rng, st in zip(
+            self.group_keys, key_meta, los, ranges_, strides
+        ):
+            vals = lo + (present // st) % rng
+            if dtype == STRING:
+                out[k] = Column(STRING, vals.astype(np.int32), dictionary, None)
+            else:
+                out[k] = Column(dtype, vals.astype(np_dtype), None, None)
+        for i, (out_name, fn, cname) in enumerate(self.aggs):
+            dtype = result_dtype(fn, self._in_dtypes[i])
+            nv_cells, val_cells = self._dstates[i]
+            nv = counts_p if nv_cells is None else nv_cells[present]
+            if fn == "count":
+                out[out_name] = _out_column(fn, None, dtype, nv, None)
+                continue
+            vals = val_cells[present]
+            if fn == "avg":
+                vals = vals.astype(np.float64) / np.maximum(nv, 1)
+            out[out_name] = _out_column(fn, None, dtype, vals, nv > 0)
+        return Table(out)
 
     def _state_table(self, rep_keys: Table, states: list) -> Table:
         """Assemble the state-layout table: group keys + per-agg value/count
@@ -1242,6 +1374,9 @@ class StreamAggregator:
     def finalize(self) -> Optional[Table]:
         """The aggregate over everything streamed so far; None when no chunk
         carried rows (the caller owns the empty-input result shape)."""
+        if self._direct is not None:
+            with self._timed("finalize"):
+                return self._finalize_direct()
         with self._timed("merge"):
             self._compact()
         if self._carry is None:

@@ -17,15 +17,40 @@ mesh (each device owns a contiguous bucket range and never communicates).
 from __future__ import annotations
 
 import os
+import time
 
 from functools import partial
-from typing import Tuple
+from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 _PAD = jnp.iinfo(jnp.int64).max
+
+#: Size-classed padding (the skew-aware layout) is the default; ``=0`` restores
+#: the single global-cap dense layout exactly as it was.
+ENV_SIZE_CLASSES = "HYPERSPACE_JOIN_SIZE_CLASSES"
+#: A bucket whose larger side exceeds ``factor × median`` of the active
+#: buckets' larger sides leaves the padded layout entirely and merges on host
+#: (per bucket). ``<=0`` disables the outlier path.
+ENV_OUTLIER_FACTOR = "HYPERSPACE_JOIN_OUTLIER_FACTOR"
+_DEFAULT_OUTLIER_FACTOR = 8.0
+# Cap on the number of capacity classes: beyond this the per-class dispatch
+# (and, on the device path, per-shape compiles) start eating the padding win.
+_MAX_CLASSES = 8
+
+
+def size_classes_enabled() -> bool:
+    return os.environ.get(ENV_SIZE_CLASSES, "") != "0"
+
+
+def _outlier_factor() -> float:
+    raw = os.environ.get(ENV_OUTLIER_FACTOR, "")
+    try:
+        return float(raw) if raw else _DEFAULT_OUTLIER_FACTOR
+    except ValueError:
+        return _DEFAULT_OUTLIER_FACTOR
 
 
 def _cap_pow2(n: int) -> int:
@@ -365,6 +390,439 @@ def probe_ranges(ls, rs, l_len, r_len):
             np.asarray(ls), np.asarray(rs), np.asarray(l_len), np.asarray(r_len)
         )
     return _probe(ls, rs, l_len, r_len)
+
+
+# ---------------------------------------------------------------------------
+# Size-classed (skew-aware) layout
+# ---------------------------------------------------------------------------
+#
+# The dense layout above pads EVERY bucket to the global max bucket size, so a
+# single hot key inflates `num_buckets × cap` — at the 8M CPU bench the padded
+# sort alone (`pad_sort_p50`) was the slowest surviving kernel (2.44 s), and a
+# skewed key distribution multiplies the padded area by the skew ratio. The
+# classed layout (JSPIM-style, PAPERS.md) groups the ACTIVE buckets (non-empty
+# on both sides) into a small set of pow2 capacity classes; each class gets its
+# own padded matrices and its own probe program (the Pallas tiled-compare
+# kernel dispatches per class on TPU, where the smaller per-class capacity
+# products fall inside its quadratic-compare budget far more often than the
+# global cap did). Oversized outlier buckets skip padding entirely and merge
+# on host per bucket (`ops.join.host_merge_pairs`). On the CPU backend the
+# class matrices are built with numpy (per-bucket stable argsort over the
+# actual rows) — no XLA scatter/argsort over padded slots at all.
+
+
+class _ClassSide:
+    """One side of one capacity class: `keys` [B, cap] sorted within each row,
+    `lengths` [B] valid counts, `order` [B, cap] sorted-slot → storage-slot
+    (None in value mode), `starts` [B] GLOBAL row offsets of the class's
+    buckets (indexable by the class-local bucket row)."""
+
+    __slots__ = ("keys", "lengths", "order", "starts", "cap")
+
+    def __init__(self, keys, lengths, order, starts, cap: int):
+        self.keys = keys
+        self.lengths = lengths
+        self.order = order
+        self.starts = starts
+        self.cap = cap
+
+    @property
+    def nbytes(self) -> int:
+        return sum(
+            int(getattr(a, "nbytes", 0) or 0)
+            for a in (self.keys, self.lengths, self.order, self.starts)
+        )
+
+
+class JoinSegment:
+    """One capacity class of a classed join plan: the bucket ids it covers
+    (ascending, SHARED by both sides — the partition is joint) and the two
+    padded sides."""
+
+    __slots__ = ("ids", "l", "r")
+
+    def __init__(self, ids: np.ndarray, l: _ClassSide, r: _ClassSide):
+        self.ids = ids
+        self.l = l
+        self.r = r
+
+
+class ClassedJoinPlan:
+    """Joint size-classed layout of one co-bucketed join pair. `l_vals`/
+    `r_vals` are the HOST key arrays in the joint key space (key64 for hash
+    mode, canonicalized actual values for value mode), concatenated in bucket
+    order — the outlier merge and the host probe slice them directly.
+    Cacheable per table pair (the classed analogue of `PaddedBuckets`)."""
+
+    __slots__ = (
+        "mode",
+        "segments",
+        "outlier_ids",
+        "l_vals",
+        "r_vals",
+        "l_starts",
+        "r_starts",
+        "num_buckets",
+    )
+
+    def __init__(
+        self, mode, segments, outlier_ids, l_vals, r_vals, l_starts, r_starts
+    ):
+        self.mode = mode  # "value" | "hash"
+        self.segments = segments
+        self.outlier_ids = outlier_ids
+        self.l_vals = l_vals
+        self.r_vals = r_vals
+        self.l_starts = l_starts
+        self.r_starts = r_starts
+        self.num_buckets = len(l_starts) - 1
+
+    @property
+    def nbytes(self) -> int:
+        total = int(self.l_vals.nbytes) + int(self.r_vals.nbytes)
+        for seg in self.segments:
+            total += seg.l.nbytes + seg.r.nbytes
+        return total
+
+
+class ClassedRanges:
+    """Probe output of a classed plan: per segment (lo, counts, swapped,
+    seg_total) in the segment's own probe orientation, plus the outlier
+    buckets' already-expanded GLOBAL candidate pairs. `total` counts every
+    candidate pair (exact matches in value mode)."""
+
+    __slots__ = ("segments", "outliers", "total")
+
+    def __init__(self, segments, outliers, total: int):
+        self.segments = segments
+        self.outliers = outliers
+        self.total = total
+
+    @property
+    def nbytes(self) -> int:
+        total = 0
+        for lo, counts, _sw, _tot in self.segments:
+            total += int(getattr(lo, "nbytes", 0)) + int(getattr(counts, "nbytes", 0))
+        for _b, li, ri in self.outliers:
+            total += int(li.nbytes) + int(ri.nbytes)
+        return total
+
+
+def joint_partition(
+    l_starts: np.ndarray, r_starts: np.ndarray
+) -> Tuple[List[np.ndarray], np.ndarray]:
+    """Partition the ACTIVE buckets (rows on BOTH sides — a bucket empty on
+    either side produces no pairs and is skipped entirely) into capacity
+    classes by the pow2 caps of their two sides, with oversized outliers
+    split off for the host merge path. Returns (class id-arrays ascending,
+    outlier ids). The partition is a pure function of the two bucket-offset
+    arrays, so both sides of a join always agree on it."""
+    l_lens = np.diff(np.asarray(l_starts, np.int64))
+    r_lens = np.diff(np.asarray(r_starts, np.int64))
+    active = np.nonzero((l_lens > 0) & (r_lens > 0))[0]
+    if len(active) == 0:
+        return [], np.empty(0, np.int64)
+    mx = np.maximum(l_lens, r_lens)[active]
+    factor = _outlier_factor()
+    if factor > 0:
+        out_mask = mx > factor * max(float(np.median(mx)), 1.0)
+    else:
+        out_mask = np.zeros(len(active), bool)
+    outliers = active[out_mask]
+    rest = active[~out_mask]
+    if len(rest) == 0:
+        return [], outliers
+
+    def group_by_caps(quantize) -> dict:
+        classes: dict = {}
+        for b in rest:
+            key = (quantize(int(l_lens[b])), quantize(int(r_lens[b])))
+            classes.setdefault(key, []).append(int(b))
+        return classes
+
+    classes = group_by_caps(_cap_pow2)
+    if len(classes) > _MAX_CLASSES:
+        # Coarsen to power-of-4 caps (halves the distinct-class count bound).
+        def cap_pow4(n: int) -> int:
+            bits = (max(1, n) - 1).bit_length()
+            return 1 << (bits + (bits & 1))
+
+        classes = group_by_caps(cap_pow4)
+    if len(classes) > _MAX_CLASSES:
+        classes = {("all", "all"): [int(b) for b in rest]}
+    groups = [
+        np.asarray(sorted(ids), np.int64)
+        for _key, ids in sorted(
+            classes.items(), key=lambda kv: (str(kv[0]), kv[1][0])
+        )
+    ]
+    return groups, outliers
+
+
+def value_mode_vals(data, starts) -> Optional[np.ndarray]:
+    """Canonicalized HOST key values for value mode, or None when the column
+    disqualifies: NaN keys (probe equality would disagree with SQL's
+    NaN != NaN) or buckets not sorted by the key (e.g. multi-file buckets
+    after incremental refresh). Same contract as `pad_buckets_by_value`,
+    checked on host without building any padded matrix."""
+    vals = np.asarray(data)
+    if np.issubdtype(vals.dtype, np.floating):
+        if bool(np.isnan(vals).any()):
+            return None
+        # -0.0 -> +0.0: probe implementations must agree on signed zeros.
+        vals = np.where(vals == 0, np.zeros((), vals.dtype), vals)
+    n = vals.shape[0]
+    if n > 1:
+        adj = vals[1:] >= vals[:-1]
+        # Bucket boundaries are exempt from the non-decreasing check.
+        bounds = np.asarray(starts, np.int64)[1:-1] - 1
+        bounds = bounds[(bounds >= 0) & (bounds < n - 1)]
+        adj[bounds] = True
+        if not bool(adj.all()):
+            return None
+    return vals
+
+
+def _host_pad_value(dtype) -> np.ndarray:
+    if np.issubdtype(dtype, np.floating):
+        return np.asarray(np.finfo(dtype).max, dtype=dtype)
+    return np.asarray(np.iinfo(dtype).max, dtype=dtype)
+
+
+def _build_side(
+    vals: np.ndarray,
+    starts: np.ndarray,
+    ids: np.ndarray,
+    mode: str,
+    device: bool,
+) -> Optional[_ClassSide]:
+    """Padded matrices of one class of one side. Host build (CPU backend):
+    numpy scatter + per-bucket stable argsort over the ACTUAL rows only —
+    measured ~2x the XLA-CPU padded argsort at bench shapes, and pad slots are
+    never sorted at all. Device build: the class rows re-concatenate and ride
+    the existing jitted `_pad_and_sort`/`_pad_only` programs (Pallas sort
+    included via `pad_buckets_by_hash`), with the bucket axis pow2-quantized
+    by EMPTY virtual buckets so growing class populations reuse compiles."""
+    lens = (starts[ids + 1] - starts[ids]).astype(np.int64)
+    cap = _cap_pow2(int(lens.max()))
+    gstarts = starts[ids].astype(np.int64)
+    if device:
+        concat = (
+            np.concatenate([vals[starts[b] : starts[b + 1]] for b in ids])
+            if len(ids)
+            else vals[:0]
+        )
+        b_pad = _cap_pow2(len(ids))
+        cstarts = np.zeros(b_pad + 1, np.int64)
+        np.cumsum(lens, out=cstarts[1 : len(ids) + 1])
+        cstarts[len(ids) + 1 :] = cstarts[len(ids)]
+        if mode == "hash":
+            rep = pad_buckets_by_hash(jnp.asarray(concat), cstarts)
+        else:
+            rep = pad_buckets_by_value(jnp.asarray(concat), cstarts)
+            if rep is None:
+                return None
+        gstarts_pad = np.zeros(b_pad, np.int64)
+        gstarts_pad[: len(ids)] = gstarts
+        return _ClassSide(
+            rep.keys, rep.lengths, rep.order, gstarts_pad, int(rep.keys.shape[1])
+        )
+    B = len(ids)
+    keys = np.full((B, cap), _host_pad_value(vals.dtype), vals.dtype)
+    order = np.zeros((B, cap), np.int64) if mode == "hash" else None
+    for k, b in enumerate(ids):
+        s, e = int(starts[b]), int(starts[b + 1])
+        sl = vals[s:e]
+        if mode == "hash":
+            o = np.argsort(sl, kind="stable")
+            keys[k, : e - s] = sl[o]
+            order[k, : e - s] = o
+        else:
+            keys[k, : e - s] = sl
+    return _ClassSide(keys, lens, order, gstarts, cap)
+
+
+def build_classed_plan(
+    l_vals: np.ndarray,
+    r_vals: np.ndarray,
+    l_starts: np.ndarray,
+    r_starts: np.ndarray,
+    mode: str,
+    device: bool = False,
+    timings: Optional[list] = None,
+) -> Optional[ClassedJoinPlan]:
+    """Build the joint size-classed layout for one co-bucketed join pair.
+    `l_vals`/`r_vals` are HOST arrays in the joint key space (key64 hashes for
+    ``mode="hash"``, `value_mode_vals`-canonicalized values for
+    ``mode="value"``), concatenated in bucket order. Returns None when a
+    value-mode segment fails the device-side sortedness check (caller retries
+    in hash mode). `timings` (a list) receives per-class build records —
+    the bench's `pad_sort_classes` breakdown."""
+    l_starts = np.asarray(l_starts, np.int64)
+    r_starts = np.asarray(r_starts, np.int64)
+    if mode == "hash":
+        l_vals = np.minimum(np.asarray(l_vals, np.int64), _PAD - 1)
+        r_vals = np.minimum(np.asarray(r_vals, np.int64), _PAD - 1)
+    else:
+        l_vals = np.asarray(l_vals)
+        r_vals = np.asarray(r_vals)
+    groups, outlier_ids = joint_partition(l_starts, r_starts)
+    segments = []
+    for ids in groups:
+        t0 = time.monotonic()
+        l_side = _build_side(l_vals, l_starts, ids, mode, device)
+        r_side = _build_side(r_vals, r_starts, ids, mode, device)
+        if l_side is None or r_side is None:
+            return None
+        segments.append(JoinSegment(ids, l_side, r_side))
+        if timings is not None:
+            timings.append(
+                {
+                    "cap_l": l_side.cap,
+                    "cap_r": r_side.cap,
+                    "buckets": int(len(ids)),
+                    "build_s": round(time.monotonic() - t0, 5),
+                }
+            )
+    if timings is not None and len(outlier_ids):
+        lens = np.maximum(
+            np.diff(l_starts)[outlier_ids], np.diff(r_starts)[outlier_ids]
+        )
+        timings.append(
+            {
+                "outliers": int(len(outlier_ids)),
+                "max_rows": int(lens.max()),
+            }
+        )
+    return ClassedJoinPlan(
+        mode, segments, outlier_ids, l_vals, r_vals, l_starts, r_starts
+    )
+
+
+def _outlier_bucket_pairs(plan: ClassedJoinPlan, b: int):
+    """Host merge of ONE oversized bucket → GLOBAL candidate (li, ri) pairs
+    (exact matches in value mode; hash candidates verified by the caller's
+    exact-equality pass, same as every padded candidate)."""
+    from .join import host_merge_pairs
+
+    ls, le = int(plan.l_starts[b]), int(plan.l_starts[b + 1])
+    rs, re = int(plan.r_starts[b]), int(plan.r_starts[b + 1])
+    lv, rv = plan.l_vals[ls:le], plan.r_vals[rs:re]
+    lv, rv = probe_keys_promoted(lv, rv)
+    li, ri = host_merge_pairs(lv, rv)
+    return li + ls, ri + rs
+
+
+def probe_classed(plan: ClassedJoinPlan) -> ClassedRanges:
+    """Range-probe every segment (each class runs its own probe program via
+    `probe_ranges` — the Pallas tiled kernel where its per-class shape budget
+    admits it, the XLA vmap'd searchsorted or host numpy probe elsewhere) and
+    merge the outlier buckets on host."""
+    segs = []
+    total = 0
+    for seg in plan.segments:
+        if seg.l.cap > seg.r.cap:
+            a, b, swapped = seg.r, seg.l, True
+        else:
+            a, b, swapped = seg.l, seg.r, False
+        ak, bk = probe_keys_promoted(a.keys, b.keys)
+        lo, counts = probe_ranges(ak, bk, a.lengths, b.lengths)
+        seg_total = int(_counts_total(counts))
+        total += seg_total
+        segs.append((lo, counts, swapped, seg_total))
+    outs = []
+    for b in plan.outlier_ids:
+        li, ri = _outlier_bucket_pairs(plan, int(b))
+        total += len(li)
+        outs.append((int(b), li, ri))
+    return ClassedRanges(segs, outs, total)
+
+
+def classed_pairs(
+    plan: ClassedJoinPlan, ranges: ClassedRanges
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Expand a classed probe into HOST candidate (li, ri) pairs in BUCKET-
+    MAJOR order (ascending bucket id; within a bucket, probe-side sorted-slot
+    order) — one deterministic order regardless of how buckets landed in
+    classes, so repeated queries and the materialized fallback agree."""
+    per_bucket = np.zeros(plan.num_buckets, np.int64)
+    seg_out = []
+    for seg, (lo, counts, swapped, seg_total) in zip(plan.segments, ranges.segments):
+        counts_np = np.asarray(counts)
+        if seg_total == 0:
+            continue
+        a, b = (seg.r, seg.l) if swapped else (seg.l, seg.r)
+        ai, bi = _expand_np(
+            np.asarray(lo), counts_np, a.starts, b.starts, a.order, b.order
+        )
+        li, ri = (bi, ai) if swapped else (ai, bi)
+        tots = counts_np.sum(axis=1, dtype=np.int64)[: len(seg.ids)]
+        per_bucket[seg.ids] = tots
+        seg_out.append((seg.ids, li, ri, tots))
+    for b, li_o, ri_o in ranges.outliers:
+        per_bucket[b] = len(li_o)
+    out_starts = np.zeros(plan.num_buckets + 1, np.int64)
+    np.cumsum(per_bucket, out=out_starts[1:])
+    total = int(out_starts[-1])
+    li_all = np.empty(total, np.int64)
+    ri_all = np.empty(total, np.int64)
+    for ids, li, ri, tots in seg_out:
+        cum = np.cumsum(tots) - tots
+        pos = np.repeat(out_starts[ids] - cum, tots) + np.arange(li.shape[0])
+        li_all[pos] = li
+        ri_all[pos] = ri
+    for b, li_o, ri_o in ranges.outliers:
+        s = int(out_starts[b])
+        li_all[s : s + len(li_o)] = li_o
+        ri_all[s : s + len(ri_o)] = ri_o
+    return li_all, ri_all
+
+
+def classed_pairs_dev(plan: ClassedJoinPlan, ranges: ClassedRanges):
+    """DEVICE expansion of a classed probe: per-segment `_expand_pairs_dev`
+    programs (pow2 out-caps, so repeat shapes reuse compiles) concatenated
+    with the host outlier pairs — (li, ri, valid) device lanes for the fused
+    join→aggregate / on-device count paths. Pair order is NOT the host
+    bucket-major order (device consumers are order-insensitive reductions)."""
+    from ..engine.device_cache import device_array
+
+    has_order = plan.mode == "hash"
+    dummy = jnp.zeros((1, 1), dtype=jnp.int64)
+    parts = []
+    for seg, (lo, counts, swapped, seg_total) in zip(plan.segments, ranges.segments):
+        if seg_total == 0:
+            continue
+        a, b = (seg.r, seg.l) if swapped else (seg.l, seg.r)
+        ai, bi, valid = _expand_pairs_dev(
+            _cap_pow2(seg_total),
+            has_order,
+            jnp.asarray(lo),
+            jnp.asarray(counts),
+            device_array(a.starts),
+            device_array(b.starts),
+            device_array(a.order) if has_order else dummy,
+            device_array(b.order) if has_order else dummy,
+        )
+        li, ri = (bi, ai) if swapped else (ai, bi)
+        parts.append((li, ri, valid))
+    for _b, li_o, ri_o in ranges.outliers:
+        if len(li_o) == 0:
+            continue
+        parts.append(
+            (
+                jnp.asarray(li_o),
+                jnp.asarray(ri_o),
+                jnp.ones(len(li_o), bool),
+            )
+        )
+    if not parts:
+        return None
+    if len(parts) == 1:
+        return parts[0]
+    li = jnp.concatenate([p[0] for p in parts])
+    ri = jnp.concatenate([p[1] for p in parts])
+    valid = jnp.concatenate([p[2] for p in parts])
+    return li, ri, valid
 
 
 def probe_padded(left: PaddedBuckets, right: PaddedBuckets, ranges=None):

@@ -77,7 +77,20 @@ def merge_join_pairs(l_key64, r_key64) -> Tuple[np.ndarray, np.ndarray]:
     # expansions here are per-op XLA-CPU dispatches (the sort ~3x slower than
     # numpy, the expansion a chain of eager gathers). Same probe body as the
     # device program (xp=np), same host sort as every other host path.
-    lk, rk = np.asarray(l_key64), np.asarray(r_key64)
+    return host_merge_pairs(np.asarray(l_key64), np.asarray(r_key64))
+
+
+def host_merge_pairs(lk: np.ndarray, rk: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """All equal-key (left_index, right_index) pairs of two HOST arrays,
+    always on numpy regardless of backend — the CPU branch of
+    `merge_join_pairs`, and the per-bucket merge of the size-classed join's
+    OUTLIER path (one oversized bucket must not drag the device-wide padded
+    layout along, nor pay a per-bucket device dispatch). Pair order: left
+    rows in sorted-key order, each with its matches in the right side's
+    sorted order — the same within-bucket order the padded expansion emits."""
+    lk, rk = np.asarray(lk), np.asarray(rk)
+    if lk.shape[0] == 0 or rk.shape[0] == 0:
+        return np.empty(0, np.int64), np.empty(0, np.int64)
     l_order = stable_argsort_host(lk)
     r_order = stable_argsort_host(rk)
     lo, counts = _range_probe_body(lk, rk, l_order, r_order, xp=np)

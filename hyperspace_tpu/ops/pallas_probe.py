@@ -52,6 +52,20 @@ _AUTO_MAX_OPS = 1 << 28
 # lowering failure must disable FLOAT dispatch only, never the proven int
 # path (the round-4 guard existed precisely for this blast radius).
 _pallas_broken: dict = {}  # kind -> first failure message; permanent fallback
+_fallback_counts: dict = {}  # kind -> how many probes fell back to XLA/host
+
+
+def pallas_fallback_stats() -> dict:
+    """Session counters of probe-kernel fallbacks, per key kind: how many
+    probes were diverted after a failure latched, and the first error. Empty
+    when the kernel never failed — rides bench_detail.join_stages /
+    bench_detail.pallas_fallbacks so silent host fallbacks are visible."""
+    if not _pallas_broken and not _fallback_counts:
+        return {}
+    return {
+        "failures": dict(_fallback_counts),
+        "errors": dict(_pallas_broken),
+    }
 
 
 def _key_kind(dtype) -> str:
@@ -215,7 +229,12 @@ def pallas_probe_wanted(
     old transform's `bitcast f64->s64` was rejected by the terminal's
     X64-elimination rewrite. `dtype` scopes the failure latch: a float-path
     lowering failure can never disable the Mosaic-validated integer path."""
-    if _key_kind(dtype) in _pallas_broken:
+    kind = _key_kind(dtype)
+    if kind in _pallas_broken:
+        # Count every DIVERTED dispatch, not just the first failure: the
+        # bench's fallback counter should reflect how much work actually ran
+        # off-kernel in this session.
+        _fallback_counts[kind] = _fallback_counts.get(kind, 0) + 1
         return False
     mode = _pallas_mode()
     if mode == "0":
@@ -235,6 +254,7 @@ def record_pallas_failure(exc: BaseException, dtype=None) -> None:
 
     kind = _key_kind(dtype)
     _pallas_broken[kind] = f"{type(exc).__name__}: {exc}"
+    _fallback_counts[kind] = _fallback_counts.get(kind, 0) + 1
     logging.getLogger("hyperspace_tpu.ops").warning(
         "pallas probe failed for %s keys; falling back to the XLA probe "
         "permanently for that key kind: %s",

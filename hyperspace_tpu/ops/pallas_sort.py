@@ -44,6 +44,19 @@ _ENV_KEY = "HYPERSPACE_PALLAS_SORT"
 _MAX_CAP = 32768
 _MIN_CAP = 256  # below this the dispatch overhead beats any fusion win
 _sort_broken: dict = {}  # scoped latch (single kind: "sort")
+_fallback_counts: dict = {}  # diverted-dispatch counter after a latch
+
+
+def pallas_fallback_stats() -> dict:
+    """Session counters of sort-kernel fallbacks (see the probe twin): how
+    many sorts were diverted after a failure latched, and the first error.
+    Empty when the kernel never failed."""
+    if not _sort_broken and not _fallback_counts:
+        return {}
+    return {
+        "failures": dict(_fallback_counts),
+        "errors": dict(_sort_broken),
+    }
 
 
 def _pairs_gt(ah, al, bh, bl):
@@ -159,6 +172,7 @@ def pallas_sort_wanted(B: int, cap: int) -> bool:
     VMEM shape budget. Any lowering failure latches a permanent fallback
     (scoped to the sort; the validated probe kernel is unaffected)."""
     if "sort" in _sort_broken:
+        _fallback_counts["sort"] = _fallback_counts.get("sort", 0) + 1
         return False
     mode = os.environ.get(_ENV_KEY, "auto")
     if mode == "0":
@@ -174,6 +188,7 @@ def record_sort_failure(exc: BaseException) -> None:
     import logging
 
     _sort_broken["sort"] = f"{type(exc).__name__}: {exc}"
+    _fallback_counts["sort"] = _fallback_counts.get("sort", 0) + 1
     logging.getLogger("hyperspace_tpu.ops").warning(
         "pallas sort failed; falling back to the XLA sort permanently: %s",
         _sort_broken["sort"],

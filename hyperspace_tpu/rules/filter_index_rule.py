@@ -296,5 +296,6 @@ def _index_relation(
         files=all_files if files is None else files,
         bucket_spec=spec,
         index_name=entry.name,
+        log_entry_id=entry.id,
         pruned_by=pruned_by,
     )

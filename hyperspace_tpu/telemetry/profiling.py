@@ -72,11 +72,12 @@ class StageTimings:
             return out
 
 
-# Most recent index-build / streaming-query stage summaries (newest last),
-# consumed by bench.py's bench_detail. Bounded: telemetry must never grow with
-# the number of builds/queries a long-lived session performs.
+# Most recent index-build / streaming-query / streamed-join stage summaries
+# (newest last), consumed by bench.py's bench_detail. Bounded: telemetry must
+# never grow with the number of builds/queries a long-lived session performs.
 _BUILD_STAGES: "deque[dict]" = deque(maxlen=16)
 _QUERY_STAGES: "deque[dict]" = deque(maxlen=16)
+_JOIN_STAGES: "deque[dict]" = deque(maxlen=16)
 _build_stages_lock = threading.Lock()
 
 
@@ -116,6 +117,53 @@ def query_stages_history() -> list:
     """Stage summaries of the last few streaming queries, oldest first."""
     with _build_stages_lock:
         return [dict(d) for d in _QUERY_STAGES]
+
+
+def record_join_stages(summary: dict) -> None:
+    """Per-stage timings of one streamed join→aggregate execution (pad/probe/
+    expand/verify/gather/eval/partial busy time + wall + overlap ratio, plus
+    class/outlier counts) — surfaced through bench.py's
+    ``bench_detail.join_stages``. Pallas fallback counters ride along so a
+    silent host fallback is visible next to the timings it explains."""
+    d = dict(summary)
+    fallbacks = pallas_fallback_summary()
+    if fallbacks:
+        d["pallas_fallbacks"] = fallbacks
+    with _build_stages_lock:
+        _JOIN_STAGES.append(d)
+
+
+def last_join_stages() -> Optional[dict]:
+    """The most recent streamed join's stage summary (None if none ran)."""
+    with _build_stages_lock:
+        return dict(_JOIN_STAGES[-1]) if _JOIN_STAGES else None
+
+
+def join_stages_history() -> list:
+    """Stage summaries of the last few streamed joins, oldest first."""
+    with _build_stages_lock:
+        return [dict(d) for d in _JOIN_STAGES]
+
+
+def pallas_fallback_summary() -> dict:
+    """Session-level Pallas fallback counters (probe + sort kernels), empty
+    when nothing fell back. Reads through sys.modules so it NEVER triggers
+    the ~1 s `jax.experimental.pallas` import on paths that never wanted a
+    kernel — a module that was never imported cannot have failed."""
+    import sys
+
+    out: dict = {}
+    for name, key in (
+        ("hyperspace_tpu.ops.pallas_probe", "probe"),
+        ("hyperspace_tpu.ops.pallas_sort", "sort"),
+    ):
+        mod = sys.modules.get(name)
+        stats = getattr(mod, "pallas_fallback_stats", None) if mod else None
+        if stats is not None:
+            s = stats()
+            if s:
+                out[key] = s
+    return out
 
 
 @contextlib.contextmanager

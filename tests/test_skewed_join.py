@@ -1,0 +1,459 @@
+"""Skew-aware streamed bucket-join executor (ISSUE 3 tentpole).
+
+The contract under test: the size-classed padded layout (pow2 capacity
+classes + host-merged outlier buckets) produces exactly the verified pairs the
+single global-cap dense layout produced, across the skew matrix — one-hot-key
+bucket, empty buckets, all-rows-one-bucket, string keys, null keys, float
+keys; a bucketed inner join feeding a grouped aggregate streams per-chunk
+through `StreamAggregator` and is byte-identical to the
+``HYPERSPACE_QUERY_STREAMING=0`` materialized fallback (group order included);
+a mid-stream fault fails the query cleanly with NO partial pair memo; and the
+verified-pairs memos re-key across index refresh (log entry id), so a rebuilt
+index can never serve stale pair indices.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import IndexConfig, IndexConstants
+from hyperspace_tpu.engine import HyperspaceSession, col
+from hyperspace_tpu.engine import physical as phys
+from hyperspace_tpu.hyperspace import (
+    Hyperspace,
+    disable_hyperspace,
+    enable_hyperspace,
+)
+
+NUM_BUCKETS = 8
+
+
+@pytest.fixture()
+def make_session(tmp_path, monkeypatch):
+    """Factory: write left/right tables, index both, return (session, q_join,
+    q_agg) with fresh device memos. Keys are the first column of each dict."""
+    monkeypatch.delenv("HYPERSPACE_QUERY_STREAMING", raising=False)
+    monkeypatch.delenv("HYPERSPACE_JOIN_SIZE_CLASSES", raising=False)
+    monkeypatch.delenv("HYPERSPACE_JOIN_OUTLIER_FACTOR", raising=False)
+
+    def build(left, right, includes_l=None, includes_r=None, num_buckets=NUM_BUCKETS):
+        phys.clear_device_memos()
+        s = HyperspaceSession(warehouse=str(tmp_path))
+        s.conf.set(IndexConstants.INDEX_SYSTEM_PATH, str(tmp_path / "indexes"))
+        s.conf.set(IndexConstants.INDEX_NUM_BUCKETS, num_buckets)
+        hs = Hyperspace(s)
+        lk = list(left.keys())[0]
+        rk = list(right.keys())[0]
+        s.write_parquet(left, str(tmp_path / "l"))
+        s.write_parquet(right, str(tmp_path / "r"))
+        hs.create_index(
+            s.read.parquet(str(tmp_path / "l")),
+            IndexConfig("skJl", [lk], includes_l or [c for c in left if c != lk]),
+        )
+        hs.create_index(
+            s.read.parquet(str(tmp_path / "r")),
+            IndexConfig("skJr", [rk], includes_r or [c for c in right if c != rk]),
+        )
+        enable_hyperspace(s)
+
+        def q_join():
+            l = s.read.parquet(str(tmp_path / "l"))
+            r = s.read.parquet(str(tmp_path / "r"))
+            return l.join(r, col(lk) == col(rk))
+
+        return s, hs, q_join
+
+    return build
+
+
+def _check_matrix(build, left, right, agg_spec, monkeypatch):
+    """The shared equivalence harness: non-indexed oracle == indexed classed
+    == indexed dense (sorted rows); streamed aggregate == materialized
+    aggregate byte-for-byte (rows(), order included); counts agree."""
+    s, _hs, q_join_raw = build(left, right)
+    group_key, agg_col = agg_spec
+
+    def q_join():
+        # Project the payload columns: a bare select-all additionally surfaces
+        # the index version partition column (`v__`) on the indexed side,
+        # which is orthogonal to the executor under test.
+        return q_join_raw().select("k", "v", "w")
+
+    def q_agg():
+        return q_join_raw().group_by(group_key).agg(
+            t=(agg_col, "sum"), c=(agg_col, "count"), m=(agg_col, "max")
+        )
+
+    disable_hyperspace(s)
+    oracle_join = q_join().sorted_rows()
+    oracle_cnt = q_join().count()
+    oracle_agg = q_agg().collect().sorted_rows()
+    enable_hyperspace(s)
+
+    monkeypatch.setenv("HYPERSPACE_QUERY_STREAMING", "1")
+    assert q_join().count() == oracle_cnt
+    assert q_join().sorted_rows() == oracle_join
+    streamed = q_agg().collect().rows()
+    assert sorted(streamed) == sorted(tuple(r) for r in oracle_agg)
+
+    monkeypatch.setenv("HYPERSPACE_QUERY_STREAMING", "0")
+    phys.clear_device_memos()
+    materialized = q_agg().collect().rows()
+    assert streamed == materialized  # byte-identical, group order included
+
+    # The dense (pre-classed) executor agrees on everything.
+    monkeypatch.setenv("HYPERSPACE_JOIN_SIZE_CLASSES", "0")
+    phys.clear_device_memos()
+    assert q_join().count() == oracle_cnt
+    assert q_join().sorted_rows() == oracle_join
+    assert sorted(q_agg().collect().rows()) == sorted(materialized)
+
+
+class TestSkewMatrix:
+    def test_one_hot_key_bucket_with_outliers(self, make_session, monkeypatch):
+        """40% of rows on one key; a low outlier factor forces the host merge
+        path for the hot bucket."""
+        monkeypatch.setenv("HYPERSPACE_JOIN_OUTLIER_FACTOR", "2")
+        rng = np.random.RandomState(3)
+        n = 8000
+        k = rng.randint(0, 400, n).astype(np.int64)
+        k[: int(n * 0.4)] = 7
+        left = {"k": k, "v": rng.randint(0, 100, n).astype(np.int64)}
+        right = {
+            "k2": np.arange(400, dtype=np.int64),
+            "w": rng.randint(0, 10, 400).astype(np.int64),
+        }
+        _check_matrix(make_session, left, right, ("k", "v"), monkeypatch)
+
+    def test_all_rows_one_bucket(self, make_session, monkeypatch):
+        """A single distinct key: every row lands in ONE bucket (the worst
+        dense-layout case — every other bucket pads to the hot cap)."""
+        rng = np.random.RandomState(4)
+        left = {
+            "k": np.full(300, 42, np.int64),
+            "v": rng.randint(0, 9, 300).astype(np.int64),
+        }
+        right = {
+            "k2": np.full(40, 42, np.int64),
+            "w": rng.randint(0, 9, 40).astype(np.int64),
+        }
+        _check_matrix(make_session, left, right, ("k", "v"), monkeypatch)
+
+    def test_empty_buckets(self, make_session, monkeypatch):
+        """3 distinct keys over 8 buckets: most buckets are empty on both
+        sides and must be skipped, not padded."""
+        rng = np.random.RandomState(5)
+        left = {
+            "k": rng.choice(np.asarray([1, 50, 999], np.int64), 2000),
+            "v": rng.randint(0, 100, 2000).astype(np.int64),
+        }
+        right = {
+            "k2": np.asarray([1, 999, 1234], np.int64),
+            "w": np.asarray([5, 6, 7], np.int64),
+        }
+        _check_matrix(make_session, left, right, ("k", "v"), monkeypatch)
+
+    def test_string_keys_hot(self, make_session, monkeypatch):
+        rng = np.random.RandomState(6)
+        n = 4000
+        k = np.array([f"sku-{i:04d}" for i in rng.randint(0, 200, n)], dtype=object)
+        k[: n // 2] = "sku-HOT"
+        left = {"k": k, "v": rng.randint(0, 100, n).astype(np.int64)}
+        right = {
+            "k2": np.array(
+                [f"sku-{i:04d}" for i in range(200)] + ["sku-HOT"], dtype=object
+            ),
+            "w": rng.randint(0, 10, 201).astype(np.int64),
+        }
+        _check_matrix(make_session, left, right, ("k", "v"), monkeypatch)
+
+    def test_null_keys(self, make_session, monkeypatch):
+        """Nullable join keys force hash mode; null keys match nothing."""
+        rng = np.random.RandomState(7)
+        n = 3000
+        k = rng.randint(0, 100, n).astype(object)
+        k[::5] = None
+        left = {"k": k, "v": rng.randint(0, 100, n).astype(np.int64)}
+        k2 = np.arange(100).astype(object)
+        k2[::9] = None
+        right = {"k2": k2, "w": rng.randint(0, 10, 100).astype(np.int64)}
+        _check_matrix(make_session, left, right, ("k", "v"), monkeypatch)
+
+    def test_float_keys_value_mode(self, make_session, monkeypatch):
+        """Float keys incl. signed zeros ride value mode (canonicalized)."""
+        rng = np.random.RandomState(8)
+        n = 2000
+        k = (rng.randint(0, 50, n) * 0.5).astype(np.float64)
+        k[::17] = -0.0
+        left = {"k": k, "v": rng.randint(0, 100, n).astype(np.int64)}
+        right = {
+            "k2": np.concatenate([np.arange(50) * 0.5, [0.0]]).astype(np.float64),
+            "w": rng.randint(0, 10, 51).astype(np.int64),
+        }
+        _check_matrix(make_session, left, right, ("k", "v"), monkeypatch)
+
+
+class TestStreamedJoinAggregate:
+    def _skewed(self, make_session, monkeypatch, **kw):
+        rng = np.random.RandomState(11)
+        n = 9000
+        k = rng.randint(0, 300, n).astype(np.int64)
+        k[: n // 3] = 5
+        left = {"k": k, "v": rng.randint(0, 100, n).astype(np.int64)}
+        right = {
+            "k2": np.arange(300, dtype=np.int64),
+            "g": rng.randint(0, 20, 300).astype(np.int64),
+        }
+        return make_session(left, right, **kw)
+
+    def test_multi_chunk_stream_matches_materialized(
+        self, make_session, monkeypatch
+    ):
+        monkeypatch.setenv("HYPERSPACE_JOIN_CHUNK_ROWS", "2000")
+        # Pin the host path: under force-device-ops the FUSED device
+        # join→aggregate takes this shape before the streamed executor.
+        monkeypatch.delenv("HYPERSPACE_FORCE_DEVICE_OPS", raising=False)
+        s, _hs, q_join = self._skewed(make_session, monkeypatch)
+
+        def q_agg():
+            return (
+                q_join()
+                .with_column("x", col("v") * col("g"))
+                .group_by("g")
+                .agg(t=("x", "sum"), c=("v", "count"))
+            )
+
+        monkeypatch.setenv("HYPERSPACE_QUERY_STREAMING", "1")
+        streamed = q_agg().collect().rows()
+        from hyperspace_tpu.telemetry.profiling import last_join_stages
+
+        js = last_join_stages()
+        assert js is not None and js["chunks"] > 1 and js["pairs"] == 9000
+        assert js["mode"] == "join-stream"
+        assert "gather_s" in js and "partial_s" in js and js["overlap_ratio"]
+        monkeypatch.setenv("HYPERSPACE_QUERY_STREAMING", "0")
+        phys.clear_device_memos()
+        assert q_agg().collect().rows() == streamed
+
+    def test_multi_chunk_float_sum_within_associativity_rounding(
+        self, make_session, monkeypatch
+    ):
+        """Float sums through the direct-cells hint: bitwise-identical to the
+        materialized fallback at single-chunk scale; across chunks the
+        partial cell folds differ only by float associativity (same contract
+        as the scan-side stream). Group ORDER is identical either way."""
+        monkeypatch.delenv("HYPERSPACE_FORCE_DEVICE_OPS", raising=False)
+        rng = np.random.RandomState(19)
+        n = 9000
+        k = rng.randint(0, 300, n).astype(np.int64)
+        k[: n // 3] = 5
+        left = {"k": k, "p": rng.rand(n) * 100.0}
+        right = {
+            "k2": np.arange(300, dtype=np.int64),
+            "g": rng.randint(0, 20, 300).astype(np.int64),
+        }
+        s, _hs, q_join = make_session(left, right)
+
+        def q_agg():
+            return q_join().group_by("g").agg(rev=("p", "sum"), n=("p", "count"))
+
+        monkeypatch.setenv("HYPERSPACE_QUERY_STREAMING", "0")
+        materialized = q_agg().collect().rows()
+
+        # Single chunk: bitwise identical.
+        monkeypatch.setenv("HYPERSPACE_QUERY_STREAMING", "1")
+        phys.clear_device_memos()
+        assert q_agg().collect().rows() == materialized
+
+        # Multi-chunk: identical group order + counts, float sums to tol.
+        monkeypatch.setenv("HYPERSPACE_JOIN_CHUNK_ROWS", "2000")
+        phys.clear_device_memos()
+        chunked = q_agg().collect().rows()
+        from hyperspace_tpu.telemetry.profiling import last_join_stages
+
+        assert last_join_stages()["chunks"] > 1
+        assert [r[0] for r in chunked] == [r[0] for r in materialized]
+        assert [r[2] for r in chunked] == [r[2] for r in materialized]
+        for rc, rm in zip(chunked, materialized):
+            assert abs(rc[1] - rm[1]) <= 1e-9 * max(1.0, abs(rm[1]))
+
+    def test_streamed_pass_populates_pairs_memo(self, make_session, monkeypatch):
+        """Warm queries after a streamed aggregate start from the verified
+        pairs: no fresh probe, the count is free."""
+        s, _hs, q_join = self._skewed(make_session, monkeypatch)
+        monkeypatch.setenv("HYPERSPACE_QUERY_STREAMING", "1")
+
+        def q_agg():
+            return q_join().group_by("g").agg(t=("v", "sum"))
+
+        q_agg().collect()  # streamed: populates the pairs memo on success
+        from hyperspace_tpu.ops import bucket_join as bj
+
+        calls = []
+        real = bj.probe_ranges
+
+        def spy(*a, **k):
+            calls.append(1)
+            return real(*a, **k)
+
+        monkeypatch.setattr(bj, "probe_ranges", spy)
+        expected = q_join().count()
+        assert not calls  # served off the streamed pass's memo
+        assert q_agg().collect().num_rows > 0
+        assert not calls
+        disable_hyperspace(s)
+        assert q_join().count() == expected
+
+    def test_serial_decode_threads_equivalent(self, make_session, monkeypatch):
+        monkeypatch.setenv("HYPERSPACE_JOIN_CHUNK_ROWS", "2000")
+        s, _hs, q_join = self._skewed(make_session, monkeypatch)
+
+        def q_agg():
+            return q_join().group_by("g").agg(t=("v", "sum"), c=("v", "count"))
+
+        monkeypatch.setenv("HYPERSPACE_QUERY_STREAMING", "1")
+        parallel = q_agg().collect().rows()
+        monkeypatch.setenv("HYPERSPACE_BUILD_DECODE_THREADS", "1")
+        phys.clear_device_memos()
+        serial = q_agg().collect().rows()
+        assert parallel == serial
+
+    def test_mid_stream_fault_leaves_no_partial_memo(
+        self, make_session, monkeypatch
+    ):
+        """A gather fault mid-stream fails the query cleanly; the pairs memo
+        holds NOTHING partial, and the retry recomputes correctly."""
+        monkeypatch.setenv("HYPERSPACE_JOIN_CHUNK_ROWS", "2000")
+        # The fused device path (force-device-ops CI leg) would take the
+        # aggregate before the streamed path: pin the host path for the fault
+        # injection, which targets the streamed executor's chunk gathers.
+        monkeypatch.delenv("HYPERSPACE_FORCE_DEVICE_OPS", raising=False)
+        s, _hs, q_join = self._skewed(make_session, monkeypatch)
+        monkeypatch.setenv("HYPERSPACE_QUERY_STREAMING", "1")
+
+        def q_agg():
+            return q_join().group_by("g").agg(t=("v", "sum"))
+
+        phys.clear_device_memos()
+        real = phys._assemble_join
+        calls = []
+
+        def boom(*a, **k):
+            calls.append(1)
+            if len(calls) >= 2:
+                raise RuntimeError("injected decoder fault")
+            return real(*a, **k)
+
+        monkeypatch.setattr(phys, "_assemble_join", boom)
+        with pytest.raises(RuntimeError, match="injected"):
+            q_agg().collect()
+        assert len(phys._pairs_cache) == 0  # no partial pair memo
+        monkeypatch.setattr(phys, "_assemble_join", real)
+        streamed = q_agg().collect().rows()
+        monkeypatch.setenv("HYPERSPACE_QUERY_STREAMING", "0")
+        phys.clear_device_memos()
+        assert q_agg().collect().rows() == streamed
+
+    def test_env_zero_is_materialized_fallback(self, make_session, monkeypatch):
+        s, _hs, q_join = self._skewed(make_session, monkeypatch)
+
+        def q_agg():
+            return q_join().group_by("g").agg(t=("v", "sum"))
+
+        monkeypatch.setenv("HYPERSPACE_QUERY_STREAMING", "0")
+        from hyperspace_tpu.telemetry.profiling import _JOIN_STAGES
+
+        before = len(_JOIN_STAGES)
+        q_agg().collect()
+        assert len(_JOIN_STAGES) == before  # the streamed executor never ran
+
+
+class TestRefreshMemoInvalidation:
+    def test_rows_token_rekeys_on_refresh(self, tmp_path, monkeypatch):
+        """The pair memos key on the index LOG ENTRY id: refresh bumps it even
+        when the rewritten files could alias the old stat signature, so stale
+        pair indices can never serve a rebuilt index."""
+        s = HyperspaceSession(warehouse=str(tmp_path))
+        s.conf.set(IndexConstants.INDEX_SYSTEM_PATH, str(tmp_path / "indexes"))
+        s.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
+        hs = Hyperspace(s)
+        rng = np.random.RandomState(13)
+        s.write_parquet(
+            {
+                "k": rng.randint(0, 50, 500).astype(np.int64),
+                "v": rng.randint(0, 9, 500).astype(np.int64),
+            },
+            str(tmp_path / "src"),
+        )
+        s.write_parquet(
+            {
+                "k2": np.arange(50, dtype=np.int64),
+                "w": np.arange(50, dtype=np.int64),
+            },
+            str(tmp_path / "dim"),
+        )
+        hs.create_index(
+            s.read.parquet(str(tmp_path / "src")), IndexConfig("rfL", ["k"], ["v"])
+        )
+        hs.create_index(
+            s.read.parquet(str(tmp_path / "dim")), IndexConfig("rfR", ["k2"], ["w"])
+        )
+        enable_hyperspace(s)
+
+        def q():
+            l = s.read.parquet(str(tmp_path / "src"))
+            d = s.read.parquet(str(tmp_path / "dim"))
+            return l.join(d, col("k") == col("k2")).select("v", "w")
+
+        def scan_token():
+            plan = q().physical_plan()
+            for node in plan.collect_nodes():
+                if isinstance(node, phys.BucketedIndexScanExec):
+                    if node.relation.index_name == "rfL":
+                        return node.rows_token(None)
+            raise AssertionError("no bucketed scan for rfL in plan")
+
+        before_cnt = q().count()
+        tok_before = scan_token()
+        assert tok_before[0][0] == "log" and tok_before[0][2] is not None
+
+        # Rewrite the source with DIFFERENT data and refresh the index: the
+        # log entry id component must advance, and results must be fresh.
+        s.write_parquet(
+            {
+                "k": np.full(500, 1, np.int64),
+                "v": np.full(500, 3, np.int64),
+            },
+            str(tmp_path / "src"),
+        )
+        hs.refresh_index("rfL")
+        tok_after = scan_token()
+        assert tok_after[0] != tok_before[0]  # entry id advanced
+        after_cnt = q().count()
+        assert after_cnt == 500  # every row matches k2 == 1 exactly once
+        assert after_cnt != before_cnt or before_cnt == 500
+        disable_hyperspace(s)
+        assert q().count() == after_cnt
+
+    def test_general_join_memo_keys_carry_relation_sig(self, tmp_path):
+        """The general-path pairs memo subkey includes each side's relation
+        signature (entry id + file inventory), not just the join keys."""
+        s = HyperspaceSession(warehouse=str(tmp_path))
+        rng = np.random.RandomState(17)
+        s.write_parquet(
+            {"a": rng.randint(0, 9, 100).astype(np.int64)}, str(tmp_path / "ga")
+        )
+        s.write_parquet(
+            {"b": rng.randint(0, 9, 80).astype(np.int64)}, str(tmp_path / "gb")
+        )
+        l = s.read.parquet(str(tmp_path / "ga"))
+        r = s.read.parquet(str(tmp_path / "gb"))
+        df = l.join(r, col("a") == col("b"))
+        plan = df.physical_plan()
+        smj = next(
+            n for n in plan.collect_nodes() if isinstance(n, phys.SortMergeJoinExec)
+        )
+        sig = phys._relation_sig(smj.left)
+        assert sig is not None
+        assert len(sig[2]) >= 1  # file inventory present
