@@ -609,6 +609,7 @@ def _cache_section() -> dict:
         global_scan_cache,
     )
 
+    from hyperspace_tpu.telemetry import metrics
     from hyperspace_tpu.telemetry.profiling import pallas_fallback_summary
 
     return {
@@ -621,6 +622,11 @@ def _cache_section() -> dict:
         # Session-level Pallas fallback counters: a silent host fallback of
         # the probe/sort kernels is a measurement hazard — surface it.
         "pallas_fallbacks": pallas_fallback_summary(),
+        # Process-wide metrics registry: every cache/memo hit+miss (with
+        # derived hit RATES), decode-pool work, rule applied/skipped counts,
+        # and kernel fallback counters — the perf trajectory records cache
+        # BEHAVIOR alongside the timings (docs/observability.md).
+        "metrics_snapshot": metrics.snapshot(),
     }
 
 

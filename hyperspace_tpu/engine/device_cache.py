@@ -14,6 +14,13 @@ import weakref
 import jax.numpy as jnp
 import numpy as np
 
+from ..telemetry import metrics as _metrics
+
+# Bound once: device_array is the hottest instrumented path (every device op
+# over cached host columns) — per-call cost is one locked int add.
+_HITS = _metrics.counter("cache.device_upload.hits")
+_MISSES = _metrics.counter("cache.device_upload.misses")
+
 _cache: dict = {}  # id(host) -> (weakref, device_array); insertion order = LRU
 # Device copies are pinned until their host arrays die (the scan cache bounds
 # hosts at 4 GiB); this byte budget additionally bounds DEVICE memory so the
@@ -46,8 +53,10 @@ def device_array(host: np.ndarray):
         hit = _cache.get(key)
         if hit is not None and hit[0]() is host:
             _cache[key] = _cache.pop(key)  # LRU refresh
+            _HITS.inc()
             return hit[1]
 
+    _MISSES.inc()
     dev = jnp.asarray(host)
 
     def _evict(wr, key=key):

@@ -18,6 +18,7 @@ import pyarrow.parquet as pq
 
 from ..exceptions import HyperspaceException
 from ..storage.filesystem import FileStatus, FileSystem, LocalFileSystem
+from ..telemetry import metrics as _metrics
 from ..util.path_utils import is_data_path
 from .schema import BOOL, FLOAT32, FLOAT64, INT32, INT64, STRING, Field, Schema
 from .table import Column, Table
@@ -44,6 +45,10 @@ ENV_DECODE_THREADS = "HYPERSPACE_BUILD_DECODE_THREADS"
 #: file concurrently; memory-constrained deployments lower it.
 ENV_PREFETCH_FILES = "HYPERSPACE_QUERY_PREFETCH_FILES"
 _DEFAULT_PREFETCH_FILES = 16
+
+# Decode-pool work counters, bound once (incremented per cold-file decode).
+_DECODE_FILES = _metrics.counter("io.decode.files")
+_DECODE_SECONDS = _metrics.histogram("io.decode.seconds")
 
 
 def decode_pool_size(n_files: int) -> int:
@@ -209,17 +214,24 @@ def _decode_into_cache(
     """The miss half of `file_table`: decode only the cold columns when the
     cache can tell which those are, else the full projection. The caller has
     already counted the miss (no double accounting)."""
+    import time as _time
+
     from .scan_cache import global_scan_cache
 
+    t0 = _time.monotonic()
     cache = global_scan_cache()
     missing = cache.missing_columns(path, file_columns)
     if missing and missing != list(file_columns or []):
         cache.put(path, missing, _read_one(path, file_format, missing))
         t = cache.get(path, file_columns, record=False)
         if t is not None:
+            _DECODE_FILES.inc()
+            _DECODE_SECONDS.observe(_time.monotonic() - t0)
             return t  # assembled: warm columns + the freshly decoded rest
     t = _read_one(path, file_format, file_columns)
     cache.put(path, file_columns, t)
+    _DECODE_FILES.inc()
+    _DECODE_SECONDS.observe(_time.monotonic() - t0)
     return t
 
 

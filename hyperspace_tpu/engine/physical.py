@@ -28,6 +28,8 @@ import numpy as np
 from ..exceptions import HyperspaceException
 from ..ops.hashing import key64
 from ..ops.join import merge_join_pairs, nonzero_indices
+from ..telemetry import metrics as _metrics
+from ..telemetry import tracing as _tracing
 from . import io as engine_io
 from .device_cache import device_array
 from .evaluate import evaluate_predicate
@@ -84,8 +86,49 @@ def _footer_row_count(files, file_format: str) -> Optional[int]:
     return total
 
 
+def _traced_node_method(kind: str, fn):
+    """Wrap one executor entry point (`execute` / `execute_count` /
+    `execute_concat`) in a query-trace span. While tracing is inactive the
+    wrapper is one predicate check — no span, no allocation, no device work
+    (the acceptance bar: tracing off must not move the warm p50s). While
+    active, the span records the operator (`op:<name>`), the node identity
+    (`node_id` — what `explain(analyze=True)` joins the rendered tree on),
+    and the output row count."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(self, ctx):
+        if not _tracing.active():
+            return fn(self, ctx)
+        with _tracing.span(
+            f"op:{self.name}", node_id=id(self), op=self.simple_string(), kind=kind
+        ) as sp:
+            out = fn(self, ctx)
+            rows = getattr(out, "num_rows", None)
+            if rows is None and isinstance(out, tuple) and out:
+                rows = getattr(out[0], "num_rows", None)  # execute_concat
+            if rows is None and isinstance(out, int):
+                rows = out  # execute_count
+            if rows is not None:
+                sp.set_attr("rows_out", int(rows))
+            return out
+
+    wrapper._hyperspace_traced = True
+    return wrapper
+
+
 class PhysicalNode:
     name = "Physical"
+
+    def __init_subclass__(cls, **kwargs):
+        # Every operator's executor entry points are span-wrapped at class
+        # creation, so per-operator tracing needs no edits in the operators
+        # themselves (and new operators inherit it automatically).
+        super().__init_subclass__(**kwargs)
+        for m in ("execute", "execute_count", "execute_concat"):
+            fn = cls.__dict__.get(m)
+            if callable(fn) and not getattr(fn, "_hyperspace_traced", False):
+                setattr(cls, m, _traced_node_method(m, fn))
 
     def children(self) -> Sequence["PhysicalNode"]:
         return ()
@@ -344,7 +387,14 @@ class BucketedIndexScanExec(PhysicalNode):
         if key is not None:
             hit = global_bucketed_cache().get(key)
             if hit is not None:
+                _tracing.set_attr("bucketed_cache", "hit")
                 return hit
+            _tracing.set_attr("bucketed_cache", "miss")
+        else:
+            # key None = the cache was never consulted and the result will
+            # not be stored — a rerun can NOT hit, and the annotated tree
+            # must not suggest otherwise.
+            _tracing.set_attr("bucketed_cache", "uncacheable")
         buckets = self.execute_buckets(ctx)
         sizes = [0 if t is None else t.num_rows for t in buckets]
         starts = np.zeros(len(buckets) + 1, dtype=np.int64)
@@ -1345,6 +1395,14 @@ _CACHES = {
 _TWO_TABLE_TAGS = ("ver", "pairs", "cpad")
 _CACHE_TAGS = {id(_key64_cache): "k64", id(_padded_cache): "pad"}
 
+# Registry counters bound ONCE per memo tag: the memo lookups are warm-path
+# (every steady-state query), so the per-hit cost stays one locked int add —
+# no name formatting, no registry lookup.
+_MEMO_HITS = {t: _metrics.counter(f"memo.{t}.hits") for t in _CACHES}
+_MEMO_MISSES = {t: _metrics.counter(f"memo.{t}.misses") for t in _CACHES}
+_MEMO_PEEK_HITS = {t: _metrics.counter(f"memo.{t}.peek_hits") for t in _CACHES}
+_MEMO_EVICTIONS = _metrics.counter("memo.evictions")
+
 # Concurrent queries (thread-local active sessions) share these memos; the
 # byte accounting is read-modify-write and eviction iterates the recency dict,
 # so every mutation runs under one lock. RLock: weakref eviction callbacks can
@@ -1434,6 +1492,7 @@ def _evict_over_budget(protect: tuple) -> None:
                 return
             _drop_entry(*victim)
             _device_cache_evictions += 1
+            _MEMO_EVICTIONS.inc()
 
 
 def _val_nbytes(val) -> int:
@@ -1464,7 +1523,9 @@ def _cached_by_table(cache: Dict[int, tuple], table: Table, subkey, compute):
             hit = ent[1].get(subkey, _MISS)
             if hit is not _MISS:
                 _touch(tag, key)
+                _MEMO_HITS[tag].inc()
                 return hit
+    _MEMO_MISSES[tag].inc()
     val = compute()  # outside the lock: device work must not serialize queries
     nbytes = _val_nbytes(val)
     with _cache_lock:
@@ -1531,7 +1592,9 @@ def _cached_two_table(
         ent = cache.get(key)
         if ent is not None and valid(ent):
             _touch(tag, key)
+            _MEMO_HITS[tag].inc()
             return ent[2]
+    _MEMO_MISSES[tag].inc()
     val = compute()  # outside the lock: device work must not serialize queries
 
     def _evict(wr, key=key):
@@ -1565,6 +1628,7 @@ def _peek_two_table(
         ent = cache.get(key)
         if ent is not None and valid(ent):
             _touch(tag, key)
+            _MEMO_PEEK_HITS[tag].inc()
             return True, ent[2]
     return False, None
 
@@ -2171,7 +2235,10 @@ class SortMergeJoinExec(PhysicalNode):
         )
         rows_key = _pair_rows_key(self.left, self.right, ctx)
 
+        computed = []
+
         def compute():
+            computed.append(True)
             pairs = None
             mesh = (
                 ctx.session.mesh_for(left.num_rows + right.num_rows)
@@ -2221,6 +2288,7 @@ class SortMergeJoinExec(PhysicalNode):
         li, ri = _cached_two_table(
             "pairs", left, right, subkey, compute, rows_key=rows_key
         )
+        _tracing.set_attr("pairs_memo", "miss" if computed else "hit")
         return left, right, li, ri
 
     def _reconciled_reps(self, left: Table, right: Table, l_starts, r_starts):

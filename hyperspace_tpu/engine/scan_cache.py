@@ -23,6 +23,7 @@ import threading
 from collections import OrderedDict
 from typing import List, Optional, Tuple
 
+from ..telemetry import metrics as _metrics
 from .table import Table
 
 # 1 GiB of decoded columns. The per-file level is the DECODE backstop: repeat
@@ -35,6 +36,24 @@ from .table import Table
 DEFAULT_CAPACITY_BYTES = int(
     os.environ.get("HYPERSPACE_SCAN_CACHE_BUDGET", 1 << 30)
 )
+
+
+def _bind_cache_metrics(cache, name: Optional[str]) -> None:
+    """Bind a cache instance's registry mirrors once (warm-path cost = one
+    locked int add). Only the NAMED process-wide singletons report to the
+    registry; an ad-hoc unnamed instance (tests construct ScanCache directly)
+    gets private unregistered metric objects, so it can never double-count
+    into — or clobber the byte gauge of — the global caches' series."""
+    if name is None:
+        cache._m_hits = _metrics.Counter("unregistered")
+        cache._m_misses = _metrics.Counter("unregistered")
+        cache._m_evictions = _metrics.Counter("unregistered")
+        cache._m_bytes = _metrics.Gauge("unregistered")
+        return
+    cache._m_hits = _metrics.counter(f"cache.{name}.hits")
+    cache._m_misses = _metrics.counter(f"cache.{name}.misses")
+    cache._m_evictions = _metrics.counter(f"cache.{name}.evictions")
+    cache._m_bytes = _metrics.gauge(f"cache.{name}.bytes")
 
 
 def _column_nbytes(c) -> int:
@@ -62,7 +81,11 @@ class ScanCache:
     columns counts ONE hit), so cache-pressure accounting stays comparable to
     the pre-column-granular cache."""
 
-    def __init__(self, capacity_bytes: int = DEFAULT_CAPACITY_BYTES):
+    def __init__(
+        self,
+        capacity_bytes: int = DEFAULT_CAPACITY_BYTES,
+        name: Optional[str] = None,
+    ):
         self._capacity = capacity_bytes
         self._lock = threading.Lock()
         self._entries: "OrderedDict[tuple, Tuple[object, int]]" = OrderedDict()
@@ -70,6 +93,7 @@ class ScanCache:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        _bind_cache_metrics(self, name)
 
     def stats(self) -> dict:
         return {
@@ -87,6 +111,8 @@ class ScanCache:
             _, ent = self._entries.popitem(last=False)
             self._bytes -= ent[-1]
             self.evictions += 1
+            self._m_evictions.inc()
+        self._m_bytes.set(self._bytes)
 
     def set_capacity(self, capacity_bytes: int) -> None:
         with self._lock:
@@ -135,11 +161,13 @@ class ScanCache:
             if cols is None:
                 if record:
                     self.misses += 1
+                    self._m_misses.inc()
                 return None
             for n in names:
                 self._entries.move_to_end(base + (("col", n),))
             if record:
                 self.hits += 1
+                self._m_hits.inc()
             return Table(cols)
 
     def missing_columns(self, path: str, columns: Optional[List[str]]) -> Optional[List[str]]:
@@ -180,9 +208,10 @@ class ScanCache:
         with self._lock:
             self._entries.clear()
             self._bytes = 0
+            self._m_bytes.set(0)
 
 
-_GLOBAL = ScanCache()
+_GLOBAL = ScanCache(name="scan")
 
 
 def global_scan_cache() -> ScanCache:
@@ -199,7 +228,7 @@ class BucketedConcatCache:
     indexed queries hit here instead. Freshness rides on the same contract as the
     scan cache: any rewrite of an index file changes its size/mtime and the key."""
 
-    def __init__(self, capacity_bytes: int = 1 << 30):
+    def __init__(self, capacity_bytes: int = 1 << 30, name: Optional[str] = None):
         self._capacity = capacity_bytes
         self._lock = threading.Lock()
         self._entries: "OrderedDict[tuple, Tuple[Table, object, int]]" = OrderedDict()
@@ -207,6 +236,7 @@ class BucketedConcatCache:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        _bind_cache_metrics(self, name)
 
     def stats(self) -> dict:
         return {
@@ -229,9 +259,11 @@ class BucketedConcatCache:
             hit = self._entries.get(key)
             if hit is None:
                 self.misses += 1
+                self._m_misses.inc()
                 return None
             self._entries.move_to_end(key)
             self.hits += 1
+            self._m_hits.inc()
             return hit[0], hit[1]
 
     def put(self, key, table: Table, starts) -> None:
@@ -251,9 +283,10 @@ class BucketedConcatCache:
         with self._lock:
             self._entries.clear()
             self._bytes = 0
+            self._m_bytes.set(0)
 
 
-_BUCKETED = BucketedConcatCache()
+_BUCKETED = BucketedConcatCache(name="bucketed_concat")
 
 
 def global_bucketed_cache() -> BucketedConcatCache:
@@ -262,7 +295,7 @@ def global_bucketed_cache() -> BucketedConcatCache:
 
 # Plain multi-file concat results get their OWN budget so ordinary scans can
 # never evict the steady-state bucketed-join entries above.
-_CONCAT = BucketedConcatCache()
+_CONCAT = BucketedConcatCache(name="concat")
 
 
 def global_concat_cache() -> BucketedConcatCache:
@@ -272,7 +305,7 @@ def global_concat_cache() -> BucketedConcatCache:
 # Filtered bucketed-concat derivatives get their OWN budget so parameterized
 # filter churn (a different literal each query) can never evict the base
 # bucketed-join entries above — same isolation rationale as _CONCAT.
-_FILTERED = BucketedConcatCache()
+_FILTERED = BucketedConcatCache(name="filtered")
 
 
 def global_filtered_cache() -> BucketedConcatCache:

@@ -171,13 +171,26 @@ class DataFrame:
         )
 
     def collect(self) -> Table:
-        phys = self.physical_plan()
-        return phys.execute(ExecContext(self.session))
+        from ..telemetry import tracing
+
+        with tracing.query_span("query:collect") as root:
+            with tracing.span("plan"):
+                phys = self.physical_plan()
+            out = phys.execute(ExecContext(self.session))
+            root.set_attr("rows_out", int(out.num_rows))
+            return out
 
     def count(self) -> int:
         # Counts never assemble output they don't need: scans answer from parquet
         # footers, joins from verified pair counts (`PhysicalNode.execute_count`).
-        return self.physical_plan().execute_count(ExecContext(self.session))
+        from ..telemetry import tracing
+
+        with tracing.query_span("query:count") as root:
+            with tracing.span("plan"):
+                phys = self.physical_plan()
+            n = phys.execute_count(ExecContext(self.session))
+            root.set_attr("rows_out", int(n))
+            return n
 
     def to_pydict(self) -> Dict[str, list]:
         return self.collect().to_pydict()
@@ -187,6 +200,24 @@ class DataFrame:
 
     def explain_string(self) -> str:
         return self.physical_plan().tree_string()
+
+    def explain(self, analyze: bool = False, redirect=None):
+        """The physical plan tree; with ``analyze=True`` the query EXECUTES
+        under a trace capture and the same tree comes back annotated with each
+        node's measured wall time, rows out, cache/memo hits, stage spans
+        (probe/verify/gather/…), Pallas fallbacks, and the optimizer-rule
+        decisions that shaped it (`plananalysis.analyze`). Returns the string
+        when `redirect` is None, else passes it to `redirect` (e.g. print)."""
+        if analyze:
+            from ..plananalysis.analyze import explain_analyze_string
+
+            s = explain_analyze_string(self)
+        else:
+            s = self.explain_string()
+        if redirect is not None:
+            redirect(s)
+            return None
+        return s
 
     def show(self, n: int = 20, redirect=print) -> None:
         """Spark-style formatted preview of the first `n` rows."""
@@ -463,11 +494,16 @@ class HyperspaceSession:
         return DataFrame(self, plan)
 
     def optimize(self, plan: LogicalPlan) -> LogicalPlan:
+        from ..telemetry import tracing
         from .logical import push_filters_below_computed
 
         plan = push_filters_below_computed(plan)
         for rule in self.extra_optimizations:
-            plan = rule.apply(plan, self)
+            # One span per rule application under the query's plan span; each
+            # rule records its applied/skipped decisions onto it
+            # (`rules.rule_utils.record_rule_decision`).
+            with tracing.span(f"rule:{type(rule).__name__}"):
+                plan = rule.apply(plan, self)
         return plan
 
     # -- data creation helpers (test/SampleData parity) ---------------------

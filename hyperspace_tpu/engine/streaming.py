@@ -309,6 +309,9 @@ def stream_join_aggregate(agg_exec, join_exec, chain, ctx) -> Optional[Table]:
     # Warm path: an earlier query (count/collect/materialized aggregate) on
     # these rows already cached the VERIFIED pairs — start at the gathers.
     verified, cached = phys._peek_two_table("pairs", left, right, subkey, rows_key)
+    from ..telemetry import tracing
+
+    tracing.set_attr("pairs_memo", "hit" if verified else "miss")
     plan = ranges = None
     ranges_hit = False
     if verified:

@@ -42,7 +42,12 @@ class Hyperspace:
     # -- index CRUD (reference Hyperspace.scala:40-104) ---------------------
 
     def create_index(self, df: DataFrame, index_config: IndexConfig) -> None:
-        self._manager.create(df, index_config)
+        from .telemetry import tracing
+
+        with tracing.query_span(
+            "build:create_index", index_name=index_config.index_name
+        ):
+            self._manager.create(df, index_config)
 
     def delete_index(self, index_name: str) -> None:
         self._manager.delete(index_name)
@@ -56,7 +61,12 @@ class Hyperspace:
     def refresh_index(self, index_name: str, mode: str = "full") -> None:
         """mode="full": rebuild from scratch (reference behavior).
         mode="incremental": index only appended source files (extension)."""
-        self._manager.refresh(index_name, mode)
+        from .telemetry import tracing
+
+        with tracing.query_span(
+            "build:refresh_index", index_name=index_name, mode=mode
+        ):
+            self._manager.refresh(index_name, mode)
 
     def optimize_index(self, index_name: str, mode: str = "quick") -> None:
         """Compact small per-bucket index files (extension; quick/full modes)."""
@@ -68,12 +78,27 @@ class Hyperspace:
     def indexes(self) -> Table:
         return self._manager.indexes()
 
-    def explain(self, df: DataFrame, verbose: bool = False, redirect=None) -> Optional[str]:
+    def explain(
+        self,
+        df: DataFrame,
+        verbose: bool = False,
+        redirect=None,
+        analyze: bool = False,
+    ) -> Optional[str]:
         """Plan diff with indexes on vs off (reference `Hyperspace.scala:101-104`).
-        Prints unless `redirect` is given (a callable receiving the string)."""
-        from .plananalysis.plan_analyzer import explain_string
+        Prints unless `redirect` is given (a callable receiving the string).
+        With ``analyze=True`` the query EXECUTES under a trace and the chosen
+        plan renders annotated with measured wall times, row counts, cache
+        hits, and the rule decisions that shaped it (`plananalysis.analyze`;
+        same output as `df.explain(analyze=True)`)."""
+        if analyze:
+            from .plananalysis.analyze import explain_analyze_string
 
-        s = explain_string(df, self._session, self._manager.indexes(), verbose)
+            s = explain_analyze_string(df)
+        else:
+            from .plananalysis.plan_analyzer import explain_string
+
+            s = explain_string(df, self._session, self._manager.indexes(), verbose)
         if redirect is not None:
             redirect(s)
             return None

@@ -54,6 +54,15 @@ _AUTO_MAX_OPS = 1 << 28
 _pallas_broken: dict = {}  # kind -> first failure message; permanent fallback
 _fallback_counts: dict = {}  # kind -> how many probes fell back to XLA/host
 
+from ..telemetry import metrics as _metrics
+
+# Bound once: after a latch, EVERY subsequent dispatch increments — no name
+# formatting or registry lookup on that path (same convention as the engine's
+# cache counters).
+_FALLBACK_METRICS = {
+    k: _metrics.counter(f"pallas.probe.{k}.fallbacks") for k in ("int", "float")
+}
+
 
 def pallas_fallback_stats() -> dict:
     """Session counters of probe-kernel fallbacks, per key kind: how many
@@ -235,6 +244,7 @@ def pallas_probe_wanted(
         # bench's fallback counter should reflect how much work actually ran
         # off-kernel in this session.
         _fallback_counts[kind] = _fallback_counts.get(kind, 0) + 1
+        _FALLBACK_METRICS[kind].inc()
         return False
     mode = _pallas_mode()
     if mode == "0":
@@ -255,6 +265,7 @@ def record_pallas_failure(exc: BaseException, dtype=None) -> None:
     kind = _key_kind(dtype)
     _pallas_broken[kind] = f"{type(exc).__name__}: {exc}"
     _fallback_counts[kind] = _fallback_counts.get(kind, 0) + 1
+    _FALLBACK_METRICS[kind].inc()
     logging.getLogger("hyperspace_tpu.ops").warning(
         "pallas probe failed for %s keys; falling back to the XLA probe "
         "permanently for that key kind: %s",

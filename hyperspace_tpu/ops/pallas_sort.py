@@ -46,6 +46,11 @@ _MIN_CAP = 256  # below this the dispatch overhead beats any fusion win
 _sort_broken: dict = {}  # scoped latch (single kind: "sort")
 _fallback_counts: dict = {}  # diverted-dispatch counter after a latch
 
+from ..telemetry import metrics as _metrics
+
+# Bound once: incremented on every diverted dispatch after a latch.
+_FALLBACK_METRIC = _metrics.counter("pallas.sort.fallbacks")
+
 
 def pallas_fallback_stats() -> dict:
     """Session counters of sort-kernel fallbacks (see the probe twin): how
@@ -173,6 +178,7 @@ def pallas_sort_wanted(B: int, cap: int) -> bool:
     (scoped to the sort; the validated probe kernel is unaffected)."""
     if "sort" in _sort_broken:
         _fallback_counts["sort"] = _fallback_counts.get("sort", 0) + 1
+        _FALLBACK_METRIC.inc()
         return False
     mode = os.environ.get(_ENV_KEY, "auto")
     if mode == "0":
@@ -189,6 +195,7 @@ def record_sort_failure(exc: BaseException) -> None:
 
     _sort_broken["sort"] = f"{type(exc).__name__}: {exc}"
     _fallback_counts["sort"] = _fallback_counts.get("sort", 0) + 1
+    _FALLBACK_METRIC.inc()
     logging.getLogger("hyperspace_tpu.ops").warning(
         "pallas sort failed; falling back to the XLA sort permanently: %s",
         _sort_broken["sort"],

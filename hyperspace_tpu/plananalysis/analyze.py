@@ -1,0 +1,204 @@
+"""EXPLAIN ANALYZE: the plan tree re-rendered with runtime truth.
+
+The static explain subsystem (`plan_analyzer`) compiles plans without running
+them. This module EXECUTES the query once under a trace capture
+(`telemetry.tracing.capture`) and renders the SAME physical tree annotated
+per node with what actually happened: measured wall seconds, rows out,
+cache/memo hit-or-miss, and the pipelined executors' stage spans
+(probe/verify/gather/…) nested where they ran. Sections below the tree report
+the optimizer-rule decisions (which index rule rewrote the plan, and why the
+others sat out), Pallas kernel fallbacks, and the per-query cache/metric
+counter deltas.
+
+Nodes with no span did not execute — the engine served them another way (a
+fused/streamed parent, a memoized pair cache, a footer-only count). That is
+reported as such rather than as zero: the annotated tree never claims time
+that was not spent.
+
+Entry points: `DataFrame.explain(analyze=True)` and
+`Hyperspace.explain(df, analyze=True)`.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+def _fmt_seconds(s: Optional[float]) -> str:
+    if s is None:
+        return "?"
+    if s >= 1:
+        return f"{s:.3f}s"
+    return f"{s * 1000:.1f}ms"
+
+
+_VERDICT_KEYS = ("bucketed_cache", "pairs_memo")
+
+
+def _subtree_verdict(span, children_of, key, rendered_ids, own_id, depth: int = 0):
+    """First cache-verdict value for `key` in the span's subtree, WITHOUT
+    crossing into another rendered node's spans (a join line must not show
+    its child scan's verdict). The attr may land on an INNER span of the
+    same node (`execute` delegating to the wrapped `execute_concat`) or on a
+    delegate node's span that matches no rendered tree node (e.g. the
+    temporary demoted bucketed scan a hybrid `ScanExec.execute` constructs)
+    — the verdict still belongs on the line the user sees."""
+    v = span.attrs.get(key)
+    if v is not None or depth >= 4:
+        return v
+    for child in children_of.get(span.span_id, ()):
+        if not child.name.startswith("op:"):
+            continue
+        nid = child.attrs.get("node_id")
+        if nid is not None and nid != own_id and nid in rendered_ids:
+            continue  # a different rendered node's span: its verdict is its own
+        v = _subtree_verdict(child, children_of, key, rendered_ids, own_id, depth + 1)
+        if v is not None:
+            return v
+    return None
+
+
+def _node_annotation(span, children_of, rendered_ids) -> str:
+    parts = [f"time={_fmt_seconds(span.duration_s)}"]
+    rows = span.attrs.get("rows_out")
+    if rows is not None:
+        parts.append(f"rows={rows}")
+    own_id = span.attrs.get("node_id")
+    for key in _VERDICT_KEYS:
+        v = _subtree_verdict(span, children_of, key, rendered_ids, own_id)
+        if v is not None:
+            parts.append(f"{key}={v}")
+    if span.status != "ok":
+        parts.append(f"status={span.status}")
+    return "   [" + ", ".join(parts) + "]"
+
+
+def _stage_lines(span, children_of, indent: int) -> List[str]:
+    """The synthesized `<kind>:stages` children of one operator span, each
+    rendered as one busy-time breakdown line (stages overlap; their busy sum
+    over wall is the overlap ratio — see `StageTimings`)."""
+    out: List[str] = []
+    pad = "  " * indent + "     "
+    for child in children_of.get(span.span_id, ()):
+        if not child.name.endswith(":stages"):
+            continue
+        kind = child.name.split(":", 1)[0]
+        stages = []
+        for st in children_of.get(child.span_id, ()):
+            stages.append(f"{st.name.split(':', 1)[1]}={_fmt_seconds(st.duration_s)}")
+        wall = child.attrs.get("wall_s")
+        overlap = child.attrs.get("overlap_ratio")
+        extra = []
+        if wall is not None:
+            extra.append(f"wall={_fmt_seconds(wall)}")
+        if overlap is not None:
+            extra.append(f"overlap={overlap}")
+        mode = child.attrs.get("mode")
+        if mode:
+            extra.append(f"mode={mode}")
+        out.append(
+            pad
+            + f"{kind} stages: "
+            + " ".join(stages + extra)
+        )
+        fallbacks = child.attrs.get("pallas_fallbacks")
+        if fallbacks:
+            out.append(pad + f"pallas fallbacks: {fallbacks}")
+    return out
+
+
+def explain_analyze_string(df) -> str:
+    """Execute `df` once under a trace and render the annotated plan tree."""
+    from ..engine.physical import ExecContext
+    from ..telemetry import metrics, tracing
+
+    session = df.session
+    snap0 = metrics.snapshot()
+    with tracing.capture() as cap:
+        with tracing.query_span("query:explain_analyze") as root:
+            with tracing.span("plan"):
+                phys = df.physical_plan()
+            result = phys.execute(ExecContext(session))
+            root.set_attr("rows_out", int(result.num_rows))
+    snap1 = metrics.snapshot()
+    trace = cap.trace
+    if trace is None:  # defensive: capture always receives the root above
+        return "EXPLAIN ANALYZE: no trace captured"
+
+    # Operator spans join the rendered tree on node identity; the OUTERMOST
+    # span of a node wins (a node may open several — e.g. execute over
+    # execute_concat).
+    by_node: Dict[int, object] = {}
+    for s in trace.spans:
+        nid = s.attrs.get("node_id")
+        if nid is not None and nid not in by_node:
+            by_node[nid] = s
+    children_of = trace.spans_by_parent()
+
+    lines: List[str] = []
+    root_span = trace.root
+    lines.append("=" * 61)
+    lines.append("EXPLAIN ANALYZE")
+    lines.append("=" * 61)
+    lines.append(
+        f"query_id={trace.query_id}  wall={_fmt_seconds(root_span.duration_s)}  "
+        f"rows={result.num_rows}"
+    )
+    plan_spans = trace.find("plan")
+    if plan_spans:
+        lines.append(f"planning={_fmt_seconds(plan_spans[0].duration_s)}")
+    lines.append("")
+
+    rendered_ids = {id(n) for n in phys.collect_nodes()}
+
+    def walk(node, indent: int) -> None:
+        line = node.format_line(indent)
+        span = by_node.get(id(node))
+        if span is not None:
+            line += _node_annotation(span, children_of, rendered_ids)
+        else:
+            line += "   [not executed: fused/streamed into parent or served from cache]"
+        lines.append(line)
+        if span is not None:
+            lines.extend(_stage_lines(span, children_of, indent))
+        for c in node.children():
+            walk(c, indent + 1)
+
+    walk(phys, 0)
+    # Stage spans recorded under the ROOT (e.g. a streamed aggregate whose
+    # operator span closed before the summary landed) still get shown.
+    root_stages = _stage_lines(root_span, children_of, 0)
+    if root_stages:
+        lines.append("")
+        lines.extend(root_stages)
+
+    decisions: List[dict] = []
+    for s in trace.spans:
+        if s.name.startswith("rule:"):
+            decisions.extend(s.attrs.get("decisions", ()))
+    lines.append("")
+    lines.append("Rule decisions:")
+    if decisions:
+        for d in decisions:
+            verdict = "applied" if d.get("applied") else "skipped"
+            detail = {
+                k: v
+                for k, v in d.items()
+                if k not in ("rule", "applied") and v not in (None, [], {})
+            }
+            suffix = f"  {detail}" if detail else ""
+            lines.append(f"  {d.get('rule')}: {verdict}{suffix}")
+    else:
+        lines.append("  (none recorded — no optimizer rules fired on this plan)")
+
+    delta = metrics.counters_delta(snap0, snap1)
+    lines.append("")
+    # The registry is process-wide: under concurrent queries this section
+    # includes their counters too (span-tree attributions above are exact).
+    lines.append("Cache/metric deltas (process-wide during this query):")
+    if delta:
+        for name in sorted(delta):
+            lines.append(f"  {name}: +{delta[name]}")
+    else:
+        lines.append("  (none)")
+    return "\n".join(lines)

@@ -26,7 +26,7 @@ from ..index.dataskipping import (
 from ..telemetry.event_logging import EventLoggerFactory
 from ..telemetry.events import HyperspaceIndexUsageEvent
 from ..util.resolver_utils import resolution_key
-from .rule_utils import get_candidate_indexes, log_rule_failure
+from .rule_utils import get_candidate_indexes, log_rule_failure, record_rule_decision
 
 
 def _normalize_conjunct(e: Expr):
@@ -159,6 +159,12 @@ class DataSkippingFilterRule:
 
                 kept_files = [f for f in scan.relation.files if keep[f.path]]
                 if len(kept_files) == len(scan.relation.files):
+                    record_rule_decision(
+                        "DataSkippingFilterRule",
+                        False,
+                        reason="no-files-pruned",
+                        candidates=[c.entry.name for c in candidates],
+                    )
                     return node
 
                 rel = scan.relation
@@ -172,6 +178,13 @@ class DataSkippingFilterRule:
                     partition_spec=rel.partition_spec,
                 )
                 new_node = FilterNode(node.condition, ScanNode(pruned))
+                record_rule_decision(
+                    "DataSkippingFilterRule",
+                    True,
+                    indexes=sorted(set(used_indexes)),
+                    files_pruned=len(rel.files) - len(kept_files),
+                    files_total=len(rel.files),
+                )
                 EventLoggerFactory.get_logger(
                     session.hs_conf.event_logger_class
                 ).log_event(

@@ -28,7 +28,12 @@ from ..index.log_entry import IndexLogEntry
 from ..telemetry.event_logging import EventLoggerFactory
 from ..telemetry.events import HyperspaceIndexUsageEvent
 from ..util.resolver_utils import resolve, resolve_all
-from .rule_utils import get_candidate_indexes, index_files_as_statuses, log_rule_failure
+from .rule_utils import (
+    get_candidate_indexes,
+    index_files_as_statuses,
+    log_rule_failure,
+    record_rule_decision,
+)
 
 
 def _extract_filter_node(plan: LogicalPlan):
@@ -80,6 +85,11 @@ class FilterIndexRule:
                 candidates = get_candidate_indexes(
                     index_manager, scan, hybrid_scan=session.hs_conf.hybrid_scan_enabled
                 )
+                if not candidates:
+                    record_rule_decision(
+                        "FilterIndexRule", False, reason="no-candidate-index"
+                    )
+                    return node
                 usable = [
                     c
                     for c in candidates
@@ -91,6 +101,12 @@ class FilterIndexRule:
                     )
                 ]
                 if not usable:
+                    record_rule_decision(
+                        "FilterIndexRule",
+                        False,
+                        reason="not-covering",
+                        candidates=[c.entry.name for c in candidates],
+                    )
                     return node
                 chosen = rank(usable)
                 best = chosen.entry
@@ -133,6 +149,16 @@ class FilterIndexRule:
                 # Always project: preserves the original output column order (the
                 # index stores columns in indexed+included order, not source order).
                 new_plan: LogicalPlan = ProjectNode(list(output_columns), new_filter)
+                record_rule_decision(
+                    "FilterIndexRule",
+                    True,
+                    indexes=[best.name],
+                    bucket_pruned_files=(
+                        None if pruned_files is None else len(pruned_files)
+                    ),
+                    hybrid_appended=len(chosen.appended),
+                    lineage_pruned=len(chosen.deleted),
+                )
                 EventLoggerFactory.get_logger(
                     session.hs_conf.event_logger_class
                 ).log_event(

@@ -26,7 +26,7 @@ from ..index.log_entry import IndexLogEntry
 from ..telemetry.event_logging import EventLoggerFactory
 from ..telemetry.events import HyperspaceIndexUsageEvent
 from ..util.resolver_utils import resolution_key
-from .rule_utils import get_candidate_indexes, log_rule_failure
+from .rule_utils import get_candidate_indexes, log_rule_failure, record_rule_decision
 
 
 def _nkey(name: str, cs: bool) -> str:
@@ -185,9 +185,15 @@ class JoinIndexRule:
                 rnames = r_scan.output_schema.names
                 oriented = _orient_pairs(pairs, lnames, rnames, cs)
                 if oriented is None:
+                    record_rule_decision(
+                        "JoinIndexRule", False, reason="unresolvable-join-columns"
+                    )
                     return node
                 l_to_r = _one_to_one(oriented, cs)
                 if l_to_r is None:
+                    record_rule_decision(
+                        "JoinIndexRule", False, reason="not-one-to-one-keys"
+                    )
                     return node
 
                 lkeys = list(dict.fromkeys(l for l, _ in oriented))
@@ -222,6 +228,17 @@ class JoinIndexRule:
                 r_usable = _usable_indexes(r_candidates, rkeys, r_required, cs)
                 compatible = _compatible_pairs(l_usable, r_usable, l_to_r, cs)
                 if not compatible:
+                    record_rule_decision(
+                        "JoinIndexRule",
+                        False,
+                        reason=(
+                            "no-candidate-index"
+                            if not (l_candidates or r_candidates)
+                            else "no-compatible-index-pair"
+                        ),
+                        left_usable=[c.entry.name for c in l_usable],
+                        right_usable=[c.entry.name for c in r_usable],
+                    )
                     return node
                 lc, rc = rank_join_pairs(compatible)[0]
                 li, ri = lc.entry, rc.entry
@@ -264,6 +281,14 @@ class JoinIndexRule:
                 new_left = substitute(node.left, l_scan, lc)
                 new_right = substitute(node.right, r_scan, rc)
                 new_plan = JoinNode(new_left, new_right, node.condition, node.how)
+                record_rule_decision(
+                    "JoinIndexRule",
+                    True,
+                    indexes=[li.name, ri.name],
+                    buckets=[li.num_buckets, ri.num_buckets],
+                    hybrid_appended=len(lc.appended) + len(rc.appended),
+                    lineage_pruned=len(lc.deleted) + len(rc.deleted),
+                )
                 EventLoggerFactory.get_logger(
                     session.hs_conf.event_logger_class
                 ).log_event(

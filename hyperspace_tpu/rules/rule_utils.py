@@ -160,6 +160,35 @@ def index_files_as_statuses(entry: IndexLogEntry) -> List[FileStatus]:
     ]
 
 
+def record_rule_decision(
+    rule_name: str,
+    applied: bool,
+    reason: Optional[str] = None,
+    indexes: Optional[List[str]] = None,
+    **extra,
+) -> None:
+    """One optimizer-rule decision, recorded at the node where it was made:
+    an `applied`/`skipped` counter in the metrics registry, and (while a
+    query trace is active) a decision entry on the ambient rule span — so
+    `explain(analyze=True)` and the JSONL export can say which rule rewrote
+    the plan and why the others sat out. Recorded only at nodes that MATCHED
+    a rule's pattern (a rule visiting an irrelevant node is not a decision)."""
+    from ..telemetry import metrics, tracing
+
+    verdict = "applied" if applied else "skipped"
+    metrics.counter(f"rule.{rule_name}.{verdict}").inc()
+    sp = tracing.current_span()
+    if sp is not None:
+        d = {"rule": rule_name, "applied": applied}
+        if reason:
+            d["reason"] = reason
+        if indexes:
+            d["indexes"] = list(indexes)
+        if extra:
+            d.update(extra)
+        sp.append_attr("decisions", d)
+
+
 def log_rule_failure(session, rule_name: str, exc: BaseException) -> None:
     """Record a swallowed rule failure: stdlib warning + telemetry event.
 
@@ -173,6 +202,9 @@ def log_rule_failure(session, rule_name: str, exc: BaseException) -> None:
         rule_name,
         type(exc).__name__,
         exc,
+    )
+    record_rule_decision(
+        rule_name, False, reason=f"error: {type(exc).__name__}: {exc}"
     )
     try:
         from ..telemetry.event_logging import EventLoggerFactory

@@ -1,3 +1,4 @@
+from . import metrics, tracing  # noqa: F401
 from .event_logging import (  # noqa: F401
     EventLogger,
     EventLoggerFactory,

@@ -49,9 +49,27 @@ class EventLoggerFactory:
                 if class_name is None:
                     cls._cache[key] = NoOpEventLogger()
                 else:
-                    module_name, _, attr = class_name.rpartition(".")
-                    mod = importlib.import_module(module_name)
-                    cls._cache[key] = getattr(mod, attr)()
+                    # A bad dotted path (typo'd conf, missing module, class
+                    # whose constructor raises) must not escape mid-query —
+                    # and must not stay uncached, which would retry (and
+                    # re-fail) the import on EVERY event. Fall back to the
+                    # no-op logger, cached under the bad name, with one
+                    # warning.
+                    try:
+                        module_name, _, attr = class_name.rpartition(".")
+                        mod = importlib.import_module(module_name)
+                        cls._cache[key] = getattr(mod, attr)()
+                    except Exception as e:
+                        import logging
+
+                        logging.getLogger("hyperspace_tpu.telemetry").warning(
+                            "Event logger class %r failed to load (%s: %s); "
+                            "falling back to NoOpEventLogger",
+                            class_name,
+                            type(e).__name__,
+                            e,
+                        )
+                        cls._cache[key] = NoOpEventLogger()
             return cls._cache[key]
 
     @classmethod

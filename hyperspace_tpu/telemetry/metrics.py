@@ -1,0 +1,222 @@
+"""Process-wide metrics registry: named counters, gauges, and histograms.
+
+The engine's caches and memos each kept private hit/miss integers that only
+bench.py knew how to scrape, and only for the caches it knew about. This
+registry is the one place every component reports to — `scan_cache`,
+`device_cache`, the device memos in `engine/physical`, the decode pool in
+`engine/io`, the optimizer rules, and the Pallas kernel fallbacks — so a
+query's cache behavior is answerable from one `snapshot()` (consumed by
+`bench_detail.metrics_snapshot` and `explain(analyze=True)`).
+
+Contracts:
+- Metric objects are cheap, lock-guarded, and process-wide singletons per
+  name: `counter("cache.scan.hits").inc()` from any thread never loses an
+  update (pinned by tests/test_tracing.py's pool hammer).
+- `snapshot()` is a point-in-time copy (plain dicts, JSON-serializable) and
+  includes derived `rates` for every `<base>.hits`/`<base>.misses` counter
+  pair, so hit RATES ride the bench artifact without consumer arithmetic.
+- Metrics are always on (integer adds; no env gate): unlike spans they cannot
+  trigger device work or allocation growth — the registry holds one object
+  per metric NAME, never per observation.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+
+class Counter:
+    """Monotonic counter. `inc` is atomic under the metric's own lock."""
+
+    __slots__ = ("name", "_lock", "_value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += int(n)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._value = 0
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Last-write-wins scalar (e.g. bytes currently pinned)."""
+
+    __slots__ = ("name", "_lock", "_value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, v) -> None:
+        with self._lock:
+            self._value = v
+
+    def reset(self) -> None:
+        with self._lock:
+            self._value = 0.0
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Summary histogram: count / total / min / max (no buckets — the
+    consumers want aggregate decode/gather costs, not latency curves)."""
+
+    __slots__ = ("name", "_lock", "count", "total", "min", "max")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self.count = 0
+        self.total = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+
+    def observe(self, v) -> None:
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.total += v
+            self.min = v if self.min is None or v < self.min else self.min
+            self.max = v if self.max is None or v > self.max else self.max
+
+    def reset(self) -> None:
+        with self._lock:
+            self.count = 0
+            self.total = 0.0
+            self.min = None
+            self.max = None
+
+    def summary(self) -> dict:
+        with self._lock:
+            return {
+                "count": self.count,
+                "total": round(self.total, 6),
+                "min": self.min,
+                "max": self.max,
+            }
+
+
+class MetricsRegistry:
+    """Name → metric map. Creation is get-or-create under one registry lock;
+    reads/writes of individual metrics take only that metric's lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            m = self._counters.get(name)
+            if m is None:
+                m = self._counters[name] = Counter(name)
+            return m
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            m = self._gauges.get(name)
+            if m is None:
+                m = self._gauges[name] = Gauge(name)
+            return m
+
+    def histogram(self, name: str) -> Histogram:
+        with self._lock:
+            m = self._histograms.get(name)
+            if m is None:
+                m = self._histograms[name] = Histogram(name)
+            return m
+
+    def snapshot(self) -> dict:
+        """Point-in-time copy of every metric, JSON-serializable. Derived
+        `rates` pair up `<base>.hits` / `<base>.misses` counters."""
+        with self._lock:
+            counters = {n: c.value for n, c in self._counters.items()}
+            gauges = {n: g.value for n, g in self._gauges.items()}
+            hists = {n: h.summary() for n, h in self._histograms.items()}
+        rates = {}
+        for name, hits in counters.items():
+            # Exact last-segment match: "memo.pairs.peek_hits" must not pair
+            # (it has no miss twin — a bogus 1.0 rate would ride the bench).
+            base, _, leaf = name.rpartition(".")
+            if leaf != "hits" or not base:
+                continue
+            total = hits + counters.get(base + ".misses", 0)
+            if total:
+                rates[base] = round(hits / total, 4)
+        out = {"counters": counters}
+        if gauges:
+            out["gauges"] = gauges
+        if hists:
+            out["histograms"] = hists
+        if rates:
+            out["rates"] = rates
+        return out
+
+    def reset(self) -> None:
+        """Zero every metric IN PLACE (tests; the bench never resets —
+        lifetime accounting stays monotonic like the cache stats). Metric
+        objects stay registered: hot paths bind them once at import
+        (`device_cache._HITS`, `physical._MEMO_*`, …), so clearing the maps
+        would silently orphan them — their increments would never reach
+        `snapshot()` again."""
+        with self._lock:
+            metrics = (
+                list(self._counters.values())
+                + list(self._gauges.values())
+                + list(self._histograms.values())
+            )
+        for m in metrics:
+            m.reset()
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def global_registry() -> MetricsRegistry:
+    return _REGISTRY
+
+
+def counter(name: str) -> Counter:
+    return _REGISTRY.counter(name)
+
+
+def gauge(name: str) -> Gauge:
+    return _REGISTRY.gauge(name)
+
+
+def histogram(name: str) -> Histogram:
+    return _REGISTRY.histogram(name)
+
+
+def snapshot() -> dict:
+    return _REGISTRY.snapshot()
+
+
+def counters_delta(before: dict, after: dict) -> dict:
+    """Counter names whose value changed between two `snapshot()`s — the
+    per-query attribution `explain(analyze=True)` prints."""
+    b = before.get("counters", {})
+    out = {}
+    for name, v in after.get("counters", {}).items():
+        d = v - b.get(name, 0)
+        if d:
+            out[name] = d
+    return out

@@ -25,6 +25,8 @@ import time
 from collections import deque
 from typing import Dict, Iterator, Optional
 
+from . import tracing
+
 
 class StageTimings:
     """Thread-safe per-stage wall-clock accumulator for a pipelined operation.
@@ -41,6 +43,11 @@ class StageTimings:
         self.mode = mode
         self._t0 = time.monotonic()
         self._wall: Optional[float] = None
+        # Pallas fallback counters at operation START: summary() attaches the
+        # DELTA, so a fallback shows up in the summary of the operation where
+        # it actually happened — not in every later operation's (the counters
+        # themselves are session-cumulative).
+        self._fallbacks0 = pallas_fallback_summary()
 
     def add(self, stage: str, seconds: float) -> None:
         with self._lock:
@@ -69,7 +76,10 @@ class StageTimings:
             out["overlap_ratio"] = round(busy / wall, 3) if wall > 0 else None
             out["mode"] = self.mode
             out["stage_counts"] = dict(sorted(self._counts.items()))
-            return out
+        delta = _fallback_delta(self._fallbacks0, pallas_fallback_summary())
+        if delta:
+            out["pallas_fallbacks"] = delta
+        return out
 
 
 # Most recent index-build / streaming-query / streamed-join stage summaries
@@ -81,9 +91,37 @@ _JOIN_STAGES: "deque[dict]" = deque(maxlen=16)
 _build_stages_lock = threading.Lock()
 
 
+def _fallback_delta(before: dict, after: dict) -> dict:
+    """Per-operation Pallas fallback delta between two `pallas_fallback_
+    summary()` snapshots: only kinds whose diverted-dispatch count GREW, with
+    the latched error strings carried along. Empty when nothing new fell
+    back during the operation."""
+    out: dict = {}
+    for mod_key, a in after.items():
+        bf = before.get(mod_key, {}).get("failures", {})
+        grown = {
+            k: v - bf.get(k, 0)
+            for k, v in a.get("failures", {}).items()
+            if v - bf.get(k, 0) > 0
+        }
+        if grown:
+            ent = {"failures": grown}
+            if a.get("errors"):
+                ent["errors"] = dict(a["errors"])
+            out[mod_key] = ent
+    return out
+
+
 def record_build_stages(summary: dict) -> None:
+    """Record one build's stage summary. Summaries come from `StageTimings.
+    summary()`, which attaches the operation-scoped `pallas_fallbacks` DELTA
+    — a silent host fallback during a build or a streaming scan is visible
+    in THAT operation's summary, and only that one (it previously rode
+    `record_join_stages` alone, as session-cumulative counters)."""
+    d = dict(summary)
     with _build_stages_lock:
-        _BUILD_STAGES.append(dict(summary))
+        _BUILD_STAGES.append(d)
+    tracing.record_stage_spans("build", d)
 
 
 def last_build_stages() -> Optional[dict]:
@@ -102,9 +140,12 @@ def record_query_stages(summary: dict) -> None:
     """Per-stage timings of one streaming query execution (decode/filter/
     partial/merge busy time + wall + overlap ratio) — the read-side twin of
     `record_build_stages`, surfaced through bench.py's
-    ``bench_detail.query_stages``."""
+    ``bench_detail.query_stages``. Pallas fallback deltas ride the summary
+    (see `record_build_stages`)."""
+    d = dict(summary)
     with _build_stages_lock:
-        _QUERY_STAGES.append(dict(summary))
+        _QUERY_STAGES.append(d)
+    tracing.record_stage_spans("query", d)
 
 
 def last_query_stages() -> Optional[dict]:
@@ -123,14 +164,12 @@ def record_join_stages(summary: dict) -> None:
     """Per-stage timings of one streamed join→aggregate execution (pad/probe/
     expand/verify/gather/eval/partial busy time + wall + overlap ratio, plus
     class/outlier counts) — surfaced through bench.py's
-    ``bench_detail.join_stages``. Pallas fallback counters ride along so a
-    silent host fallback is visible next to the timings it explains."""
+    ``bench_detail.join_stages``. Pallas fallback deltas ride the summary so
+    a silent host fallback is visible next to the timings it explains."""
     d = dict(summary)
-    fallbacks = pallas_fallback_summary()
-    if fallbacks:
-        d["pallas_fallbacks"] = fallbacks
     with _build_stages_lock:
         _JOIN_STAGES.append(d)
+    tracing.record_stage_spans("join", d)
 
 
 def last_join_stages() -> Optional[dict]:

@@ -1,0 +1,439 @@
+"""Unified query-lifecycle tracing: a hierarchical span tree per query.
+
+PRs 1–3 bolted three parallel ad-hoc stage recorders onto the engine
+(`record_build_stages` / `record_query_stages` / `record_join_stages`) that
+bench.py scrapes but no query can correlate end-to-end. This module is the
+correlation layer: every user-facing action (`DataFrame.collect/count`,
+`create_index`, `explain(analyze=True)`) opens a ROOT span carrying a stable
+`query_id`; physical operators, the planner, the optimizer rules, and the
+stage summaries of the pipelined executors attach child spans under it — one
+tree answering "where did this query's time go and which caches/rules fired".
+
+Design rules:
+
+- **Off by default, zero device impact.** Spans record only while a sink is
+  active: ``HYPERSPACE_TRACE_FILE`` set (JSONL export), ``HYPERSPACE_TRACING
+  =1``, or a `capture()` scope (what `explain(analyze=True)` uses). When
+  inactive every hook degrades to one predicate check and a shared no-op
+  span — no allocation, no jax import, no new compilations.
+- **Thread-safe child spans.** The ambient parent rides a `contextvars.
+  ContextVar` (per-thread under plain threading); pool workers that outlive
+  the submitting context pass `parent=` explicitly. Span/trace mutation is
+  lock-guarded; a worker that raises inside a `span()` scope closes its span
+  with ``status="error"`` before the exception propagates.
+- **Bounded.** Finished traces land in a ``deque(maxlen=16)`` (same bound as
+  the stage-summary histories); a long-lived session can never grow
+  telemetry with query count. Per-trace span count is capped so a runaway
+  loop inside one traced query cannot hold unbounded memory either.
+- **Device correlation.** While recording, spans opened via `span()` also
+  enter a `jax.profiler.TraceAnnotation` (only when jax is already imported
+  — tracing must never pay the import), so host spans line up with device
+  timelines in an xprof trace taken with `profiling.trace`.
+
+JSONL export (``HYPERSPACE_TRACE_FILE``): one line per span, written when the
+root span ends — `{"query_id", "span_id", "parent_id", "name", "start_s",
+"duration_s", "status", "attrs"}`. Every span of a trace shares the root's
+`query_id`; `parent_id` of the root is null and resolves within the file for
+every other span (schema pinned by tests/test_tracing.py and the CI smoke
+leg).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import os
+import sys
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Dict, Iterator, List, Optional
+
+ENV_TRACE_FILE = "HYPERSPACE_TRACE_FILE"
+ENV_TRACING = "HYPERSPACE_TRACING"
+
+#: Spans per trace hard cap (a traced query touching thousands of operators
+#: keeps the tree, further spans are dropped and counted on the root).
+MAX_SPANS_PER_TRACE = 4096
+
+_RECENT: "deque[QueryTrace]" = deque(maxlen=16)
+_recent_lock = threading.Lock()
+_export_lock = threading.Lock()
+
+_current_span: "contextvars.ContextVar[Optional[Span]]" = contextvars.ContextVar(
+    "hyperspace_current_span", default=None
+)
+_capture: "contextvars.ContextVar[Optional[Capture]]" = contextvars.ContextVar(
+    "hyperspace_trace_capture", default=None
+)
+
+
+def new_query_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+class QueryTrace:
+    """All spans of one root query, in creation order (root first)."""
+
+    def __init__(self, query_id: str):
+        self.query_id = query_id
+        self.spans: List[Span] = []
+        self.dropped = 0
+        self._lock = threading.Lock()
+        self._next_id = 0
+
+    def _register(self, span: "Span") -> bool:
+        with self._lock:
+            if len(self.spans) >= MAX_SPANS_PER_TRACE:
+                self.dropped += 1
+                return False
+            span.span_id = self._next_id
+            self._next_id += 1
+            self.spans.append(span)
+            return True
+
+    @property
+    def root(self) -> "Span":
+        return self.spans[0]
+
+    def spans_by_parent(self) -> Dict[Optional[int], List["Span"]]:
+        out: Dict[Optional[int], List[Span]] = {}
+        with self._lock:
+            for s in self.spans:
+                out.setdefault(s.parent_id, []).append(s)
+        return out
+
+    def find(self, name: str) -> List["Span"]:
+        with self._lock:
+            return [s for s in self.spans if s.name == name]
+
+
+class Span:
+    """One named, timed node of a query's span tree."""
+
+    __slots__ = (
+        "trace",
+        "name",
+        "span_id",
+        "parent_id",
+        "start_s",
+        "_t0",
+        "duration_s",
+        "status",
+        "attrs",
+        "_lock",
+        "_registered",
+    )
+
+    def __init__(self, trace: QueryTrace, name: str, parent_id: Optional[int], attrs=None):
+        self.trace = trace
+        self.name = name
+        self.span_id = -1
+        self.parent_id = parent_id
+        self.start_s = time.time()
+        self._t0 = time.monotonic()
+        self.duration_s: Optional[float] = None
+        self.status = "ok"
+        self.attrs: dict = dict(attrs) if attrs else {}
+        self._lock = threading.Lock()
+        self._registered = trace._register(self)
+
+    @property
+    def query_id(self) -> str:
+        return self.trace.query_id
+
+    def set_attr(self, key: str, value) -> None:
+        with self._lock:
+            self.attrs[key] = value
+
+    def add_attrs(self, **attrs) -> None:
+        with self._lock:
+            self.attrs.update(attrs)
+
+    def append_attr(self, key: str, value) -> None:
+        """Append to a list-valued attribute (rule decisions accumulate)."""
+        with self._lock:
+            self.attrs.setdefault(key, []).append(value)
+
+    def end(self, status: Optional[str] = None, error: Optional[BaseException] = None) -> None:
+        # Locked end-to-end: the exporter's end(status="unclosed") on a
+        # worker span that outlived the root must not interleave with the
+        # worker's own end(error=...) — the first end wins atomically.
+        with self._lock:
+            if self.duration_s is not None:
+                return  # idempotent: the first end wins
+            self.duration_s = max(0.0, time.monotonic() - self._t0)
+            if error is not None:
+                self.status = "error"
+                self.attrs["error"] = f"{type(error).__name__}: {error}"
+            elif status is not None:
+                self.status = status
+
+    def to_json(self) -> dict:
+        # Attrs snapshot under the span lock: a still-running worker span
+        # mutating attrs during export would otherwise raise mid-serialize
+        # (and _finalize's swallow would drop the whole trace's lines).
+        with self._lock:
+            attrs = dict(self.attrs)
+            duration = self.duration_s
+            status = self.status
+        return {
+            "query_id": self.trace.query_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "name": self.name,
+            "start_s": round(self.start_s, 6),
+            "duration_s": None if duration is None else round(duration, 6),
+            "status": status,
+            "attrs": attrs,
+        }
+
+
+class _NoopSpan:
+    """Shared do-nothing span: what every hook gets while tracing is off."""
+
+    __slots__ = ()
+    name = "<noop>"
+    span_id = -1
+    parent_id = None
+    query_id = ""
+    duration_s = 0.0
+    status = "ok"
+    attrs: dict = {}
+
+    def set_attr(self, key, value):
+        pass
+
+    def add_attrs(self, **attrs):
+        pass
+
+    def append_attr(self, key, value):
+        pass
+
+    def end(self, status=None, error=None):
+        pass
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class Capture:
+    """In-memory sink for one traced execution (`explain(analyze=True)` and
+    tests): the next root trace FINISHED on this context lands in `.trace`."""
+
+    def __init__(self):
+        self.trace: Optional[QueryTrace] = None
+
+
+def active() -> bool:
+    """Whether spans should record: any sink is attached. One env lookup on
+    the hot path; everything heavier happens only when this is True."""
+    if _capture.get() is not None:
+        return True
+    if os.environ.get(ENV_TRACE_FILE):
+        return True
+    return os.environ.get(ENV_TRACING) == "1"
+
+
+def current_span():
+    return _current_span.get()
+
+
+def set_attr(key: str, value) -> None:
+    """Attribute on the ambient span; no-op without one (or tracing off)."""
+    sp = _current_span.get()
+    if sp is not None:
+        sp.set_attr(key, value)
+
+
+@contextlib.contextmanager
+def capture() -> Iterator[Capture]:
+    """Force-record the traces started under this scope and hand the first
+    finished root trace to the caller (independent of the env sinks)."""
+    cap = Capture()
+    token = _capture.set(cap)
+    try:
+        yield cap
+    finally:
+        _capture.reset(token)
+
+
+def _annotation(name: str):
+    """`jax.profiler.TraceAnnotation` when jax is ALREADY imported (tracing
+    must never trigger the import), else None. Setup failures are absorbed —
+    the host span still records."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        ann = jax.profiler.TraceAnnotation(name)
+        ann.__enter__()
+        return ann
+    except Exception:
+        return None
+
+
+@contextlib.contextmanager
+def query_span(name: str, **attrs) -> Iterator:
+    """Root span of one user-facing action (collect/count/build/explain).
+
+    Nested under an already-active span (e.g. a scalar subquery's inner
+    collect inside the outer query) it degrades to a plain child span — ONE
+    query_id per outermost action. When no sink is active it yields the
+    shared no-op span."""
+    if not active():
+        yield NOOP_SPAN
+        return
+    parent = _current_span.get()
+    if parent is not None:
+        with span(name, **attrs) as sp:
+            yield sp
+        return
+    trace = QueryTrace(new_query_id())
+    root = Span(trace, name, None, attrs)
+    token = _current_span.set(root)
+    ann = _annotation(name)
+    try:
+        yield root
+        root.end()
+    except BaseException as e:
+        root.end(error=e)
+        raise
+    finally:
+        if ann is not None:
+            try:
+                ann.__exit__(None, None, None)
+            except Exception:
+                pass
+        _current_span.reset(token)
+        _finalize(trace)
+
+
+@contextlib.contextmanager
+def span(name: str, parent=None, **attrs) -> Iterator:
+    """Child span under `parent` (default: the ambient span). Without an
+    ambient root (or with tracing off) it is a no-op — stray spans outside a
+    query never allocate a trace. Exceptions close the span with
+    ``status="error"`` and propagate.
+
+    An EXPLICIT real parent records regardless of this thread's `active()`
+    view: pool workers run in a fresh contextvars context, so the submitting
+    code passing `parent=` is the proof a sink is attached — without this, a
+    worker's span would silently no-op (found by the pool hammer test)."""
+    if parent is None:
+        if not active():
+            yield NOOP_SPAN
+            return
+        parent = _current_span.get()
+    if parent is None or isinstance(parent, _NoopSpan):
+        yield NOOP_SPAN
+        return
+    sp = Span(parent.trace, name, parent.span_id, attrs)
+    token = _current_span.set(sp)
+    ann = _annotation(name)
+    try:
+        yield sp
+        sp.end()
+    except BaseException as e:
+        sp.end(error=e)
+        raise
+    finally:
+        if ann is not None:
+            try:
+                ann.__exit__(None, None, None)
+            except Exception:
+                pass
+        _current_span.reset(token)
+
+
+def record_stage_spans(kind: str, summary: dict, parent=None) -> None:
+    """Adapt one `StageTimings` summary into child spans of the ambient span:
+    per stage a span named ``<kind>:<stage>`` whose duration is that stage's
+    BUSY seconds (stages overlap — they are not a wall-clock partition, which
+    is why `overlap_ratio` rides the summary span), plus one ``<kind>:stages``
+    span carrying the whole summary verbatim. This is the bridge that keeps
+    `bench_detail.*_stages` and the span tree telling the same story: the
+    recorders in `telemetry.profiling` call it on every summary they keep."""
+    if parent is None:
+        if not active():
+            return
+        parent = _current_span.get()
+    if parent is None or isinstance(parent, _NoopSpan):
+        return
+    meta = Span(parent.trace, f"{kind}:stages", parent.span_id)
+    # These spans are SYNTHESIZED at summary-record time (the operation's
+    # end): back-date them by the recorded wall so a timeline viewer places
+    # them inside the operation, not after the root ended. Stage durations
+    # are BUSY seconds summed across workers — concurrent by design, so they
+    # all start at the operation start and legitimately overlap.
+    wall = summary.get("wall_s")
+    wall = float(wall) if isinstance(wall, (int, float)) else 0.0
+    meta.start_s -= wall
+    meta.duration_s = wall
+    meta.set_attr("synthesized", True)
+    counts = summary.get("stage_counts") or {}
+    for key, val in summary.items():
+        if not key.endswith("_s") or key == "wall_s" or not isinstance(val, (int, float)):
+            continue
+        stage = key[:-2]
+        sp = Span(meta.trace, f"{kind}:{stage}", meta.span_id)
+        sp.start_s = meta.start_s
+        sp.set_attr("busy_s", float(val))
+        cnt = counts.get(stage)
+        if cnt is not None:
+            sp.set_attr("count", cnt)
+        sp.duration_s = max(0.0, float(val))
+        sp.status = "ok"
+    try:
+        meta.attrs.update({k: v for k, v in summary.items() if _json_safe(v)})
+    except Exception:
+        pass
+
+
+def _json_safe(v) -> bool:
+    try:
+        json.dumps(v)
+        return True
+    except (TypeError, ValueError):
+        return False
+
+
+def recent_traces() -> List[QueryTrace]:
+    """Finished root traces, oldest first (bounded history, newest last)."""
+    with _recent_lock:
+        return list(_RECENT)
+
+
+def last_trace() -> Optional[QueryTrace]:
+    with _recent_lock:
+        return _RECENT[-1] if _RECENT else None
+
+
+def _finalize(trace: QueryTrace) -> None:
+    """Root ended: bank the trace, hand it to a same-context capture, and
+    export JSONL when the env sink is set. Export failures are swallowed —
+    telemetry must never fail the query it observed."""
+    with _recent_lock:
+        _RECENT.append(trace)
+    cap = _capture.get()
+    if cap is not None and cap.trace is None:
+        cap.trace = trace
+    path = os.environ.get(ENV_TRACE_FILE)
+    if not path:
+        return
+    try:
+        lines = []
+        for s in list(trace.spans):
+            if s.duration_s is None:
+                # A worker span left open (its pool outlived the root): export
+                # it closed at the root's end with an explicit marker rather
+                # than an unparseable null duration.
+                s.end(status="unclosed")
+            lines.append(json.dumps(s.to_json(), default=str))
+        with _export_lock:
+            with open(path, "a") as f:
+                f.write("\n".join(lines) + "\n")
+    except Exception:
+        pass

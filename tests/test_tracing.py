@@ -1,0 +1,374 @@
+"""Unified query-lifecycle tracing: span tree, JSONL export, metrics registry,
+EXPLAIN ANALYZE.
+
+Pins the observability contracts:
+- One indexed streamed join→aggregate query = ONE span tree (one query_id)
+  covering plan → rule → join stages (probe/verify/gather/…) → aggregate,
+  with non-negative durations and parent linkage that resolves, exported as
+  JSONL via ``HYPERSPACE_TRACE_FILE`` (the schema the CI smoke leg checks).
+- `explain(analyze=True)` renders the SAME tree with measured wall times,
+  row counts, cache-hit annotations and the rule decisions.
+- Telemetry concurrency: span creation and metric increments hammered from a
+  thread pool lose nothing; a crashing worker closes its span with error
+  status; trace history is bounded (deque(maxlen=16)).
+- `EventLoggerFactory` falls back to NoOpEventLogger (cached, one warning)
+  on a bad dotted path instead of raising mid-query.
+"""
+
+import json
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import IndexConfig, IndexConstants
+from hyperspace_tpu.engine import HyperspaceSession, col
+from hyperspace_tpu.hyperspace import Hyperspace, enable_hyperspace
+from hyperspace_tpu.telemetry import metrics, tracing
+
+
+@pytest.fixture()
+def session(tmp_path):
+    base = str(tmp_path)
+    s = HyperspaceSession(warehouse=base)
+    s.conf.set(IndexConstants.INDEX_SYSTEM_PATH, os.path.join(base, "indexes"))
+    s.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 8)
+    return s
+
+
+def _indexed_join_agg(s, tmp_path):
+    """Two indexed sides + a streamed join→aggregate query over them."""
+    rng = np.random.RandomState(7)
+    n = 8000
+    li = os.path.join(str(tmp_path), "li")
+    orr = os.path.join(str(tmp_path), "orders")
+    s.write_parquet(
+        {
+            "lk": rng.randint(0, 300, n).astype(np.int64),
+            "v": rng.randint(1, 99, n).astype(np.int64),
+        },
+        li,
+    )
+    s.write_parquet(
+        {
+            "ok": np.arange(300, dtype=np.int64),
+            "w": rng.randint(1, 9, 300).astype(np.int64),
+        },
+        orr,
+    )
+    hs = Hyperspace(s)
+    hs.create_index(s.read.parquet(li), IndexConfig("liIdx", ["lk"], ["v"]))
+    hs.create_index(s.read.parquet(orr), IndexConfig("orIdx", ["ok"], ["w"]))
+    enable_hyperspace(s)
+
+    def q():
+        return (
+            s.read.parquet(li)
+            .join(s.read.parquet(orr), col("lk") == col("ok"))
+            .with_column("rev", col("v") * col("w"))
+            .group_by("lk")
+            .agg(total=("rev", "sum"))
+        )
+
+    return q
+
+
+def _check_jsonl_schema(spans):
+    """The CI smoke leg's schema contract: every span has a query_id, a
+    non-negative duration, and a parent that resolves within its trace."""
+    assert spans, "trace file is empty"
+    ids = {(sp["query_id"], sp["span_id"]) for sp in spans}
+    for sp in spans:
+        assert sp["query_id"], sp
+        assert isinstance(sp["span_id"], int), sp
+        assert sp["duration_s"] is not None and sp["duration_s"] >= 0, sp
+        assert sp["status"] in ("ok", "error", "unclosed"), sp
+        if sp["parent_id"] is not None:
+            assert (sp["query_id"], sp["parent_id"]) in ids, sp
+
+
+def test_indexed_join_agg_single_span_tree(session, tmp_path, monkeypatch):
+    # Pin the STREAMED bucket-join executor (the acceptance shape): under
+    # HYPERSPACE_FORCE_DEVICE_OPS=1 the fused device join→aggregate wins the
+    # dispatch and runs as one program with no stage summaries to bridge.
+    monkeypatch.setenv("HYPERSPACE_FORCE_DEVICE_OPS", "0")
+    monkeypatch.setenv("HYPERSPACE_QUERY_STREAMING", "1")
+    q = _indexed_join_agg(session, tmp_path)
+    trace_file = os.path.join(str(tmp_path), "trace.jsonl")
+    monkeypatch.setenv(tracing.ENV_TRACE_FILE, trace_file)
+    out = q().collect()
+    assert out.num_rows == 300
+    monkeypatch.delenv(tracing.ENV_TRACE_FILE)
+
+    spans = [json.loads(line) for line in open(trace_file)]
+    _check_jsonl_schema(spans)
+    # ONE query = ONE query_id across every exported span.
+    assert len({sp["query_id"] for sp in spans}) == 1
+    names = {sp["name"] for sp in spans}
+    assert "query:collect" in names and "plan" in names
+    assert "op:HashAggregate" in names
+    assert "rule:JoinIndexRule" in names
+    # The streamed bucketed join's stage spans: probe/verify/gather at
+    # minimum (cold run), riding the join:stages summary span.
+    assert "join:stages" in names
+    for stage in ("join:probe", "join:verify", "join:gather"):
+        assert stage in names, sorted(names)
+    # The root is the only parentless span.
+    roots = [sp for sp in spans if sp["parent_id"] is None]
+    assert len(roots) == 1 and roots[0]["name"] == "query:collect"
+    assert roots[0]["attrs"].get("rows_out") == 300
+    # The rule span carries the applied decision with the index names.
+    rule = next(sp for sp in spans if sp["name"] == "rule:JoinIndexRule")
+    decisions = rule["attrs"]["decisions"]
+    assert any(
+        d["applied"] and d["indexes"] == ["liIdx", "orIdx"] for d in decisions
+    )
+
+
+def test_explain_analyze_annotates_measured_tree(session, tmp_path):
+    q = _indexed_join_agg(session, tmp_path)
+    q().collect()  # warm: analyze output must reflect cache hits honestly
+    s = q().explain(analyze=True)
+    assert "EXPLAIN ANALYZE" in s
+    assert "query_id=" in s and "wall=" in s
+    assert "HashAggregate" in s and "SortMergeJoin" in s
+    assert "rows=300" in s
+    assert "JoinIndexRule: applied" in s
+    assert "liIdx" in s and "orIdx" in s
+    assert "bucketed_cache=hit" in s  # warm run reads the concat cache
+    assert "Cache/metric deltas" in s
+    # The redirect form matches the Hyperspace facade form.
+    captured = []
+    Hyperspace(session).explain(q(), analyze=True, redirect=captured.append)
+    assert captured and "EXPLAIN ANALYZE" in captured[0]
+
+
+def test_explain_analyze_plain_query(session, tmp_path):
+    path = os.path.join(str(tmp_path), "t")
+    session.write_parquet(
+        {"k": np.arange(100, dtype=np.int64), "v": np.arange(100, dtype=np.int64)},
+        path,
+    )
+    df = (
+        session.read.parquet(path)
+        .filter(col("k") < 50)
+        .group_by("k")
+        .agg(total=("v", "sum"))
+    )
+    s = df.explain(analyze=True)
+    assert "HashAggregate" in s and "Filter" in s and "Scan" in s
+    assert "rows=50" in s
+    # analyze=False returns the plain static tree.
+    assert df.explain() == df.explain_string()
+
+
+def test_tracing_disabled_records_nothing(session, tmp_path, monkeypatch):
+    monkeypatch.delenv(tracing.ENV_TRACE_FILE, raising=False)
+    monkeypatch.delenv(tracing.ENV_TRACING, raising=False)
+    path = os.path.join(str(tmp_path), "t")
+    session.write_parquet({"k": np.arange(10, dtype=np.int64)}, path)
+    before = len(tracing.recent_traces())
+    session.read.parquet(path).collect()
+    assert len(tracing.recent_traces()) == before
+    # The hooks hand out the shared no-op span.
+    with tracing.query_span("query:off") as sp:
+        assert sp is tracing.NOOP_SPAN
+
+
+def test_span_concurrency_and_error_status():
+    """Pool-worker-shaped hammer: child spans created from many threads under
+    one root all register under one trace; a crashing worker's span closes
+    with error status before the exception propagates."""
+    n_threads, n_tasks = 8, 200
+
+    with tracing.capture() as cap:
+        with tracing.query_span("query:hammer") as root:
+
+            def work(i):
+                if i == 137:
+                    with pytest.raises(RuntimeError):
+                        with tracing.span(f"w{i}", parent=root):
+                            raise RuntimeError("worker died")
+                    return
+                with tracing.span(f"w{i}", parent=root) as sp:
+                    sp.set_attr("i", i)
+
+            with ThreadPoolExecutor(max_workers=n_threads) as pool:
+                list(pool.map(work, range(n_tasks)))
+
+    trace = cap.trace
+    assert trace is not None
+    workers = [s for s in trace.spans if s.name.startswith("w")]
+    assert len(workers) == n_tasks  # no lost spans
+    assert all(s.parent_id == trace.root.span_id for s in workers)
+    assert all(s.duration_s is not None and s.duration_s >= 0 for s in workers)
+    crashed = [s for s in trace.spans if s.name == "w137"]
+    assert len(crashed) == 1 and crashed[0].status == "error"
+    assert "worker died" in crashed[0].attrs["error"]
+    ok = [s for s in workers if s.name != "w137"]
+    assert all(s.status == "ok" for s in ok)
+
+
+def test_metric_increments_lose_nothing_under_threads():
+    c = metrics.counter("test.hammer.counter")
+    h = metrics.histogram("test.hammer.hist")
+    start = c.value
+    n_threads, n_inc = 16, 500
+
+    def work(_):
+        for _i in range(n_inc):
+            c.inc()
+            h.observe(0.5)
+
+    with ThreadPoolExecutor(max_workers=n_threads) as pool:
+        list(pool.map(work, range(n_threads)))
+    assert c.value - start == n_threads * n_inc
+    snap = metrics.snapshot()
+    assert snap["counters"]["test.hammer.counter"] == c.value
+    assert snap["histograms"]["test.hammer.hist"]["count"] >= n_threads * n_inc
+    assert json.dumps(snap)  # bench_detail-serializable
+
+
+def test_snapshot_hit_rates_derive_from_counter_pairs():
+    metrics.counter("test.rate.hits").inc(3)
+    metrics.counter("test.rate.misses").inc(1)
+    snap = metrics.snapshot()
+    assert snap["rates"]["test.rate"] == 0.75
+
+
+def test_trace_history_is_bounded(monkeypatch):
+    monkeypatch.setenv(tracing.ENV_TRACING, "1")
+    for i in range(tracing._RECENT.maxlen + 5):
+        with tracing.query_span(f"query:bounded{i}"):
+            pass
+    recent = tracing.recent_traces()
+    assert len(recent) == tracing._RECENT.maxlen == 16
+    # Newest last; the oldest of the burst aged out.
+    assert recent[-1].root.name == f"query:bounded{tracing._RECENT.maxlen + 4}"
+
+
+def test_nested_collect_stays_one_query(session, tmp_path, monkeypatch):
+    """A scalar-subquery-style nested action inside a traced query attaches
+    as a child span instead of opening a second query_id."""
+    monkeypatch.setenv(tracing.ENV_TRACING, "1")
+    path = os.path.join(str(tmp_path), "t")
+    session.write_parquet({"k": np.arange(20, dtype=np.int64)}, path)
+    with tracing.capture() as cap:
+        with tracing.query_span("query:outer"):
+            session.read.parquet(path).collect()
+    trace = cap.trace
+    assert trace.root.name == "query:outer"
+    inner = trace.find("query:collect")
+    assert len(inner) == 1 and inner[0].parent_id == trace.root.span_id
+
+
+def test_stage_spans_ride_streaming_scan_aggregate(session, tmp_path, monkeypatch):
+    """A multi-file streamed scan→aggregate records query:stages spans under
+    its HashAggregate span (the StageTimings→span bridge)."""
+    from hyperspace_tpu.engine import io as engine_io
+    from hyperspace_tpu.engine.table import Table
+
+    monkeypatch.setenv("HYPERSPACE_QUERY_STREAMING", "1")
+    path = os.path.join(str(tmp_path), "multi")
+    rng = np.random.RandomState(3)
+    for i in range(3):
+        engine_io.write_parquet(
+            Table.from_pydict(
+                {
+                    "g": rng.randint(0, 5, 1000).astype(np.int64),
+                    "x": rng.randint(0, 100, 1000).astype(np.int64),
+                }
+            ),
+            os.path.join(path, f"part-{i:05d}.parquet"),
+        )
+    df = session.read.parquet(path).group_by("g").agg(total=("x", "sum"))
+    with tracing.capture() as cap:
+        df.collect()
+    names = {s.name for s in cap.trace.spans}
+    assert "query:stages" in names, sorted(names)
+    assert any(n.startswith("query:") and n != "query:stages" for n in names)
+
+
+def test_pallas_fallbacks_attach_to_build_and_query_summaries(monkeypatch):
+    """A Pallas fallback during an operation is visible on that operation's
+    build/query stage summary — as a PER-OPERATION delta, not the session
+    counters (a fallback in query 1 must not reappear on every later clean
+    operation's summary). Previously only record_join_stages carried any
+    fallback info at all."""
+    from hyperspace_tpu.ops import pallas_probe
+    from hyperspace_tpu.telemetry import profiling
+
+    counts = {"int": 3}
+    monkeypatch.setattr(pallas_probe, "_fallback_counts", counts)
+    st = profiling.StageTimings(mode="t")  # snapshots the baseline ({int: 3})
+    st.add("decode", 0.1)
+    counts["int"] = 5  # two fallbacks happen DURING the operation
+    profiling.record_build_stages(st.summary())
+    got = profiling.last_build_stages()
+    assert got["pallas_fallbacks"]["probe"]["failures"] == {"int": 2}
+
+    # A clean operation after the fallback latched: no fallback key at all.
+    clean = profiling.StageTimings(mode="t")
+    clean.add("eval", 0.1)
+    profiling.record_query_stages(clean.summary())
+    assert "pallas_fallbacks" not in profiling.last_query_stages()
+
+
+def test_event_logger_bad_path_falls_back_to_noop():
+    from hyperspace_tpu.telemetry import EventLoggerFactory, NoOpEventLogger
+    from hyperspace_tpu.telemetry.events import HyperspaceEvent
+
+    EventLoggerFactory.reset()
+    try:
+        logger = EventLoggerFactory.get_logger("no.such.module.NoSuchLogger")
+        assert isinstance(logger, NoOpEventLogger)
+        logger.log_event(HyperspaceEvent(message="must not raise"))
+        # Cached: the broken import is not retried per event.
+        assert EventLoggerFactory.get_logger("no.such.module.NoSuchLogger") is logger
+        # A bad ATTRIBUTE on a real module falls back the same way.
+        assert isinstance(
+            EventLoggerFactory.get_logger("hyperspace_tpu.telemetry.NoSuchAttr"),
+            NoOpEventLogger,
+        )
+    finally:
+        EventLoggerFactory.reset()
+
+
+def test_rule_skip_reasons_recorded(session, tmp_path, monkeypatch):
+    """An eligible-but-unusable pattern records a skipped decision with a
+    reason (here: indexes exist but none covers the query)."""
+    path = os.path.join(str(tmp_path), "t")
+    session.write_parquet(
+        {
+            "a": np.arange(50, dtype=np.int64),
+            "b": np.arange(50, dtype=np.int64),
+            "c": np.arange(50, dtype=np.int64),
+        },
+        path,
+    )
+    hs = Hyperspace(session)
+    hs.create_index(
+        session.read.parquet(path), IndexConfig("abIdx", ["a"], ["b"])
+    )
+    enable_hyperspace(session)
+    # Filter on a non-head column: the index cannot cover the plan.
+    df = session.read.parquet(path).filter(col("c") > 10).select("c")
+    with tracing.capture() as cap:
+        with tracing.query_span("query:skip"):
+            session.optimize(df.plan)
+    rule_spans = cap.trace.find("rule:FilterIndexRule")
+    assert rule_spans
+    decisions = rule_spans[0].attrs.get("decisions", [])
+    assert any(not d["applied"] and d.get("reason") for d in decisions)
+
+
+def test_traced_query_equals_untraced(session, tmp_path, monkeypatch):
+    """Tracing must observe, never change: identical rows with the trace
+    sink on and off."""
+    q = _indexed_join_agg(session, tmp_path)
+    plain = sorted(map(tuple, q().collect().rows()))
+    monkeypatch.setenv(tracing.ENV_TRACE_FILE, os.path.join(str(tmp_path), "t.jsonl"))
+    traced = sorted(map(tuple, q().collect().rows()))
+    assert traced == plain
