@@ -125,8 +125,12 @@ class OptimizeAction(Action):
             # Re-sort within the bucket by the indexed columns (same contract as the
             # original bucketed write).
             sorted_t, _ = bucketize_table(merged, prev.indexed_columns, prev.num_buckets)
+            # Same bounded row-group layout as the original bucketed write, so
+            # compacted files stay prunable by the scan pushdown's zone maps.
             engine_io.write_parquet(
-                sorted_t, os.path.join(self._index_data_path, f"part-{b:05d}.parquet")
+                sorted_t,
+                os.path.join(self._index_data_path, f"part-{b:05d}.parquet"),
+                row_group_rows=engine_io.index_row_group_rows(),
             )
 
     def log_entry(self) -> LogEntry:

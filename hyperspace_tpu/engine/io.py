@@ -3,6 +3,15 @@
 The engine analogue of Spark's DataSource file formats. Source relations resolve their
 file inventory eagerly at read time (InMemoryFileIndex-style), which is what the
 file-based signature provider fingerprints.
+
+Selective reads (PR 5): parquet footer metadata — row-group boundaries plus
+per-column min/max/null-count zone maps — is parsed once per file and cached
+under the scan-cache budget (`footer_metadata`). A `ScanPredicate`
+(`engine.pushdown`) handed to `read_files`/`iter_file_tables` prunes at
+row-group granularity through those zone maps: only qualifying row groups
+decode (`pruned_file_table`), cached under selection-aware keys.
+``HYPERSPACE_SCAN_PUSHDOWN=0`` disables all of it — the byte-identical
+whole-file fallback.
 """
 
 from __future__ import annotations
@@ -46,9 +55,42 @@ ENV_DECODE_THREADS = "HYPERSPACE_BUILD_DECODE_THREADS"
 ENV_PREFETCH_FILES = "HYPERSPACE_QUERY_PREFETCH_FILES"
 _DEFAULT_PREFETCH_FILES = 16
 
+#: Row-group cap of the per-bucket index files the build writes
+#: (`index/build_pipeline._BucketWriter` AND the serial writer in
+#: `index/builder.py` — the byte-identity contract requires one value).
+#: Bounded, key-sorted row groups give the footer zone maps sub-file
+#: resolution, so indexed point lookups and range filters prune INSIDE a
+#: bucket file, not just across bucket files.
+ENV_INDEX_ROW_GROUP_ROWS = "HYPERSPACE_INDEX_ROW_GROUP_ROWS"
+_DEFAULT_INDEX_ROW_GROUP_ROWS = 65536
+
+
+def index_row_group_rows() -> int:
+    """Row cap of one row group in a written index bucket file (≥1)."""
+    return max(
+        1,
+        int(
+            os.environ.get(ENV_INDEX_ROW_GROUP_ROWS, _DEFAULT_INDEX_ROW_GROUP_ROWS)
+            or _DEFAULT_INDEX_ROW_GROUP_ROWS
+        ),
+    )
+
+
 # Decode-pool work counters, bound once (incremented per cold-file decode).
 _DECODE_FILES = _metrics.counter("io.decode.files")
 _DECODE_SECONDS = _metrics.histogram("io.decode.seconds")
+
+# Footer-metadata cache traffic + row-group pruning outcomes
+# (`bench_detail.io_pruning` and the per-scan span attrs read them). The
+# row-group counters tick per pruning SCAN that actually assembles (a warm
+# concat-cache hit never inflates them — `_record_pruning`); the byte
+# counters tick only at real pruned DECODES (`_record_decoded_bytes`).
+_FOOTER_HITS = _metrics.counter("io.footer.hits")
+_FOOTER_MISSES = _metrics.counter("io.footer.misses")
+_RG_SCANNED = _metrics.counter("io.pruning.row_groups_scanned")
+_RG_SKIPPED = _metrics.counter("io.pruning.row_groups_skipped")
+_RG_BYTES_DECODED = _metrics.counter("io.pruning.bytes_decoded")
+_RG_BYTES_SKIPPED = _metrics.counter("io.pruning.bytes_skipped")
 
 
 def decode_pool_size(n_files: int) -> int:
@@ -118,7 +160,14 @@ def _arrow_to_table(at: pa.Table) -> Table:
                 arr = arr.fill_null(0)
         np_arr = arr.to_numpy(zero_copy_only=False)
         if np_arr.dtype.kind == "O":
-            np_arr = np.asarray([str(x) for x in np_arr])
+            # A ZERO-row object array must stay a string column (np.asarray of
+            # an empty list would infer float64): all-pruned row-group reads
+            # and empty files concat against real string columns.
+            np_arr = (
+                np.empty(0, dtype="<U1")
+                if len(np_arr) == 0
+                else np.asarray([str(x) for x in np_arr])
+            )
         c = Column.from_values(np_arr)
         if validity is not None:
             # Re-apply canonical fills in code/data space (from_values saw fills).
@@ -173,6 +222,166 @@ def _read_json_lines(path: str) -> pa.Table:
         raise HyperspaceException(f"Empty JSON file: {path}")
     names = list(rows[0].keys())
     return pa.table({n: pa.array([r[n] for r in rows]) for n in names})
+
+
+# ---------------------------------------------------------------------------
+# Parquet footer metadata: row-group boundaries + per-column zone maps,
+# parsed ONCE per (path, size, mtime) and cached under the scan-cache budget
+# so pruning decisions never re-open footers.
+# ---------------------------------------------------------------------------
+
+
+class RowGroupMeta:
+    """One row group's shape + per-column `ZoneStats` and byte sizes
+    (keys = schema names; `col_bytes` holds each column chunk's uncompressed
+    size, so byte counters can report the columns actually decoded)."""
+
+    __slots__ = ("num_rows", "total_bytes", "stats", "col_bytes")
+
+    def __init__(self, num_rows: int, total_bytes: int, stats: dict, col_bytes: dict):
+        self.num_rows = num_rows
+        self.total_bytes = total_bytes
+        self.stats = stats
+        self.col_bytes = col_bytes
+
+
+class FileFooterMeta:
+    """One parquet file's footer facts: row count, arrow schema (for empty
+    reads and columns=None name order), and the row-group zone maps."""
+
+    __slots__ = ("num_rows", "names", "arrow_schema", "row_groups")
+
+    def __init__(self, num_rows, names, arrow_schema, row_groups):
+        self.num_rows = num_rows
+        self.names = names
+        self.arrow_schema = arrow_schema
+        self.row_groups = row_groups
+
+
+def _stat_value(v):
+    """Parquet statistics value → the comparison space the engine evaluates
+    in (UTF-8 byte arrays decode to str; undecodable bytes = unusable)."""
+    if isinstance(v, bytes):
+        try:
+            return v.decode("utf-8")
+        except UnicodeDecodeError:
+            return None
+    return v
+
+
+def _parse_footer_meta(path: str) -> FileFooterMeta:
+    from .pushdown import ZoneStats
+
+    with pq.ParquetFile(path) as pf:
+        md = pf.metadata
+        schema = pf.schema_arrow
+        names = list(schema.names)
+        # Column-chunk order == schema leaf order; zone maps are recorded only
+        # for FLAT schemas (leaf count == field count) — nested leaves would
+        # mis-align names, and the engine reads flat tables anyway.
+        flat = md.num_columns == len(names)
+        row_groups: List[RowGroupMeta] = []
+        for i in range(md.num_row_groups):
+            rg = md.row_group(i)
+            stats: Dict[str, object] = {}
+            col_bytes: Dict[str, int] = {}
+            if flat:
+                for j in range(rg.num_columns):
+                    chunk = rg.column(j)
+                    col_bytes[names[j]] = int(chunk.total_uncompressed_size)
+                    st = chunk.statistics
+                    if st is None:
+                        stats[names[j]] = ZoneStats()
+                        continue
+                    mn = mx = None
+                    has = bool(st.has_min_max)
+                    if has:
+                        mn = _stat_value(st.min)
+                        mx = _stat_value(st.max)
+                        has = mn is not None and mx is not None
+                    nulls = st.null_count if st.has_null_count else None
+                    stats[names[j]] = ZoneStats(mn, mx, has, nulls)
+            row_groups.append(
+                RowGroupMeta(rg.num_rows, rg.total_byte_size, stats, col_bytes)
+            )
+    return FileFooterMeta(md.num_rows, names, schema, row_groups)
+
+
+def _meta_nbytes(meta: FileFooterMeta) -> int:
+    """Byte estimate for the scan-cache budget: footers are tiny next to
+    decoded columns, but unbounded growth over huge lakes must still evict."""
+    per_rg = 64 + 96 * max(1, len(meta.names))
+    return 512 + per_rg * max(1, len(meta.row_groups))
+
+
+def footer_metadata(path: str, file_format: str = "parquet") -> Optional[FileFooterMeta]:
+    """Footer metadata of one parquet file through the scan cache (freshness =
+    the cache's (path, size, mtime) base). None for non-parquet formats or an
+    unreadable footer — callers then skip pruning for the file."""
+    if file_format not in ("parquet", "delta"):
+        return None
+    from .scan_cache import global_scan_cache
+
+    cache = global_scan_cache()
+    meta = cache.get_meta(path)
+    if meta is not None:
+        _FOOTER_HITS.inc()
+        return meta
+    _FOOTER_MISSES.inc()
+    try:
+        meta = _parse_footer_meta(path)
+    except Exception:
+        return None  # unreadable/corrupt footer: never break the scan over pruning
+    cache.put_meta(path, meta, _meta_nbytes(meta))
+    return meta
+
+
+def _pushdown_selections(ordered: List[str], file_format: str, pushdown):
+    """Per-file row-group selections of one scan: a list aligned with
+    `ordered` of (meta, sel) — sel None = keep every row group — or None when
+    pushdown is inapplicable or prunes NOTHING anywhere (the caller then runs
+    the plain whole-file path with unchanged cache keys). Pure decision — no
+    counters (`_record_pruning` ticks them only for scans that actually
+    assemble, so a concat-cache hit never inflates them)."""
+    if pushdown is None or file_format not in ("parquet", "delta"):
+        return None
+    out = []
+    any_pruned = False
+    for p in ordered:
+        meta = footer_metadata(p, file_format)
+        sel = pushdown.select_row_groups(meta) if meta is not None else None
+        out.append((meta, sel))
+        if sel is not None:
+            any_pruned = True
+    return out if any_pruned else None
+
+
+def _record_pruning(selections, pruning_stats=None) -> None:
+    """Tick the row-group decision counters for one scan that is really
+    assembling its result (a scan fully served by the concat cache never
+    gets here). Byte counters are decode-truth instead: they tick inside
+    `_decode_rg_into_cache`, so per-file cache hits cannot inflate
+    ``bytes_decoded``. `pruning_stats` (a dict) receives this scan's
+    scanned/skipped totals for the per-scan span attrs."""
+    scanned = skipped = 0
+    for meta, sel in selections:
+        if meta is None:
+            continue
+        n = len(meta.row_groups)
+        if sel is None:
+            scanned += n
+        else:
+            scanned += len(sel)
+            skipped += n - len(sel)
+    _RG_SCANNED.inc(scanned)
+    _RG_SKIPPED.inc(skipped)
+    if pruning_stats is not None:
+        pruning_stats["row_groups_scanned"] = (
+            pruning_stats.get("row_groups_scanned", 0) + scanned
+        )
+        pruning_stats["row_groups_skipped"] = (
+            pruning_stats.get("row_groups_skipped", 0) + skipped
+        )
 
 
 def file_columns_for(columns: Optional[List[str]], partitions) -> Optional[List[str]]:
@@ -235,6 +444,119 @@ def _decode_into_cache(
     return t
 
 
+def _empty_file_table(meta: FileFooterMeta, file_columns: Optional[List[str]]) -> Table:
+    """0-row table with one file's exact decoded dtypes (from its footer
+    schema, no byte decoded) — the ALL-PRUNED outcome. The empty table still
+    flows into concats/streams so dtype promotion and union dictionaries
+    match the unpruned path exactly."""
+    at = meta.arrow_schema.empty_table()
+    if file_columns is not None:
+        at = at.select(file_columns)
+    return _arrow_to_table(at)
+
+
+def _read_row_groups_one(path: str, sel, columns: Optional[List[str]]) -> Table:
+    """Decode ONLY the row groups in `sel` (ascending indices) — pruned bytes
+    are never decoded. Row order is the file's own (row groups in index
+    order), so the surviving rows appear exactly as in a whole-file read
+    minus the pruned groups."""
+    with pq.ParquetFile(path) as pf:
+        at = pf.read_row_groups(list(sel), columns=columns)
+    return _arrow_to_table(at)
+
+
+def selection_columns(
+    file_columns: Optional[List[str]], meta: FileFooterMeta
+) -> List[str]:
+    """THE explicit column list of a selection-keyed cache entry: the
+    requested projection, or the footer's whole-file order for columns=None.
+    Every selection put/get/warm site resolves through here — the key space
+    must be computed identically everywhere (selection entries never consult
+    the whole-file ("names",) record)."""
+    return list(file_columns) if file_columns is not None else list(meta.names)
+
+
+def pruned_file_table(
+    path: str,
+    file_format: str,
+    file_columns: Optional[List[str]],
+    meta: FileFooterMeta,
+    sel,
+) -> Table:
+    """Decoded table of ONE file under a row-group selection, through the
+    per-file scan cache. `sel` None = the plain whole-file path (identical
+    behavior AND cache keys to a non-pushdown read); a tuple = the pruned
+    decode, cached under selection-aware keys so it can never alias the
+    whole-file entries."""
+    if sel is None:
+        return file_table(path, file_format, file_columns)
+    if len(sel) == 0:
+        return _empty_file_table(meta, file_columns)
+    from .scan_cache import global_scan_cache
+
+    cols = selection_columns(file_columns, meta)
+    sel = tuple(sel)
+    t = global_scan_cache().get(path, cols, sel=sel)
+    if t is not None:
+        return t
+    return _decode_rg_into_cache(path, cols, sel, meta)
+
+
+def _record_decoded_bytes(
+    meta: Optional[FileFooterMeta], sel: tuple, decoded_cols: List[str]
+) -> None:
+    """Decode-truth byte counters: ticked ONLY when a pruned decode really
+    runs, never on cache hits, and only for the column chunks actually
+    decoded — ``bytes_decoded``/``bytes_skipped`` measure bytes, not
+    decisions. Skipped bytes are the SAME columns' chunks in the pruned row
+    groups (what a whole-file read of this projection would have paid)."""
+    if meta is None:
+        return
+
+    def cols_bytes(rg) -> int:
+        if not rg.col_bytes:
+            return rg.total_bytes
+        return sum(rg.col_bytes.get(c, 0) for c in decoded_cols)
+
+    keep = set(sel)
+    _RG_BYTES_DECODED.inc(
+        sum(cols_bytes(rg) for i, rg in enumerate(meta.row_groups) if i in keep)
+    )
+    _RG_BYTES_SKIPPED.inc(
+        sum(cols_bytes(rg) for i, rg in enumerate(meta.row_groups) if i not in keep)
+    )
+
+
+def _decode_rg_into_cache(
+    path: str, cols: List[str], sel: tuple, meta: Optional[FileFooterMeta] = None
+) -> Table:
+    """The miss half of `pruned_file_table`: decode only the cold columns of
+    the selection when the cache can tell which those are. The cache only
+    ever stores successful decodes — a fault mid-scan leaves no partial
+    selection entry behind (pinned by tests/test_scan_pushdown.py)."""
+    import time as _time
+
+    from .scan_cache import global_scan_cache
+
+    t0 = _time.monotonic()
+    cache = global_scan_cache()
+    missing = cache.missing_columns(path, cols, sel=sel)
+    if missing and missing != cols:
+        cache.put(path, missing, _read_row_groups_one(path, sel, missing), sel=sel)
+        t = cache.get(path, cols, record=False, sel=sel)
+        if t is not None:
+            _record_decoded_bytes(meta, sel, missing)
+            _DECODE_FILES.inc()
+            _DECODE_SECONDS.observe(_time.monotonic() - t0)
+            return t
+    t = _read_row_groups_one(path, sel, cols)
+    cache.put(path, cols, t, sel=sel)
+    _record_decoded_bytes(meta, sel, cols)
+    _DECODE_FILES.inc()
+    _DECODE_SECONDS.observe(_time.monotonic() - t0)
+    return t
+
+
 def decorate_file_table(
     t: Table,
     path: str,
@@ -255,28 +577,47 @@ def decorate_file_table(
 
 
 def warm_file_cache(
-    paths: List[str], file_format: str, file_columns: Optional[List[str]]
+    paths: List[str],
+    file_format: str,
+    file_columns: Optional[List[str]],
+    selections=None,
 ) -> None:
     """Concurrently decode the cache-cold files among `paths` into the per-file
     scan cache (shared decode-pool contract). Callers that must consume files
     in a fixed order one at a time (the bucketed index scan) call this first so
     the serial consumption loop runs fully warm — cold indexed reads previously
-    decoded every bucket file back-to-back on one thread."""
+    decoded every bucket file back-to-back on one thread.
+
+    `selections` (path → (meta, sel), from `_pushdown_selections`) warms the
+    SELECTION-keyed entries for files a pushdown decision pruned: the pool
+    decodes exactly the surviving row groups."""
     from .scan_cache import global_scan_cache
 
     cache = global_scan_cache()
-    missing = [p for p in paths if cache.missing_columns(p, file_columns) != []]
-    workers = decode_pool_size(len(missing)) if missing else 0
-    if len(missing) > 1 and workers > 1:
+    jobs = []  # (path, sel_or_None, explicit cols for the sel path)
+    for p in paths:
+        meta, sel = (selections or {}).get(p, (None, None))
+        if sel is None:
+            if cache.missing_columns(p, file_columns) != []:
+                jobs.append((p, None, None))
+        elif len(sel) > 0:
+            cols = selection_columns(file_columns, meta)
+            if cache.missing_columns(p, cols, sel=tuple(sel)) != []:
+                jobs.append((p, tuple(sel), cols))
+    workers = decode_pool_size(len(jobs)) if jobs else 0
+    if len(jobs) > 1 and workers > 1:
         from concurrent.futures import ThreadPoolExecutor
 
+        def warm_one(job):
+            p, sel, cols = job
+            if sel is None:
+                _decode_into_cache(p, file_format, file_columns)
+            else:
+                meta, _sel = (selections or {}).get(p, (None, None))
+                _decode_rg_into_cache(p, cols, sel, meta)
+
         with ThreadPoolExecutor(max_workers=workers) as pool:
-            list(
-                pool.map(
-                    lambda p: _decode_into_cache(p, file_format, file_columns),
-                    missing,
-                )
-            )
+            list(pool.map(warm_one, jobs))
 
 
 def iter_file_tables(
@@ -285,6 +626,8 @@ def iter_file_tables(
     columns: Optional[List[str]] = None,
     partitions=None,
     on_decode=None,
+    pushdown=None,
+    pruning_stats=None,
 ):
     """Ordered per-file table iterator with bounded decode prefetch — the
     read-side twin of the build pipeline's decode stage. Files decode on a
@@ -295,6 +638,14 @@ def iter_file_tables(
     yield point; already-submitted decodes finish into the cache harmlessly
     (the cache only ever stores successful decodes — no poisoned entries).
 
+    `pushdown` (a `ScanPredicate`) prunes at ROW-GROUP granularity: each
+    file's footer zone maps decide the surviving row groups up front, and the
+    per-file tables yielded here carry ONLY those groups — pruned bytes are
+    never decoded, staged, or filtered, and the streaming executor's chunks
+    then align to the surviving groups by construction. An all-pruned file
+    yields its 0-row schema table so downstream dtype promotion matches the
+    unpruned stream.
+
     `on_decode(seconds)` observes each file's decode wall time (telemetry)."""
     if not files:
         return
@@ -303,10 +654,21 @@ def iter_file_tables(
 
     file_columns = file_columns_for(columns, partitions)
     ordered = sorted(files)
+    selections = _pushdown_selections(ordered, file_format, pushdown)
+    sel_of = {}
+    if selections is not None:
+        # The stream always assembles (no concat-cache level), so the
+        # decision counters tick per streamed scan.
+        _record_pruning(selections, pruning_stats)
+        sel_of = dict(zip(ordered, selections))
 
     def decode_one(path: str) -> Table:
         t0 = _time.monotonic()
-        t = file_table(path, file_format, file_columns)
+        meta, sel = sel_of.get(path, (None, None))
+        if sel is None:
+            t = file_table(path, file_format, file_columns)
+        else:
+            t = pruned_file_table(path, file_format, file_columns, meta, sel)
         if on_decode is not None:
             on_decode(_time.monotonic() - t0)
         return t
@@ -340,12 +702,18 @@ def concat_cache_probe(
     file_format: str,
     columns: Optional[List[str]],
     partitions,
+    selection_marker=None,
 ) -> Tuple[Optional[tuple], Optional[Table]]:
     """(key, cached table or None) for the multi-file concat cache. Key =
     per-file (path,size,mtime) + columns + partition layout, so any file
     rewrite (or a different partition interpretation of the same files)
     invalidates. Shared by `read_files` and the pipelined index build (a warm
-    source concat skips the build's whole decode stage)."""
+    source concat skips the build's whole decode stage).
+
+    `selection_marker` (the per-file row-group selections of a pushdown scan,
+    aligned with the sorted file order) keys PRUNED concats apart from whole
+    ones — and two predicates surviving to the same selections share one
+    entry, because the selection fully determines the bytes read."""
     if len(files) <= 1:
         return None, None
     from .scan_cache import global_concat_cache
@@ -367,6 +735,8 @@ def concat_cache_probe(
             ("<all>",) if columns is None else tuple(columns),
             part_marker,
         )
+        if selection_marker is not None:
+            concat_key = concat_key + (("rgsel", selection_marker),)
     except OSError:
         return None, None
     hit = global_concat_cache().get(concat_key)
@@ -378,30 +748,76 @@ def read_files(
     file_format: str,
     columns: Optional[List[str]] = None,
     partitions=None,
+    pushdown=None,
+    pruning_stats=None,
 ) -> Table:
     """Read + concat data files. `partitions` = (PartitionSpec, root_paths) for
     hive-partitioned sources: the per-file cache holds the RAW file content (the
     partition values are path facts, not file content) and the constant partition
-    columns are appended per file before the concat."""
+    columns are appended per file before the concat.
+
+    `pushdown` (a `ScanPredicate`) evaluates each file's footer zone maps and
+    decodes only the qualifying row groups (`pruned_file_table`). When it
+    prunes NOTHING, the call is bit-and-key-identical to a pushdown-free read
+    — the concat entry stays shared with every other consumer of these files.
+    All-pruned files contribute their 0-row schema tables so concat dtype
+    promotion and union dictionaries match the whole-file path exactly."""
     if not files:
         raise HyperspaceException("No data files to read.")
     from .scan_cache import global_concat_cache
 
+    ordered = sorted(files)
+    file_columns = file_columns_for(columns, partitions)
+    selections = _pushdown_selections(ordered, file_format, pushdown)
+    sel_marker = (
+        None
+        if selections is None
+        else tuple(sel for _meta, sel in selections)
+    )
+
     # Multi-file concat cache: re-assembling N per-file tables (and re-unioning
     # string dictionaries) per query dominates repeated multi-file scans — e.g.
     # a filter-index scan over num_buckets small files.
-    concat_key, cached = concat_cache_probe(files, file_format, columns, partitions)
+    concat_key, cached = concat_cache_probe(
+        files, file_format, columns, partitions, selection_marker=sel_marker
+    )
     if cached is not None:
         return cached
-
-    file_columns = file_columns_for(columns, partitions)
+    if selections is not None:
+        # Past the concat probe: this scan really assembles, so its pruning
+        # decision counts (a warm repeat served above never gets here).
+        _record_pruning(selections, pruning_stats)
 
     from .scan_cache import global_scan_cache
 
     cache = global_scan_cache()
-    ordered = sorted(files)
-    tables: List[Optional[Table]] = [cache.get(f, file_columns) for f in ordered]
-    missing = [i for i, t in enumerate(tables) if t is None]
+    if selections is None:
+        tables: List[Optional[Table]] = [cache.get(f, file_columns) for f in ordered]
+        missing = [i for i, t in enumerate(tables) if t is None]
+        decode_miss = lambda i: _decode_into_cache(
+            ordered[i], file_format, file_columns
+        )
+    else:
+        tables = []
+        for f, (meta, sel) in zip(ordered, selections):
+            if sel is None:
+                tables.append(cache.get(f, file_columns))
+            elif len(sel) == 0:
+                tables.append(_empty_file_table(meta, file_columns))
+            else:
+                tables.append(
+                    cache.get(f, selection_columns(file_columns, meta), sel=tuple(sel))
+                )
+        missing = [i for i, t in enumerate(tables) if t is None]
+
+        def decode_miss(i: int) -> Table:
+            meta, sel = selections[i]
+            if sel is None:
+                return _decode_into_cache(ordered[i], file_format, file_columns)
+            return _decode_rg_into_cache(
+                ordered[i], selection_columns(file_columns, meta), tuple(sel), meta
+            )
+
     workers = decode_pool_size(len(missing)) if missing else 0
     if len(missing) > 1 and workers > 1:
         # Decode cache misses concurrently: parquet/csv decode is pyarrow C++ work
@@ -413,17 +829,12 @@ def read_files(
         from concurrent.futures import ThreadPoolExecutor
 
         with ThreadPoolExecutor(max_workers=workers) as pool:
-            decoded = list(
-                pool.map(
-                    lambda i: _decode_into_cache(ordered[i], file_format, file_columns),
-                    missing,
-                )
-            )
+            decoded = list(pool.map(decode_miss, missing))
         for i, t in zip(missing, decoded):
             tables[i] = t
     else:
         for i in missing:
-            tables[i] = _decode_into_cache(ordered[i], file_format, file_columns)
+            tables[i] = decode_miss(i)
 
     if partitions is not None:
         tables = [
@@ -487,9 +898,15 @@ def table_to_arrow(table: Table) -> pa.Table:
     return pa.table(dict(zip(names, arrays)))
 
 
-def write_parquet(table: Table, path: str) -> None:
+def write_parquet(table: Table, path: str, row_group_rows: Optional[int] = None) -> None:
+    """`row_group_rows` bounds the written row groups (None = pyarrow's
+    default) — the index writers pass `index_row_group_rows()` so footer zone
+    maps get sub-file resolution over the key-sorted bucket rows."""
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    pq.write_table(table_to_arrow(table), path)
+    if row_group_rows is None:
+        pq.write_table(table_to_arrow(table), path)
+    else:
+        pq.write_table(table_to_arrow(table), path, row_group_size=int(row_group_rows))
 
 
 def write_orc(table: Table, path: str) -> None:

@@ -163,6 +163,31 @@ class PhysicalNode:
         return out
 
 
+def _resolution_case_sensitive(ctx, schema_names) -> bool:
+    """Effective case sensitivity for pushdown conjunct resolution: the
+    session conf, FORCED case-sensitive when the schema case-collides (the
+    same guard as `FilterExec._condition_key` — with both 'X' and 'x'
+    present, resolution is exact-match-first and the spellings read
+    different columns)."""
+    cs = (
+        ctx.session.hs_conf.case_sensitive
+        if ctx is not None and ctx.session is not None
+        else False
+    )
+    if len({n.lower() for n in schema_names}) != len(schema_names):
+        cs = True
+    return cs
+
+
+def _set_pruning_attrs(stats: Dict[str, int]) -> None:
+    """Surface one scan's row-group pruning outcome on the current trace span
+    (rendered by explain(analyze=True)); no-op when nothing pruned."""
+    if not stats:
+        return
+    _tracing.set_attr("row_groups_scanned", int(stats.get("row_groups_scanned", 0)))
+    _tracing.set_attr("row_groups_skipped", int(stats.get("row_groups_skipped", 0)))
+
+
 def _default_scan_columns(relation: SourceRelation, columns):
     """Effective column list when `columns` is None ("everything"): for an
     INDEX relation, "everything" means the VISIBLE schema — the internal
@@ -185,6 +210,33 @@ class ScanExec(PhysicalNode):
     def __init__(self, relation: SourceRelation, columns: Optional[List[str]] = None):
         self.relation = relation
         self.columns = columns
+        #: Conjunctive filter of the FilterExec DIRECTLY above this scan, set
+        #: by the planner. Purely advisory pruning: with it set, execute /
+        #: execute_stream may omit rows the predicate provably rejects (the
+        #: owning filter drops them anyway), by skipping parquet row groups
+        #: whose footer zone maps exclude the conjuncts. execute_count keeps
+        #: reporting the FULL file row count — the owning filter never counts
+        #: through the scan.
+        self.pushdown: Optional[Expr] = None
+
+    def _pushdown_pred(self, ctx):
+        """The compiled `ScanPredicate`, or None whenever pushdown cannot
+        apply (disabled, non-parquet, bucketed/hybrid relation, or no
+        prunable conjunct)."""
+        if self.pushdown is None:
+            return None
+        rel = self.relation
+        if rel.file_format not in ("parquet", "delta"):
+            return None
+        if rel.hybrid_append is not None or rel.bucket_spec is not None:
+            return None
+        from .pushdown import ScanPredicate, pushdown_enabled
+
+        if not pushdown_enabled():
+            return None
+        return ScanPredicate.from_condition(
+            self.pushdown, _resolution_case_sensitive(ctx, rel.schema.names)
+        )
 
     def execute(self, ctx) -> Table:
         if self.relation.hybrid_append is not None and self.relation.bucket_spec is not None:
@@ -202,9 +254,17 @@ class ScanExec(PhysicalNode):
         partitions = None
         if self.relation.partition_spec is not None:
             partitions = (self.relation.partition_spec, self.relation.root_paths)
-        return engine_io.read_files(
-            files, self.relation.file_format, cols, partitions=partitions
+        stats: Dict[str, int] = {}
+        out = engine_io.read_files(
+            files,
+            self.relation.file_format,
+            cols,
+            partitions=partitions,
+            pushdown=self._pushdown_pred(ctx),
+            pruning_stats=stats,
         )
+        _set_pruning_attrs(stats)
+        return out
 
     def execute_count(self, ctx) -> int:
         rel = self.relation
@@ -224,7 +284,9 @@ class ScanExec(PhysicalNode):
         the shared pool ahead of the consumer, through the per-column scan
         cache) split into row chunks. Chunk boundaries never change values or
         concat order, so consuming this stream through `Table.concat` equals
-        `execute` exactly."""
+        `execute` exactly. With a pushdown predicate, the per-file tables
+        carry only the surviving row groups — chunks align to them and
+        pruned bytes never enter the stream."""
         from .streaming import query_chunk_rows, split_chunks
 
         cols = _default_scan_columns(self.relation, self.columns)
@@ -234,11 +296,19 @@ class ScanExec(PhysicalNode):
             partitions = (self.relation.partition_spec, self.relation.root_paths)
         on_decode = None if stages is None else (lambda s: stages.add("decode", s))
         chunk_rows = query_chunk_rows()
+        stats: Dict[str, int] = {}
         for t in engine_io.iter_file_tables(
-            files, self.relation.file_format, cols, partitions, on_decode=on_decode
+            files,
+            self.relation.file_format,
+            cols,
+            partitions,
+            on_decode=on_decode,
+            pushdown=self._pushdown_pred(ctx),
+            pruning_stats=stats,
         ):
             for ch in split_chunks(t, chunk_rows):
                 yield ch
+        _set_pruning_attrs(stats)
 
     def simple_string(self):
         cols = f" [{', '.join(self.columns)}]" if self.columns else ""
@@ -261,9 +331,33 @@ class BucketedIndexScanExec(PhysicalNode):
         self.relation = relation
         self.columns = columns
 
-    def execute_buckets(self, ctx) -> List[Optional[Table]]:
+    def _assemble_buckets(self, read_one) -> List[Optional[Table]]:
+        """Per-bucket tables from this scan's `part-<bucket>` files, each
+        file's table produced by `read_one(path)` — THE bucket-assembly loop
+        (file order, bucket-id parse, per-bucket concat), shared by the plain
+        and row-group-pruned paths so their row order can never diverge."""
         spec = self.relation.bucket_spec
         buckets: List[Optional[Table]] = [None] * spec.num_buckets
+        for f in self.relation.files:
+            m = _BUCKET_FILE_RE.search(os.path.basename(f.path))
+            if m is None:
+                raise HyperspaceException(f"Not a bucketed index file: {f.path}")
+            b = int(m.group(1))
+            t = read_one(f.path)
+            buckets[b] = t if buckets[b] is None else Table.concat([buckets[b], t])
+        return buckets
+
+    @staticmethod
+    def _concat_with_starts(buckets, empty_table) -> Tuple[Table, np.ndarray]:
+        """One contiguous table + bucket start offsets from per-bucket tables
+        — shared tail of the plain and pruned concats."""
+        sizes = [0 if t is None else t.num_rows for t in buckets]
+        starts = np.zeros(len(buckets) + 1, dtype=np.int64)
+        np.cumsum(sizes, out=starts[1:])
+        tables = [t for t in buckets if t is not None and t.num_rows > 0]
+        return (Table.concat(tables) if tables else empty_table()), starts
+
+    def execute_buckets(self, ctx) -> List[Optional[Table]]:
         cols = _default_scan_columns(self.relation, self.columns)
         # Cold reads: decode every cache-cold bucket file on the shared pool
         # FIRST (pyarrow releases the GIL), then assemble serially from the
@@ -272,13 +366,9 @@ class BucketedIndexScanExec(PhysicalNode):
         engine_io.warm_file_cache(
             [f.path for f in self.relation.files], self.relation.file_format, cols
         )
-        for f in self.relation.files:
-            m = _BUCKET_FILE_RE.search(os.path.basename(f.path))
-            if m is None:
-                raise HyperspaceException(f"Not a bucketed index file: {f.path}")
-            b = int(m.group(1))
-            t = engine_io.read_files([f.path], self.relation.file_format, cols)
-            buckets[b] = t if buckets[b] is None else Table.concat([buckets[b], t])
+        buckets = self._assemble_buckets(
+            lambda p: engine_io.read_files([p], self.relation.file_format, cols)
+        )
         if self.relation.hybrid_append is not None:
             self._merge_appended(buckets)
         return buckets
@@ -335,6 +425,62 @@ class BucketedIndexScanExec(PhysicalNode):
                 continue
             part = sorted_t.take(np.arange(lo, hi))
             buckets[b] = part if buckets[b] is None else Table.concat([buckets[b], part])
+
+    def execute_pruned_concat(self, ctx, condition) -> Optional[Tuple[Table, np.ndarray]]:
+        """Row-group-PRUNED concat of this bucketed scan under `condition`:
+        each `part-<bucket>` file decodes only the row groups whose footer
+        zone maps can satisfy the condition (the build writes buckets with
+        bounded, key-sorted row groups precisely so equality/range filters
+        resolve inside a bucket file). Returns (table, starts) over the
+        SURVIVING rows — a row-subset of `execute_concat`'s table in the same
+        order, so applying the condition afterwards yields byte-identical
+        rows and bucket boundaries.
+
+        None whenever the pruned path cannot apply (pushdown disabled,
+        hybrid-appended rows, unreadable footers, no prunable conjunct, or
+        nothing actually pruned) — the caller then takes the plain path,
+        which also populates the full bucketed-concat cache exactly as
+        before."""
+        from .pushdown import ScanPredicate, pushdown_enabled
+
+        rel = self.relation
+        if not pushdown_enabled() or rel.hybrid_append is not None:
+            return None
+        if rel.file_format not in ("parquet", "delta"):
+            return None
+        pred = ScanPredicate.from_condition(
+            condition, _resolution_case_sensitive(ctx, rel.schema.names)
+        )
+        if pred is None:
+            return None
+        cols = _default_scan_columns(rel, self.columns)
+        selections = engine_io._pushdown_selections(
+            [f.path for f in rel.files], rel.file_format, pred
+        )
+        if selections is None:
+            return None
+        stats: Dict[str, int] = {}
+        engine_io._record_pruning(selections, stats)
+        sel_of = dict(
+            zip([f.path for f in rel.files], selections)
+        )
+        # Decode the cold (pruned or whole) files on the shared pool first,
+        # then assemble serially from the warm cache — the pruned twin of
+        # `execute_buckets`' warm_file_cache step.
+        engine_io.warm_file_cache(
+            [f.path for f in rel.files], rel.file_format, cols, selections=sel_of
+        )
+        buckets = self._assemble_buckets(
+            lambda p: engine_io.pruned_file_table(
+                p, rel.file_format, cols, *sel_of[p]
+            )
+        )
+        table, starts = self._concat_with_starts(buckets, self.empty_table)
+        # The pruned path never consults the bucketed-concat cache — report
+        # that honestly (every cold bucketed scan carries a cache verdict).
+        _tracing.set_attr("bucketed_cache", "pruned-bypass")
+        _set_pruning_attrs(stats)
+        return table, starts
 
     def empty_table(self) -> Table:
         """Empty table with this scan's (pruned) schema."""
@@ -396,11 +542,7 @@ class BucketedIndexScanExec(PhysicalNode):
             # must not suggest otherwise.
             _tracing.set_attr("bucketed_cache", "uncacheable")
         buckets = self.execute_buckets(ctx)
-        sizes = [0 if t is None else t.num_rows for t in buckets]
-        starts = np.zeros(len(buckets) + 1, dtype=np.int64)
-        np.cumsum(sizes, out=starts[1:])
-        tables = [t for t in buckets if t is not None and t.num_rows > 0]
-        table = Table.concat(tables) if tables else self.empty_table()
+        table, starts = self._concat_with_starts(buckets, self.empty_table)
         if key is not None:
             global_bucketed_cache().put(key, table, starts)
         return table, starts
@@ -515,7 +657,24 @@ class FilterExec(PhysicalNode):
             hit = global_filtered_cache().get(key)
             if hit is not None:
                 return hit
-        table, starts = child.execute_concat(ctx)
+        # Cold: try the row-group-pruned bucket assembly — the pruned table
+        # is a row-subset of the full concat in identical order, so the
+        # filter below yields byte-identical rows AND identical bucket
+        # boundaries (surviving-row counts per bucket are what both paths
+        # searchsort over). The cache entry under `key` is therefore the same
+        # value either way. Skipped when the FULL concat is already warm
+        # (filtering in memory beats re-decoding pruned row groups from
+        # disk); when nothing prunes, the plain path runs and populates the
+        # full bucketed-concat cache exactly as before.
+        from .scan_cache import global_bucketed_cache
+
+        pruned = None
+        if base_key is None or not global_bucketed_cache().contains(base_key):
+            pruned = child.execute_pruned_concat(ctx, self.condition)
+        if pruned is not None:
+            table, starts = pruned
+        else:
+            table, starts = child.execute_concat(ctx)
         if table.num_rows:
             mask = evaluate_predicate(self.condition, table)
             keep = nonzero_indices(mask)  # ascending → in-bucket order kept
@@ -536,14 +695,7 @@ class FilterExec(PhysicalNode):
         cache entry."""
         from .expr import canonical_condition_repr
 
-        cs = (
-            ctx.session.hs_conf.case_sensitive
-            if ctx is not None and ctx.session is not None
-            else False
-        )
-        names = self.child.relation.schema.names
-        if len({n.lower() for n in names}) != len(names):
-            cs = True
+        cs = _resolution_case_sensitive(ctx, self.child.relation.schema.names)
         return canonical_condition_repr(self.condition, cs)
 
     def rows_token(self, ctx=None):
@@ -2670,7 +2822,14 @@ def plan_physical(
                 child_required = list(
                     dict.fromkeys(list(logical.child.output_schema.names) + refs)
                 )
-        return FilterExec(logical.condition, plan_physical(logical.child, child_required, case_sensitive))
+        child_phys = plan_physical(logical.child, child_required, case_sensitive)
+        if type(child_phys) is ScanExec:
+            # Thread the filter's conjuncts into the scan it sits on: the
+            # scan may skip parquet row groups whose zone maps prove no row
+            # can pass this exact filter (advisory — the FilterExec still
+            # evaluates the full condition over whatever the scan returns).
+            child_phys.pushdown = logical.condition
+        return FilterExec(logical.condition, child_phys)
 
     if isinstance(logical, ProjectNode):
         return ProjectExec(

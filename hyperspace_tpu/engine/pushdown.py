@@ -1,0 +1,211 @@
+"""Predicate pushdown: conjunct normalization + the zone-map evaluator.
+
+One home for the "can a value zone [min, max] (+ null counts) contain a row
+satisfying `col op literal`?" decision, shared by every pruning consumer:
+
+- the scan layer's row-group pruning (`engine.io.read_files` /
+  `iter_file_tables` evaluate a `ScanPredicate` against parquet footer
+  zone maps and decode only qualifying row groups),
+- the filtered bucketed index scan (`FilterExec.execute_concat` pruning
+  inside `part-<bucket>` files),
+- `DataSkippingFilterRule`'s MinMaxSketch — both the per-FILE sketch and its
+  per-ROW-GROUP variant prune through `minmax_keeps`/`zone_keeps` here.
+
+Soundness contract: a zone is pruned only when NO row in it can satisfy the
+conjunct under the engine's evaluation semantics (`engine.evaluate`):
+comparisons with null are unknown and WHERE drops unknowns, so an all-null
+zone satisfies no comparison; float zones are never pruned on `!=` (a NaN row
+satisfies `x != lit` but parquet min/max statistics exclude NaN); any type
+mismatch keeps the zone. Pruned rows are therefore exactly rows the
+downstream filter would have dropped — results are byte-identical with
+pruning on or off (the ``HYPERSPACE_SCAN_PUSHDOWN=0`` oracle, pinned by
+tests/test_scan_pushdown.py).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence, Tuple
+
+from .expr import BinaryOp, Col, Expr, IsIn, IsNull, Lit, split_conjuncts
+
+#: On/off switch for the whole row-group pushdown path (scan + bucketed
+#: filter pruning). ``0`` = the byte-identical whole-file fallback — the same
+#: contract style as ``HYPERSPACE_QUERY_STREAMING`` / size classes.
+ENV_SCAN_PUSHDOWN = "HYPERSPACE_SCAN_PUSHDOWN"
+
+
+def pushdown_enabled() -> bool:
+    """Default ON; ``HYPERSPACE_SCAN_PUSHDOWN=0`` disables every row-group
+    pruning decision (whole files decode exactly as before the pushdown)."""
+    return os.environ.get(ENV_SCAN_PUSHDOWN, "") != "0"
+
+
+_FLIPPED = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "==": "==", "!=": "!="}
+
+
+def normalize_conjunct(e: Expr) -> Optional[tuple]:
+    """(op, column_name, literal(s)) for zone-prunable conjunct shapes:
+
+    - ``(cmp, col, value)`` for `col <cmp> lit` (either orientation),
+    - ``("in", col, [values])`` for `col IN [...]`,
+    - ``("isnull" | "isnotnull", col, None)``.
+
+    None for anything else (arithmetic, OR, UDFs, col-vs-col) — those
+    conjuncts simply cannot prune."""
+    if isinstance(e, IsIn) and isinstance(e.child, Col):
+        return ("in", e.child.name, e.values)
+    if isinstance(e, IsNull) and isinstance(e.child, Col):
+        return ("isnotnull" if e.negated else "isnull", e.child.name, None)
+    if not isinstance(e, BinaryOp) or e.op not in BinaryOp.COMPARISONS:
+        return None
+    l, r = e.left, e.right
+    if isinstance(l, Col) and isinstance(r, Lit):
+        return (e.op, l.name, r.value)
+    if isinstance(l, Lit) and isinstance(r, Col):
+        return (_FLIPPED[e.op], r.name, l.value)
+    return None
+
+
+def prunable_conjuncts(condition: Expr) -> List[tuple]:
+    """The normalized prunable conjuncts of a condition's CNF split."""
+    out = []
+    for c in split_conjuncts(condition):
+        n = normalize_conjunct(c)
+        if n is not None:
+            out.append(n)
+    return out
+
+
+def _is_floatish(v) -> bool:
+    import numpy as np
+
+    return isinstance(v, (float, np.floating))
+
+
+def minmax_keeps(op: str, value, mn, mx) -> bool:
+    """Can a zone with value range [mn, mx] contain a row satisfying
+    `col op value`? Conservative: incomparable types and `!=` keep (the
+    NaN-aware `!=` refinement lives in `zone_keeps`, which knows the
+    zone's null/float facts)."""
+    try:
+        if op == "==":
+            return mn <= value <= mx
+        if op == "<":
+            return mn < value
+        if op == "<=":
+            return mn <= value
+        if op == ">":
+            return mx > value
+        if op == ">=":
+            return mx >= value
+    except TypeError:
+        return True  # incomparable types: never prune
+    return True  # "!=" and anything else: cannot prune here
+
+
+class ZoneStats:
+    """One zone's statistics: value bounds over the NON-NULL rows (valid only
+    when `has_minmax`) plus the null count (None = unknown). For parquet row
+    groups these come straight from the footer's column-chunk statistics."""
+
+    __slots__ = ("mn", "mx", "has_minmax", "null_count")
+
+    def __init__(self, mn=None, mx=None, has_minmax: bool = False, null_count=None):
+        self.mn = mn
+        self.mx = mx
+        self.has_minmax = has_minmax
+        self.null_count = null_count
+
+
+def zone_keeps(op: str, value, st: ZoneStats, zone_rows: int) -> bool:
+    """Can a zone of `zone_rows` rows with stats `st` contain a row the
+    conjunct keeps? THE pruning decision (see module docstring for the
+    soundness contract)."""
+    if op == "isnull":
+        return st.null_count is None or st.null_count > 0
+    if op == "isnotnull":
+        return st.null_count is None or st.null_count < zone_rows
+    # Value-matching conjuncts: a comparison with null is unknown and WHERE
+    # drops unknowns, so an all-null zone satisfies nothing.
+    if st.null_count is not None and st.null_count >= zone_rows:
+        return False
+    if not st.has_minmax:
+        return True
+    try:
+        if op == "in":
+            return any(minmax_keeps("==", v, st.mn, st.mx) for v in value)
+        if op == "!=":
+            # Prunable only when EVERY row equals the literal: constant
+            # zone, no nulls unknown-ness needed (nulls fail != too), and
+            # no float lanes (a NaN row satisfies != but is invisible to
+            # parquet min/max statistics).
+            if _is_floatish(st.mn) or _is_floatish(st.mx) or _is_floatish(value):
+                return True
+            return not (st.mn == st.mx == value)
+        return minmax_keeps(op, value, st.mn, st.mx)
+    except TypeError:
+        return True
+
+
+def _resolve_name(name: str, names: Sequence[str], case_sensitive: bool) -> Optional[str]:
+    """Resolve a conjunct's column spelling against a file's schema names —
+    exact match first, then unique case-insensitive (Table._resolve's rule);
+    None when unresolved (the conjunct cannot prune this file)."""
+    if name in names:
+        return name
+    if case_sensitive:
+        return None
+    ci = [n for n in names if n.lower() == name.lower()]
+    return ci[0] if len(ci) == 1 else None
+
+
+class ScanPredicate:
+    """A query's conjunctive filter compiled to its zone-prunable conjuncts,
+    carried down the scan path (`read_files` / `iter_file_tables`) by the
+    physical plan. Stateless against any particular file: `select_row_groups`
+    resolves the conjuncts per footer metadata."""
+
+    __slots__ = ("conjuncts", "case_sensitive")
+
+    def __init__(self, conjuncts: List[tuple], case_sensitive: bool = False):
+        self.conjuncts = conjuncts
+        self.case_sensitive = case_sensitive
+
+    @staticmethod
+    def from_condition(
+        condition: Expr, case_sensitive: bool = False
+    ) -> Optional["ScanPredicate"]:
+        """None when no conjunct is prunable (the scan runs exactly as
+        without pushdown — no footer reads, no key changes)."""
+        cj = prunable_conjuncts(condition)
+        return ScanPredicate(cj, case_sensitive) if cj else None
+
+    def select_row_groups(self, meta) -> Optional[Tuple[int, ...]]:
+        """Surviving row-group indices of one file (`meta` is an
+        `engine.io.FileFooterMeta`). None = every row group survives (the
+        caller keeps the plain whole-file path and its cache keys); a tuple
+        (possibly empty) = a real pruning decision."""
+        resolved = []
+        for op, name, value in self.conjuncts:
+            rn = _resolve_name(name, meta.names, self.case_sensitive)
+            if rn is not None:
+                resolved.append((op, rn, value))
+        if not resolved:
+            return None
+        keep: List[int] = []
+        dropped = False
+        for i, rg in enumerate(meta.row_groups):
+            ok = True
+            for op, rn, value in resolved:
+                st = rg.stats.get(rn)
+                if st is None:
+                    continue
+                if not zone_keeps(op, value, st, rg.num_rows):
+                    ok = False
+                    break
+            if ok:
+                keep.append(i)
+            else:
+                dropped = True
+        return tuple(keep) if dropped else None

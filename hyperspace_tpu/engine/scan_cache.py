@@ -73,9 +73,18 @@ class ScanCache:
     """Per-column store behind a table-level get/put API.
 
     Entry kinds under one (path, size, mtime) freshness base:
-      - ("col", name)  → one decoded Column (+ its byte size)
-      - ("names",)     → the file's full column-name order (for columns=None
-                         requests, which must reproduce the decode order)
+      - ("col", name)       → one decoded Column (+ its byte size)
+      - ("col", name, sel)  → the column decoded from the row-group subset
+                              `sel` (a tuple of row-group indices — the scan
+                              pushdown's pruned decodes; a partial decode must
+                              never alias the whole-file entry)
+      - ("names",)          → the file's full column-name order (for
+                              columns=None requests, which must reproduce the
+                              decode order)
+      - ("meta",)           → the file's parquet FOOTER METADATA (row-group
+                              boundaries + per-column min/max/null-count zone
+                              maps), cached under the same byte budget so
+                              pruning decisions never re-open footers
 
     Hit/miss counting is per table-level request (a get that assembles from
     columns counts ONE hit), so cache-pressure accounting stays comparable to
@@ -137,12 +146,24 @@ class ScanCache:
         self._entries.move_to_end(base + (("names",),))
         return list(ent[0])
 
+    @staticmethod
+    def _col_key(n: str, sel) -> tuple:
+        """Entry kind of one column: whole-file, or a row-group selection
+        (`sel` = sorted tuple of row-group indices). Distinct kinds by
+        construction — a pruned decode can never serve a whole-file read."""
+        return ("col", n) if sel is None else ("col", n, tuple(sel))
+
     def get(
-        self, path: str, columns: Optional[List[str]], record: bool = True
+        self,
+        path: str,
+        columns: Optional[List[str]],
+        record: bool = True,
+        sel=None,
     ) -> Optional[Table]:
         """Assemble the requested table from cached columns. `record=False`
         skips hit/miss accounting (internal re-reads after a partial decode —
-        one user-level request must count exactly once)."""
+        one user-level request must count exactly once). `sel` selects the
+        row-group-subset entries instead of the whole-file ones."""
         base = self._base(path)
         if base is None:
             return None
@@ -151,7 +172,7 @@ class ScanCache:
             cols = {}
             if names is not None:
                 for n in names:
-                    ent = self._entries.get(base + (("col", n),))
+                    ent = self._entries.get(base + (self._col_key(n, sel),))
                     if ent is None:
                         cols = None
                         break
@@ -164,13 +185,15 @@ class ScanCache:
                     self._m_misses.inc()
                 return None
             for n in names:
-                self._entries.move_to_end(base + (("col", n),))
+                self._entries.move_to_end(base + (self._col_key(n, sel),))
             if record:
                 self.hits += 1
                 self._m_hits.inc()
             return Table(cols)
 
-    def missing_columns(self, path: str, columns: Optional[List[str]]) -> Optional[List[str]]:
+    def missing_columns(
+        self, path: str, columns: Optional[List[str]], sel=None
+    ) -> Optional[List[str]]:
         """The subset of `columns` NOT currently cached for this file — the
         decode-only-what's-cold contract of the pipelined build (and any
         projection-changing scan). None = can't tell (unknown name set for
@@ -182,19 +205,25 @@ class ScanCache:
             names = self._names_for_locked(base, columns)
             if names is None:
                 return None
-            return [n for n in names if base + (("col", n),) not in self._entries]
+            return [
+                n
+                for n in names
+                if base + (self._col_key(n, sel),) not in self._entries
+            ]
 
-    def put(self, path: str, columns: Optional[List[str]], table: Table) -> None:
+    def put(
+        self, path: str, columns: Optional[List[str]], table: Table, sel=None
+    ) -> None:
         base = self._base(path)
         if base is None:
             return
         with self._lock:
-            if columns is None:
+            if columns is None and sel is None:
                 key = base + (("names",),)
                 if key not in self._entries:
                     self._entries[key] = (list(table.column_names), 0)
             for n, c in table.columns.items():
-                key = base + (("col", n),)
+                key = base + (self._col_key(n, sel),)
                 if key in self._entries:
                     continue
                 size = _column_nbytes(c)
@@ -202,6 +231,35 @@ class ScanCache:
                     continue
                 self._entries[key] = (c, size)
                 self._bytes += size
+            self._evict_to_capacity_locked()
+
+    # -- footer metadata (parquet zone maps) --------------------------------
+    # Metadata rides the SAME freshness base and LRU/byte budget as the
+    # decoded columns (the scan-cache budget bounds it); its own hit/miss
+    # accounting lives with the io-layer counters (`io.footer.*`), never the
+    # table-level hits/misses above.
+
+    def get_meta(self, path: str):
+        base = self._base(path)
+        if base is None:
+            return None
+        with self._lock:
+            ent = self._entries.get(base + (("meta",),))
+            if ent is None:
+                return None
+            self._entries.move_to_end(base + (("meta",),))
+            return ent[0]
+
+    def put_meta(self, path: str, meta, nbytes: int) -> None:
+        base = self._base(path)
+        if base is None:
+            return
+        with self._lock:
+            key = base + (("meta",),)
+            if key in self._entries or nbytes > self._capacity:
+                return
+            self._entries[key] = (meta, int(nbytes))
+            self._bytes += int(nbytes)
             self._evict_to_capacity_locked()
 
     def clear(self) -> None:
@@ -265,6 +323,14 @@ class BucketedConcatCache:
             self.hits += 1
             self._m_hits.inc()
             return hit[0], hit[1]
+
+    def contains(self, key) -> bool:
+        """Accounting-free peek (no hit/miss counting, no LRU touch) — lets a
+        caller choose BETWEEN strategies (e.g. in-memory filtering of a warm
+        full concat vs a pruned disk re-assembly) without the probe itself
+        distorting the stats the choice is judged by."""
+        with self._lock:
+            return key in self._entries
 
     def put(self, key, table: Table, starts) -> None:
         size = _table_nbytes(table)

@@ -511,9 +511,19 @@ class HyperspaceSession:
     def create_table(self, data: Dict[str, list]) -> Table:
         return Table.from_pydict(data)
 
-    def write_parquet(self, data: Union[Table, Dict[str, list]], path: str) -> None:
+    def write_parquet(
+        self,
+        data: Union[Table, Dict[str, list]],
+        path: str,
+        row_group_rows: Optional[int] = None,
+    ) -> None:
+        """`row_group_rows` bounds the written parquet row groups (None =
+        pyarrow default, one group for typical test sizes) — multi-row-group
+        sources are what the scan pushdown's zone maps prune inside."""
         t = data if isinstance(data, Table) else Table.from_pydict(data)
-        engine_io.write_parquet(t, os.path.join(path, "part-00000.parquet"))
+        engine_io.write_parquet(
+            t, os.path.join(path, "part-00000.parquet"), row_group_rows=row_group_rows
+        )
 
     def write_orc(self, data: Union[Table, Dict[str, list]], path: str) -> None:
         t = data if isinstance(data, Table) else Table.from_pydict(data)

@@ -9,10 +9,14 @@ the query side:
 1. **Decode** — `engine.io.iter_file_tables` feeds per-file tables in sorted
    order through the per-column scan cache, with a bounded decode pool
    (shared ``HYPERSPACE_BUILD_DECODE_THREADS`` contract) running up to
-   ``HYPERSPACE_QUERY_PREFETCH_FILES`` files ahead of the consumer.
-2. **Chunk** — each file splits into row slices of at most
+   ``HYPERSPACE_QUERY_PREFETCH_FILES`` files ahead of the consumer. With a
+   pushdown predicate (`engine.pushdown`, via `ScanExec.pushdown`), each file
+   decodes only the row groups its footer zone maps cannot exclude — pruned
+   bytes never enter the stream, so they are never staged or filtered either.
+2. **Chunk** — each (pruned) file table splits into row slices of at most
    ``HYPERSPACE_QUERY_CHUNK_ROWS`` (numpy views; chunk boundaries never change
-   values or output order).
+   values or output order, and align to the surviving row groups'
+   concatenation by construction).
 3. **Filter / projections** — `FilterExec`/`ProjectExec`/`WithColumnExec`
    apply per chunk through their `execute_stream` generators, so selective
    filters shrink chunks before any reduction.

@@ -313,8 +313,14 @@ class _BucketWriter:
         out = pa.table(
             {n: self.gathered[n].slice(lo, hi - lo) for n in self.names}
         )
+        # Bounded row groups over the key-sorted bucket rows: the footer zone
+        # maps then resolve point/range filters INSIDE the bucket file (scan
+        # pushdown). Same bound as the serial writer — the byte-identity
+        # contract between the two paths includes the row-group layout.
         pq.write_table(
-            out, os.path.join(self.index_data_path, f"part-{b:05d}.parquet")
+            out,
+            os.path.join(self.index_data_path, f"part-{b:05d}.parquet"),
+            row_group_size=engine_io.index_row_group_rows(),
         )
 
     def run(self, perm: np.ndarray, starts: np.ndarray, pool_size: int) -> None:

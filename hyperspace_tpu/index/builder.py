@@ -197,8 +197,13 @@ class CoveringIndexBuilder(IndexerBuilder):
             if hi <= lo:
                 return  # empty bucket: no file
             bucket_table = sorted_table.take(np.arange(lo, hi))
+            # Bounded, key-sorted row groups (same bound as the pipelined
+            # writer — the byte-identity contract includes the layout): scan
+            # pushdown prunes inside bucket files through the footer stats.
             engine_io.write_parquet(
-                bucket_table, os.path.join(index_data_path, f"part-{b:05d}.parquet")
+                bucket_table,
+                os.path.join(index_data_path, f"part-{b:05d}.parquet"),
+                row_group_rows=engine_io.index_row_group_rows(),
             )
 
         # Parquet encode is pyarrow C++ work that releases the GIL: writing the

@@ -64,14 +64,35 @@ class Sketch:
     @staticmethod
     def from_json(d: dict) -> "Sketch":
         if d["kind"] == "MinMaxSketch":
-            return MinMaxSketch(d["column"])
+            return MinMaxSketch(d["column"], d.get("granularity", "file"))
         if d["kind"] == "BloomFilterSketch":
             return BloomFilterSketch(d["column"], d.get("numBits", 1024), d.get("numHashes", 5))
         raise HyperspaceException(f"Unknown sketch kind: {d['kind']}")
 
 
 class MinMaxSketch(Sketch):
+    """Per-file min/max zone. `granularity="rowgroup"` additionally records
+    the PER-ROW-GROUP [min, max] zones of each parquet source file (read from
+    the footers at build time — no extra decode): a file whose overall range
+    straddles a literal still prunes when no individual row group can contain
+    it (clustered data), through the same zone-map evaluator the scan
+    pushdown uses (`engine.pushdown.minmax_keeps`)."""
+
     kind = "MinMaxSketch"
+
+    def __init__(self, column: str, granularity: str = "file"):
+        super().__init__(column)
+        if granularity not in ("file", "rowgroup"):
+            raise HyperspaceException(
+                f"MinMaxSketch granularity must be 'file' or 'rowgroup': {granularity}"
+            )
+        self.granularity = granularity
+
+    def to_json(self) -> dict:
+        d = super().to_json()
+        if self.granularity != "file":
+            d["granularity"] = self.granularity
+        return d
 
 
 class BloomFilterSketch(Sketch):
@@ -156,6 +177,31 @@ def bloom_probe(bits: np.ndarray, value, column_dtype: str, num_hashes: int) -> 
     return True
 
 
+def _row_group_zones(path: str, file_format: str, column: str) -> list:
+    """Per-row-group [min, max] zones of one source file's column from its
+    parquet footer (no decode): a list of 2-lists, with None for a zone whose
+    statistics are absent (that zone always keeps). [] when the file carries
+    no usable footer (non-parquet or unreadable) — the sketch then degrades
+    to its file-level min/max."""
+    meta = engine_io.footer_metadata(path, file_format)
+    if meta is None:
+        return []
+    ci = [n for n in meta.names if n.lower() == column.lower()]
+    name = column if column in meta.names else (ci[0] if len(ci) == 1 else None)
+    if name is None:
+        return []
+    zones = []
+    for rg in meta.row_groups:
+        st = rg.stats.get(name)
+        if st is None or not st.has_minmax:
+            zones.append(None)
+        else:
+            mn = st.mn.item() if hasattr(st.mn, "item") else st.mn
+            mx = st.mx.item() if hasattr(st.mx, "item") else st.mx
+            zones.append([mn, mx])
+    return zones
+
+
 def _bits_to_hex(bits: np.ndarray) -> str:
     return np.packbits(bits.astype(np.uint8)).tobytes().hex()
 
@@ -213,6 +259,10 @@ class DataSkippingIndexBuilder(IndexerBuilder):
                         mx = np.asarray(jnp.max(arr)).item()
                     rows.setdefault(f"min_{s.column}", []).append(mn)
                     rows.setdefault(f"max_{s.column}", []).append(mx)
+                    if s.granularity == "rowgroup":
+                        rows.setdefault(f"rgzm_{s.column}", []).append(
+                            json.dumps(_row_group_zones(f.path, rel.file_format, s.column))
+                        )
                 elif isinstance(s, BloomFilterSketch):
                     bits = _bloom_bits(c, s.num_bits, s.num_hashes)
                     rows.setdefault(f"bloom_{s.column}", []).append(_bits_to_hex(bits))

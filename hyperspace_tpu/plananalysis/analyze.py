@@ -32,7 +32,14 @@ def _fmt_seconds(s: Optional[float]) -> str:
     return f"{s * 1000:.1f}ms"
 
 
-_VERDICT_KEYS = ("bucketed_cache", "pairs_memo")
+# Per-node annotation attrs: cache verdicts plus the scan pushdown's
+# row-group pruning outcome (set only when a scan actually pruned).
+_VERDICT_KEYS = (
+    "bucketed_cache",
+    "pairs_memo",
+    "row_groups_scanned",
+    "row_groups_skipped",
+)
 
 
 def _subtree_verdict(span, children_of, key, rendered_ids, own_id, depth: int = 0):

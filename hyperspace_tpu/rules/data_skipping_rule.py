@@ -13,8 +13,9 @@ import json
 from typing import Dict, List, Optional
 
 from ..engine import io as engine_io
-from ..engine.expr import BinaryOp, Col, Expr, IsIn, Lit, split_conjuncts
+from ..engine.expr import Expr, split_conjuncts
 from ..engine.logical import FilterNode, LogicalPlan, ScanNode, SourceRelation
+from ..engine.pushdown import minmax_keeps, normalize_conjunct
 from ..index.dataskipping import (
     DATA_SKIPPING_KIND,
     BloomFilterSketch,
@@ -29,37 +30,21 @@ from ..util.resolver_utils import resolution_key
 from .rule_utils import get_candidate_indexes, log_rule_failure, record_rule_decision
 
 
-def _normalize_conjunct(e: Expr):
-    """Return (op, column_name, literal(s)) for prunable shapes, else None."""
-    if isinstance(e, IsIn) and isinstance(e.child, Col):
-        return ("in", e.child.name, e.values)
-    if not isinstance(e, BinaryOp) or e.op not in BinaryOp.COMPARISONS:
-        return None
-    l, r = e.left, e.right
-    if isinstance(l, Col) and isinstance(r, Lit):
-        return (e.op, l.name, r.value)
-    if isinstance(l, Lit) and isinstance(r, Col):
-        flipped = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "==": "==", "!=": "!="}
-        return (flipped[e.op], r.name, l.value)
-    return None
+# Conjunct normalization and the [min, max]-zone decision are the SHARED
+# zone-map evaluator (`engine.pushdown`) — one soundness contract for this
+# rule's file/row-group sketches AND the scan layer's row-group pushdown.
 
 
-def _minmax_keeps(op: str, value, mn, mx) -> bool:
-    """Can a file with [mn, mx] on the column contain a row satisfying `col op value`?"""
-    try:
-        if op == "==":
-            return mn <= value <= mx
-        if op == "<":
-            return mn < value
-        if op == "<=":
-            return mn <= value
-        if op == ">":
-            return mx > value
-        if op == ">=":
-            return mx >= value
-    except TypeError:
-        return True  # incomparable types: never prune
-    return True  # "!=" and anything else: cannot prune
+def _zones_exclude(zones, op: str, value) -> bool:
+    """True when EVERY recorded row-group zone of a file excludes
+    `col op value` — the row-group MinMaxSketch's file-prune decision. A
+    missing zone list ([]) or a stats-less zone (None) keeps the file."""
+    if not zones:
+        return False
+    for z in zones:
+        if z is None or minmax_keeps(op, value, z[0], z[1]):
+            return False
+    return True
 
 
 class DataSkippingFilterRule:
@@ -113,7 +98,7 @@ class DataSkippingFilterRule:
                 if not candidates:
                     return node
 
-                conjuncts = [_normalize_conjunct(c) for c in split_conjuncts(node.condition)]
+                conjuncts = [normalize_conjunct(c) for c in split_conjuncts(node.condition)]
                 conjuncts = [c for c in conjuncts if c is not None]
                 if not conjuncts:
                     return node
@@ -140,9 +125,21 @@ class DataSkippingFilterRule:
                                 ):
                                     mn = data[f"min_{s.column}"][i]
                                     mx = data[f"max_{s.column}"][i]
-                                    if not _minmax_keeps(op, value, mn, mx):
+                                    if not minmax_keeps(op, value, mn, mx):
                                         keep[path] = False
                                         applied = True
+                                    elif s.granularity == "rowgroup":
+                                        # File range straddles the literal:
+                                        # the per-row-group zones may still
+                                        # prove no single row group contains
+                                        # it (clustered data).
+                                        raw = data.get(f"rgzm_{s.column}")
+                                        zones = (
+                                            json.loads(raw[i]) if raw else []
+                                        )
+                                        if _zones_exclude(zones, op, value):
+                                            keep[path] = False
+                                            applied = True
                                 elif isinstance(s, BloomFilterSketch) and op in ("==", "in"):
                                     bits = hex_to_bits(
                                         data[f"bloom_{s.column}"][i], s.num_bits

@@ -9,6 +9,14 @@ Parity: reference `index/rules/FilterIndexRule.scala:38-253`:
 - Ranking is first-candidate (reference TODO, :202-208).
 - Any exception → return the original plan unchanged (:74-78).
 - Emits HyperspaceIndexUsageEvent on success (:121-127).
+
+Bucket pruning composes with the scan-layer row-group pushdown (PR 5): the
+rewrite keeps the filter DIRECTLY over the substituted index scan, so the
+planner threads the same condition into it (`ScanExec.pushdown`) — a point
+lookup first drops every `part-<bucket>` file but the literal's hash bucket
+(here), then decodes only the row groups of THAT file whose key-sorted zone
+maps can contain the literal (`engine.pushdown`). The bucket-pruning decision
+and the pushdown therefore act on one condition at two granularities.
 """
 
 from __future__ import annotations

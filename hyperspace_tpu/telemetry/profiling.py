@@ -205,6 +205,23 @@ def pallas_fallback_summary() -> dict:
     return out
 
 
+def io_pruning_summary() -> dict:
+    """Session-cumulative scan-pushdown counters: row groups scanned vs
+    skipped by zone-map pruning, the byte totals behind them, and footer-
+    cache traffic. Consumed by ``bench_detail.io_pruning`` so the pruning win
+    is measured, not modeled."""
+    from . import metrics as _metrics
+
+    return {
+        "row_groups_scanned": _metrics.counter("io.pruning.row_groups_scanned").value,
+        "row_groups_skipped": _metrics.counter("io.pruning.row_groups_skipped").value,
+        "bytes_decoded": _metrics.counter("io.pruning.bytes_decoded").value,
+        "bytes_skipped": _metrics.counter("io.pruning.bytes_skipped").value,
+        "footer_hits": _metrics.counter("io.footer.hits").value,
+        "footer_misses": _metrics.counter("io.footer.misses").value,
+    }
+
+
 @contextlib.contextmanager
 def trace(log_dir: Optional[str], enabled: bool = True) -> Iterator[None]:
     """Profile a scope into `log_dir` (xprof format); no-op when disabled/unset."""

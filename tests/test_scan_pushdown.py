@@ -1,0 +1,476 @@
+"""Pushdown-driven selective scan: row-group zone maps, predicate pushdown
+into the decode layer, and the footer-metadata cache (ISSUE 5 tentpole).
+
+The contract under test: with ``HYPERSPACE_SCAN_PUSHDOWN`` on (the default),
+a filtered parquet scan decodes only the row groups whose footer zone maps
+can satisfy the filter's conjuncts — and produces results BYTE-IDENTICAL
+(values, row order, and aggregate GROUP order) to the
+``HYPERSPACE_SCAN_PUSHDOWN=0`` whole-file fallback, across int/float/string/
+null filters, all-pruned and none-pruned files, and single-row-group files.
+A decode fault mid-scan propagates cleanly and leaves no partial
+selection-keyed cache entry. Footers parse once per file (the footer cache
+under the scan-cache budget). The build-side satellite — bounded, key-sorted
+row groups in index bucket files — lets indexed point lookups prune INSIDE a
+bucket file, and the row-group MinMaxSketch variant prunes whole files whose
+per-row-group zones all exclude the literal.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import IndexConfig, IndexConstants
+from hyperspace_tpu.engine import HyperspaceSession, col
+from hyperspace_tpu.engine import io as engine_io
+from hyperspace_tpu.engine.table import Table
+from hyperspace_tpu.hyperspace import Hyperspace, disable_hyperspace, enable_hyperspace
+from hyperspace_tpu.telemetry import metrics
+
+ENV = "HYPERSPACE_SCAN_PUSHDOWN"
+
+
+@pytest.fixture()
+def session(tmp_path):
+    s = HyperspaceSession(warehouse=str(tmp_path))
+    s.conf.set(IndexConstants.INDEX_SYSTEM_PATH, str(tmp_path / "indexes"))
+    s.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
+    return s
+
+
+def _clear_caches():
+    from hyperspace_tpu.engine.physical import clear_device_memos
+    from hyperspace_tpu.engine.scan_cache import (
+        global_bucketed_cache,
+        global_concat_cache,
+        global_filtered_cache,
+        global_scan_cache,
+    )
+
+    global_scan_cache().clear()
+    global_concat_cache().clear()
+    global_filtered_cache().clear()
+    global_bucketed_cache().clear()
+    clear_device_memos()
+
+
+def _pruning_counters():
+    return {
+        "scanned": metrics.counter("io.pruning.row_groups_scanned").value,
+        "skipped": metrics.counter("io.pruning.row_groups_skipped").value,
+        "footer_misses": metrics.counter("io.footer.misses").value,
+        "footer_hits": metrics.counter("io.footer.hits").value,
+    }
+
+
+def _on_off(monkeypatch, make_df):
+    """(rows_on, rows_off, pruning delta of the ON run) — each mode runs COLD
+    (all caches cleared) so the ON run's decode work is the pruned one."""
+    monkeypatch.setenv(ENV, "1")
+    _clear_caches()
+    c0 = _pruning_counters()
+    rows_on = make_df().collect().rows()
+    c1 = _pruning_counters()
+    monkeypatch.setenv(ENV, "0")
+    _clear_caches()
+    rows_off = make_df().collect().rows()
+    monkeypatch.delenv(ENV, raising=False)
+    return rows_on, rows_off, {k: c1[k] - c0[k] for k in c0}
+
+
+def _write_clustered(base, name, n=4000, files=2, row_groups_per_file=8):
+    """Ascending-ts multi-row-group files: mixed int/float/string/null
+    payloads so every filter dtype has tight per-row-group zones."""
+    per = n // files
+    rg = per // row_groups_per_file
+    rng = np.random.RandomState(5)
+    for i in range(files):
+        ts = np.arange(i * per, (i + 1) * per, dtype=np.int64)
+        fv = ts.astype(np.float64) / 10.0
+        sv = np.asarray([f"s{v:06d}" for v in ts], dtype=object)
+        nv = np.asarray([int(v) if v % 5 else None for v in ts], dtype=object)
+        engine_io.write_parquet(
+            Table.from_pydict({"ts": ts, "fv": fv, "sv": sv, "nv": nv}),
+            os.path.join(base, name, f"part-{i:05d}.parquet"),
+            row_group_rows=rg,
+        )
+    return os.path.join(base, name)
+
+
+class TestOnOffOracle:
+    """Byte-identical results (values, row order, group order) with pushdown
+    on vs off, with the ON run provably decoding fewer row groups."""
+
+    def test_int_range_filter_prunes_and_matches(self, session, tmp_path, monkeypatch):
+        src = _write_clustered(str(tmp_path), "src")
+
+        def q():
+            return session.read.parquet(src).filter(
+                (col("ts") >= 700) & (col("ts") < 780)
+            ).select("ts", "fv", "sv")
+
+        on, off, d = _on_off(monkeypatch, q)
+        assert on == off and len(on) == 80
+        assert d["skipped"] > 0 and d["scanned"] < d["scanned"] + d["skipped"]
+
+    def test_float_string_null_filters_match(self, session, tmp_path, monkeypatch):
+        src = _write_clustered(str(tmp_path), "src")
+        cases = [
+            lambda df: df.filter(col("fv") < 12.5),
+            lambda df: df.filter(col("fv") >= 399.9),
+            lambda df: df.filter(col("sv") == "s001234"),
+            lambda df: df.filter((col("sv") > "s0030") & (col("sv") <= "s003210")),
+            lambda df: df.filter(col("nv").is_not_null() & (col("nv") < 40)),
+            lambda df: df.filter((col("ts") != 3) & (col("ts") < 9)),
+            lambda df: df.filter(col("ts").isin([17, 2801, 9999])),
+        ]
+        for make in cases:
+            on, off, _ = _on_off(
+                monkeypatch, lambda: make(session.read.parquet(src))
+            )
+            assert on == off, make
+
+    def test_grouped_aggregate_group_order_identical(
+        self, session, tmp_path, monkeypatch
+    ):
+        src = _write_clustered(str(tmp_path), "src")
+
+        def q():
+            return (
+                session.read.parquet(src)
+                .filter(col("ts") < 900)
+                .group_by("sv")
+                .agg(n=("ts", "count"), sm=("ts", "sum"))
+            )
+
+        on, off, d = _on_off(monkeypatch, q)
+        assert on == off  # unsorted: group ORDER is part of the contract
+        assert d["skipped"] > 0
+
+    def test_all_pruned_and_none_pruned_files(self, session, tmp_path, monkeypatch):
+        src = _write_clustered(str(tmp_path), "src", n=4000, files=4)
+
+        # Range entirely outside the data: EVERY row group of every file
+        # prunes; the scan yields the 0-row schema without decoding a byte.
+        def q_none():
+            return session.read.parquet(src).filter(col("ts") >= 10_000_000)
+
+        on, off, d = _on_off(monkeypatch, q_none)
+        assert on == off == []
+        assert d["scanned"] == 0 and d["skipped"] == 32
+
+        # Filter no zone can exclude: selection keeps everything → the scan
+        # runs the plain whole-file path (no pruning counters tick).
+        def q_all():
+            # != is prunable only for a CONSTANT zone equal to the literal;
+            # -1 is nowhere, so every zone keeps and no pruning fires.
+            return session.read.parquet(src).filter(col("ts") != -1)
+
+        on, off, d = _on_off(monkeypatch, q_all)
+        assert on == off and len(on) == 4000
+        assert d["scanned"] == 0 and d["skipped"] == 0
+
+    def test_single_row_group_files(self, session, tmp_path, monkeypatch):
+        # One row group per file: pruning degenerates to file-level zone
+        # skipping (the all-or-nothing selection).
+        per = 500
+        for i in range(4):
+            engine_io.write_parquet(
+                Table.from_pydict(
+                    {"ts": np.arange(i * per, (i + 1) * per, dtype=np.int64)}
+                ),
+                os.path.join(str(tmp_path), "one_rg", f"part-{i:05d}.parquet"),
+            )
+        src = os.path.join(str(tmp_path), "one_rg")
+
+        def q():
+            return session.read.parquet(src).filter(
+                (col("ts") >= 600) & (col("ts") < 640)
+            )
+
+        on, off, d = _on_off(monkeypatch, q)
+        assert on == off and len(on) == 40
+        assert d["scanned"] == 1 and d["skipped"] == 3
+
+    def test_mixed_width_promotion_with_all_pruned_file(
+        self, session, tmp_path, monkeypatch
+    ):
+        """An all-pruned file still contributes its 0-row schema to the
+        concat, so dtype promotion (int32 file + int64 file) matches the
+        unpruned path exactly."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        d = str(tmp_path / "mixed")
+        os.makedirs(d)
+        pq.write_table(
+            pa.table({"k": pa.array(np.arange(100, dtype=np.int32))}),
+            os.path.join(d, "part-00000.parquet"),
+        )
+        pq.write_table(
+            pa.table({"k": pa.array(np.arange(1000, 1100, dtype=np.int64))}),
+            os.path.join(d, "part-00001.parquet"),
+        )
+
+        def q():
+            return session.read.parquet(d).filter(col("k") >= 1000)
+
+        monkeypatch.setenv(ENV, "1")
+        _clear_caches()
+        t_on = q().collect()
+        monkeypatch.setenv(ENV, "0")
+        _clear_caches()
+        t_off = q().collect()
+        monkeypatch.delenv(ENV, raising=False)
+        assert t_on.rows() == t_off.rows()
+        assert t_on.column("k").data.dtype == t_off.column("k").data.dtype
+
+
+class TestCacheAndFaults:
+    def test_footer_parsed_once_per_file(self, session, tmp_path, monkeypatch):
+        src = _write_clustered(str(tmp_path), "src")
+        monkeypatch.setenv(ENV, "1")
+        _clear_caches()
+
+        def q():
+            return session.read.parquet(src).filter(col("ts") < 100)
+
+        c0 = _pruning_counters()
+        q().collect()
+        c1 = _pruning_counters()
+        assert c1["footer_misses"] - c0["footer_misses"] == 2  # one per file
+        q().collect()
+        q().collect()
+        c2 = _pruning_counters()
+        assert c2["footer_misses"] == c1["footer_misses"]  # cached thereafter
+        assert c2["footer_hits"] > c1["footer_hits"]
+
+    def test_fault_mid_scan_leaves_no_partial_entry(
+        self, session, tmp_path, monkeypatch
+    ):
+        from hyperspace_tpu.engine.scan_cache import global_scan_cache
+
+        src = _write_clustered(str(tmp_path), "src")
+        monkeypatch.setenv(ENV, "1")
+        monkeypatch.setenv("HYPERSPACE_BUILD_DECODE_THREADS", "1")  # deterministic order
+        _clear_caches()
+
+        real = engine_io._read_row_groups_one
+        boom = {"path": None}
+
+        def failing(path, sel, columns):
+            if boom["path"] is None:
+                boom["path"] = path  # fail the FIRST pruned decode
+            if path == boom["path"]:
+                raise OSError("injected decode fault")
+            return real(path, sel, columns)
+
+        monkeypatch.setattr(engine_io, "_read_row_groups_one", failing)
+
+        def q():
+            return session.read.parquet(src).filter(col("ts") < 900)
+
+        with pytest.raises(OSError, match="injected"):
+            q().collect()
+        assert boom["path"] is not None
+        # The faulted file has NO selection-keyed entries: a retry decodes
+        # from scratch (and succeeds once the fault clears).
+        cache = global_scan_cache()
+        names = ["ts", "fv", "sv", "nv"]
+        for sel in [(0,), (0, 1)]:
+            missing = cache.missing_columns(boom["path"], names, sel=sel)
+            assert missing == names
+        monkeypatch.setattr(engine_io, "_read_row_groups_one", real)
+        assert len(q().collect().rows()) == 900
+
+    def test_selection_entries_never_alias_whole_file(self, session, tmp_path, monkeypatch):
+        """A pruned decode must not satisfy a later UNFILTERED read (which
+        needs every row) — the selection rides the cache key."""
+        src = _write_clustered(str(tmp_path), "src")
+        monkeypatch.setenv(ENV, "1")
+        _clear_caches()
+        filtered = (
+            session.read.parquet(src).filter(col("ts") < 100).collect().rows()
+        )
+        assert len(filtered) == 100
+        full = session.read.parquet(src).collect()
+        assert full.num_rows == 4000
+
+
+class TestIndexedShapes:
+    def test_point_lookup_prunes_inside_bucket_file(
+        self, session, tmp_path, monkeypatch
+    ):
+        """The build satellite: bounded, key-sorted row groups in bucket
+        files → an indexed point lookup decodes only the literal's row
+        group(s) inside the one bucket file bucket pruning left."""
+        monkeypatch.setenv("HYPERSPACE_INDEX_ROW_GROUP_ROWS", "128")
+        n = 4000
+        session.write_parquet(
+            {
+                "k": np.arange(n, dtype=np.int64).tolist(),
+                "v": (np.arange(n) % 97).tolist(),
+            },
+            str(tmp_path / "pts"),
+        )
+        hs = Hyperspace(session)
+        hs.create_index(
+            session.read.parquet(str(tmp_path / "pts")),
+            IndexConfig("ptIdx", ["k"], ["v"]),
+        )
+        enable_hyperspace(session)
+
+        def q():
+            return (
+                session.read.parquet(str(tmp_path / "pts"))
+                .filter(col("k") == 1234)
+                .select("v")
+            )
+
+        assert "ptIdx" in q().explain_string()
+        on, off, d = _on_off(monkeypatch, q)
+        assert on == off == [(1234 % 97,)]
+        assert d["skipped"] > 0  # pruned INSIDE the bucket file
+
+    def test_filtered_bucketed_join_equivalence(self, session, tmp_path, monkeypatch):
+        """A range filter on one side of a bucketed index join takes the
+        row-group-pruned concat; join results (incl. the streamed/fused
+        aggregates above it) equal the whole-file path's exactly."""
+        from hyperspace_tpu.engine.physical import SortMergeJoinExec
+
+        monkeypatch.setenv("HYPERSPACE_INDEX_ROW_GROUP_ROWS", "256")
+        n = 3000
+        session.write_parquet(
+            {
+                "okey": np.arange(n, dtype=np.int64).tolist(),
+                "qty": (np.arange(n) % 9 + 1).tolist(),
+            },
+            str(tmp_path / "li"),
+        )
+        session.write_parquet(
+            {
+                "okey2": list(range(n)),
+                "cust": (np.arange(n) % 17).tolist(),
+            },
+            str(tmp_path / "ord"),
+        )
+        hs = Hyperspace(session)
+        hs.create_index(
+            session.read.parquet(str(tmp_path / "li")),
+            IndexConfig("rpLi", ["okey"], ["qty"]),
+        )
+        hs.create_index(
+            session.read.parquet(str(tmp_path / "ord")),
+            IndexConfig("rpOrd", ["okey2"], ["cust"]),
+        )
+        enable_hyperspace(session)
+
+        def q():
+            l = session.read.parquet(str(tmp_path / "li"))
+            o = session.read.parquet(str(tmp_path / "ord"))
+            return (
+                l.filter((col("okey") >= 500) & (col("okey") < 620))
+                .join(o, col("okey") == col("okey2"))
+                .select("qty", "cust")
+            )
+
+        joins = [
+            nde
+            for nde in q().physical_plan().collect_nodes()
+            if isinstance(nde, SortMergeJoinExec)
+        ]
+        assert joins and joins[0].bucketed
+        on, off, d = _on_off(monkeypatch, q)
+        assert on == off and len(on) == 120
+        assert d["skipped"] > 0
+        disable_hyperspace(session)
+        _clear_caches()
+        assert sorted(on) == sorted(q().collect().rows())
+
+
+class TestRowGroupSketch:
+    def test_rowgroup_minmax_prunes_straddling_file(self, session, tmp_path):
+        """Per-row-group sketch zones prune a file whose OVERALL min/max
+        straddles the literal but whose individual row groups all exclude it
+        — the row-group variant of MinMaxSketch through the shared zone-map
+        evaluator."""
+        from hyperspace_tpu.index.dataskipping import (
+            DataSkippingIndexConfig,
+            MinMaxSketch,
+        )
+
+        d = str(tmp_path / "gap")
+        # One file, two row groups: [0..99] and [200..299] — value 150 falls
+        # in the file's overall range but in NO row group's zone.
+        vals = np.concatenate(
+            [np.arange(100, dtype=np.int64), np.arange(200, 300, dtype=np.int64)]
+        )
+        engine_io.write_parquet(
+            Table.from_pydict({"ts": vals, "v": vals % 7}),
+            os.path.join(d, "part-00000.parquet"),
+            row_group_rows=100,
+        )
+        engine_io.write_parquet(
+            Table.from_pydict(
+                {"ts": np.arange(1000, 1200, dtype=np.int64), "v": np.arange(200, dtype=np.int64) % 7}
+            ),
+            os.path.join(d, "part-00001.parquet"),
+            row_group_rows=100,
+        )
+        hs = Hyperspace(session)
+        hs.create_index(
+            session.read.parquet(d),
+            DataSkippingIndexConfig("rgDs", [MinMaxSketch("ts", granularity="rowgroup")]),
+        )
+        enable_hyperspace(session)
+
+        def q(v):
+            return session.read.parquet(d).filter(col("ts") == v).select("v")
+
+        # 150: straddles file 0's [0, 299] range — only the ROW-GROUP zones
+        # prove it absent, so both files prune and the scan is empty.
+        plan = q(150).physical_plan().tree_string()
+        assert "pruned by" in plan, plan
+        assert q(150).collect().rows() == []
+        # A value actually present keeps exactly its file.
+        assert q(250).collect().rows() == [(250 % 7,)]
+        disable_hyperspace(session)
+        assert q(150).collect().rows() == []
+        assert q(250).collect().rows() == [(250 % 7,)]
+
+    def test_file_granularity_unchanged(self, session, tmp_path):
+        from hyperspace_tpu.index.dataskipping import (
+            DataSkippingIndexConfig,
+            MinMaxSketch,
+        )
+
+        d = str(tmp_path / "plain")
+        for i in range(4):
+            engine_io.write_parquet(
+                Table.from_pydict(
+                    {"ts": np.arange(i * 100, (i + 1) * 100, dtype=np.int64)}
+                ),
+                os.path.join(d, f"part-{i:05d}.parquet"),
+            )
+        hs = Hyperspace(session)
+        hs.create_index(
+            session.read.parquet(d),
+            DataSkippingIndexConfig("fDs", [MinMaxSketch("ts")]),
+        )
+        enable_hyperspace(session)
+        df = session.read.parquet(d).filter(col("ts") == 250)
+        assert "pruned by" in df.physical_plan().tree_string()
+        assert df.collect().rows() == [(250,)]
+
+
+class TestExplainAnalyze:
+    def test_pruning_attrs_surface(self, session, tmp_path, monkeypatch):
+        src = _write_clustered(str(tmp_path), "src")
+        monkeypatch.setenv(ENV, "1")
+        _clear_caches()
+        out = (
+            session.read.parquet(src)
+            .filter(col("ts") < 60)
+            .explain(analyze=True)
+        )
+        assert "row_groups_scanned=" in out and "row_groups_skipped=" in out
