@@ -738,7 +738,7 @@ def _cache_section() -> dict:
         global_scan_cache,
     )
 
-    from hyperspace_tpu.telemetry import metrics
+    from hyperspace_tpu.telemetry import compile_log, metrics
     from hyperspace_tpu.telemetry.profiling import pallas_fallback_summary
 
     return {
@@ -753,9 +753,15 @@ def _cache_section() -> dict:
         "pallas_fallbacks": pallas_fallback_summary(),
         # Process-wide metrics registry: every cache/memo hit+miss (with
         # derived hit RATES), decode-pool work, rule applied/skipped counts,
-        # and kernel fallback counters — the perf trajectory records cache
+        # kernel fallback counters, and quantile latency histograms
+        # (p50/p90/p99 per histogram) — the perf trajectory records cache
         # BEHAVIOR alongside the timings (docs/observability.md).
         "metrics_snapshot": metrics.snapshot(),
+        # Per-program XLA compile observatory: compiles / compile seconds /
+        # traced shapes per jit entry point — the bench artifact records
+        # WHAT compiled, so a compile-bound run (the r05 TPU timeout mode)
+        # is attributable from the JSON alone.
+        "compile_observatory": compile_log.program_summary(),
     }
 
 

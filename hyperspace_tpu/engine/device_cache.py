@@ -14,6 +14,7 @@ import weakref
 import jax.numpy as jnp
 import numpy as np
 
+from ..telemetry import accounting as _accounting
 from ..telemetry import metrics as _metrics
 
 # Bound once: device_array is the hottest instrumented path (every device op
@@ -58,6 +59,8 @@ def device_array(host: np.ndarray):
 
     _MISSES.inc()
     dev = jnp.asarray(host)
+    # Upload-miss = a real host→device transfer this query caused.
+    _accounting.add("device_upload_bytes", int(dev.nbytes))
 
     def _evict(wr, key=key):
         # Only drop the entry this weakref installed: a dead array's id can be

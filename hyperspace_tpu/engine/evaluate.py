@@ -473,7 +473,9 @@ def _build_compiled_fn(expr: Expr, facade: _PredTableFacade, spellings: list, mo
             out["valid"] = v.valid
         return out
 
-    return jax.jit(fn)
+    from ..telemetry.compile_log import observed_jit as _observed_jit
+
+    return _observed_jit(fn, label="evaluate.compiled_expr")
 
 
 def _compiled_eval(expr: Expr, table: Table, mode: str):

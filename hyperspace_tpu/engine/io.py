@@ -27,6 +27,7 @@ import pyarrow.parquet as pq
 
 from ..exceptions import HyperspaceException
 from ..storage.filesystem import FileStatus, FileSystem, LocalFileSystem
+from ..telemetry import accounting as _accounting
 from ..telemetry import metrics as _metrics
 from ..util.path_utils import is_data_path
 from .schema import BOOL, FLOAT32, FLOAT64, INT32, INT64, STRING, Field, Schema
@@ -79,6 +80,31 @@ def index_row_group_rows() -> int:
 # Decode-pool work counters, bound once (incremented per cold-file decode).
 _DECODE_FILES = _metrics.counter("io.decode.files")
 _DECODE_SECONDS = _metrics.histogram("io.decode.seconds")
+# Decode-pool saturation: decodes currently EXECUTING (every decode path —
+# read_files pool, streaming prefetch, cache warmer, pipelined build — funnels
+# through the two _decode_*_into_cache functions below), plus the session
+# high-water mark. The key admission signal for the future scheduler.
+_DECODE_IN_FLIGHT = _metrics.gauge("io.decode.in_flight")
+_DECODE_IN_FLIGHT_PEAK = _metrics.gauge("io.decode.in_flight_peak")
+
+
+def _decode_begin() -> None:
+    _DECODE_IN_FLIGHT.inc()
+    _DECODE_IN_FLIGHT_PEAK.set_max(_DECODE_IN_FLIGHT.value)
+
+
+def _decode_end(t0: float) -> None:
+    """Close one decode's accounting: in-flight gauge down, work counters up,
+    and the task-seconds charged to the ambient query's ledger (pool paths
+    adopt the submitter's ledger via `accounting.use_ledger`)."""
+    import time as _time
+
+    dt = _time.monotonic() - t0
+    _DECODE_IN_FLIGHT.dec()
+    _DECODE_FILES.inc()
+    _DECODE_SECONDS.observe(dt)
+    _accounting.add("decode_files", 1)
+    _accounting.add("decode_task_s", dt)
 
 # Footer-metadata cache traffic + row-group pruning outcomes
 # (`bench_detail.io_pruning` and the per-scan span attrs read them). The
@@ -428,20 +454,23 @@ def _decode_into_cache(
     from .scan_cache import global_scan_cache
 
     t0 = _time.monotonic()
-    cache = global_scan_cache()
-    missing = cache.missing_columns(path, file_columns)
-    if missing and missing != list(file_columns or []):
-        cache.put(path, missing, _read_one(path, file_format, missing))
-        t = cache.get(path, file_columns, record=False)
-        if t is not None:
-            _DECODE_FILES.inc()
-            _DECODE_SECONDS.observe(_time.monotonic() - t0)
-            return t  # assembled: warm columns + the freshly decoded rest
-    t = _read_one(path, file_format, file_columns)
-    cache.put(path, file_columns, t)
-    _DECODE_FILES.inc()
-    _DECODE_SECONDS.observe(_time.monotonic() - t0)
-    return t
+    _decode_begin()
+    try:
+        cache = global_scan_cache()
+        missing = cache.missing_columns(path, file_columns)
+        if missing and missing != list(file_columns or []):
+            cache.put(path, missing, _read_one(path, file_format, missing))
+            t = cache.get(path, file_columns, record=False)
+            if t is not None:
+                _decode_end(t0)
+                return t  # assembled: warm columns + the freshly decoded rest
+        t = _read_one(path, file_format, file_columns)
+        cache.put(path, file_columns, t)
+        _decode_end(t0)
+        return t
+    except BaseException:
+        _DECODE_IN_FLIGHT.dec()  # failed decode still leaves the pool
+        raise
 
 
 def _empty_file_table(meta: FileFooterMeta, file_columns: Optional[List[str]]) -> Table:
@@ -519,12 +548,16 @@ def _record_decoded_bytes(
         return sum(rg.col_bytes.get(c, 0) for c in decoded_cols)
 
     keep = set(sel)
-    _RG_BYTES_DECODED.inc(
-        sum(cols_bytes(rg) for i, rg in enumerate(meta.row_groups) if i in keep)
+    decoded = sum(cols_bytes(rg) for i, rg in enumerate(meta.row_groups) if i in keep)
+    skipped = sum(
+        cols_bytes(rg) for i, rg in enumerate(meta.row_groups) if i not in keep
     )
-    _RG_BYTES_SKIPPED.inc(
-        sum(cols_bytes(rg) for i, rg in enumerate(meta.row_groups) if i not in keep)
-    )
+    _RG_BYTES_DECODED.inc(decoded)
+    _RG_BYTES_SKIPPED.inc(skipped)
+    # Ledger mirror: the SAME values at the SAME site, so a query's
+    # bytes_decoded reconciles with the io.pruning.* counters by construction.
+    _accounting.add("bytes_decoded", decoded)
+    _accounting.add("bytes_skipped", skipped)
 
 
 def _decode_rg_into_cache(
@@ -539,22 +572,25 @@ def _decode_rg_into_cache(
     from .scan_cache import global_scan_cache
 
     t0 = _time.monotonic()
-    cache = global_scan_cache()
-    missing = cache.missing_columns(path, cols, sel=sel)
-    if missing and missing != cols:
-        cache.put(path, missing, _read_row_groups_one(path, sel, missing), sel=sel)
-        t = cache.get(path, cols, record=False, sel=sel)
-        if t is not None:
-            _record_decoded_bytes(meta, sel, missing)
-            _DECODE_FILES.inc()
-            _DECODE_SECONDS.observe(_time.monotonic() - t0)
-            return t
-    t = _read_row_groups_one(path, sel, cols)
-    cache.put(path, cols, t, sel=sel)
-    _record_decoded_bytes(meta, sel, cols)
-    _DECODE_FILES.inc()
-    _DECODE_SECONDS.observe(_time.monotonic() - t0)
-    return t
+    _decode_begin()
+    try:
+        cache = global_scan_cache()
+        missing = cache.missing_columns(path, cols, sel=sel)
+        if missing and missing != cols:
+            cache.put(path, missing, _read_row_groups_one(path, sel, missing), sel=sel)
+            t = cache.get(path, cols, record=False, sel=sel)
+            if t is not None:
+                _record_decoded_bytes(meta, sel, missing)
+                _decode_end(t0)
+                return t
+        t = _read_row_groups_one(path, sel, cols)
+        cache.put(path, cols, t, sel=sel)
+        _record_decoded_bytes(meta, sel, cols)
+        _decode_end(t0)
+        return t
+    except BaseException:
+        _DECODE_IN_FLIGHT.dec()
+        raise
 
 
 def decorate_file_table(
@@ -608,13 +644,16 @@ def warm_file_cache(
     if len(jobs) > 1 and workers > 1:
         from concurrent.futures import ThreadPoolExecutor
 
+        led = _accounting.current_ledger()  # charge workers to the submitter
+
         def warm_one(job):
             p, sel, cols = job
-            if sel is None:
-                _decode_into_cache(p, file_format, file_columns)
-            else:
-                meta, _sel = (selections or {}).get(p, (None, None))
-                _decode_rg_into_cache(p, cols, sel, meta)
+            with _accounting.use_ledger(led):
+                if sel is None:
+                    _decode_into_cache(p, file_format, file_columns)
+                else:
+                    meta, _sel = (selections or {}).get(p, (None, None))
+                    _decode_rg_into_cache(p, cols, sel, meta)
 
         with ThreadPoolExecutor(max_workers=workers) as pool:
             list(pool.map(warm_one, jobs))
@@ -662,16 +701,19 @@ def iter_file_tables(
         _record_pruning(selections, pruning_stats)
         sel_of = dict(zip(ordered, selections))
 
+    led = _accounting.current_ledger()  # pool workers charge the submitter
+
     def decode_one(path: str) -> Table:
-        t0 = _time.monotonic()
-        meta, sel = sel_of.get(path, (None, None))
-        if sel is None:
-            t = file_table(path, file_format, file_columns)
-        else:
-            t = pruned_file_table(path, file_format, file_columns, meta, sel)
-        if on_decode is not None:
-            on_decode(_time.monotonic() - t0)
-        return t
+        with _accounting.use_ledger(led):
+            t0 = _time.monotonic()
+            meta, sel = sel_of.get(path, (None, None))
+            if sel is None:
+                t = file_table(path, file_format, file_columns)
+            else:
+                t = pruned_file_table(path, file_format, file_columns, meta, sel)
+            if on_decode is not None:
+                on_decode(_time.monotonic() - t0)
+            return t
 
     # The prefetch depth is the binding in-flight bound: more decode workers
     # than undelivered-file slots could only grow resident memory past it.
@@ -828,8 +870,14 @@ def read_files(
         # `=1` forces the serial path here exactly as it does for the build.
         from concurrent.futures import ThreadPoolExecutor
 
+        led = _accounting.current_ledger()  # charge workers to the submitter
+
+        def decode_miss_worker(i: int) -> Table:
+            with _accounting.use_ledger(led):
+                return decode_miss(i)
+
         with ThreadPoolExecutor(max_workers=workers) as pool:
-            decoded = list(pool.map(decode_miss, missing))
+            decoded = list(pool.map(decode_miss_worker, missing))
         for i, t in zip(missing, decoded):
             tables[i] = t
     else:

@@ -2098,19 +2098,19 @@ def _verify_lanes(
     return tuple(lanes), flat
 
 
-from functools import partial as _fpartial
-
 import jax as _jax
 
+from ..telemetry.compile_log import observed_jit as _observed_jit
 
-@_fpartial(_jax.jit, static_argnums=(0,))
+
+@_observed_jit(label="physical.gather_many", static_argnums=(0,))
 def _gather_many_jit(sides: tuple, li, ri, *arrays):
     """Batch gather through the join pair indices: one program for all
     payload columns of a fused join→aggregate."""
     return tuple(a[li if s == "l" else ri] for s, a in zip(sides, arrays))
 
 
-@_fpartial(_jax.jit, static_argnums=(0,))
+@_observed_jit(label="physical.verified_keep", static_argnums=(0,))
 def _verified_keep_jit(lanes: tuple, li, ri, valid, *flat):
     """Pair-validity mask on device: candidate (li, ri) pairs survive iff every
     key pair compares EQUAL on actual values (codes for strings) and no key slot
@@ -2130,12 +2130,12 @@ def _verified_keep_jit(lanes: tuple, li, ri, valid, *flat):
     return keep
 
 
-@_fpartial(_jax.jit, static_argnums=(0,))
+@_observed_jit(label="physical.verified_count", static_argnums=(0,))
 def _verified_count_jit(lanes: tuple, li, ri, valid, *flat):
     return _verified_keep_jit(lanes, li, ri, valid, *flat).sum(dtype=jnp.int64)
 
 
-@_fpartial(_jax.jit, static_argnums=(0, 1, 2))
+@_observed_jit(label="physical.verified_match_counts", static_argnums=(0, 1, 2))
 def _verified_match_counts_jit(lanes: tuple, lcap: int, rcap: int, li, ri, valid, *flat):
     """(verified pair count, distinct matched left rows, distinct matched
     right rows) in one program — everything every join type's COUNT needs
@@ -2186,7 +2186,7 @@ def _value_inner_count_body(lv, rv, xp=jnp):
     return counts.sum(dtype=np.int64)
 
 
-@_jax.jit
+@_observed_jit(label="physical.value_inner_count")
 def _value_inner_count_jit(lv, rv):
     return _value_inner_count_body(lv, rv)
 

@@ -23,6 +23,7 @@ import threading
 from collections import OrderedDict
 from typing import List, Optional, Tuple
 
+from ..telemetry import accounting as _accounting
 from ..telemetry import metrics as _metrics
 from .table import Table
 
@@ -115,12 +116,19 @@ class ScanCache:
 
     def _evict_to_capacity_locked(self) -> None:
         """LRU-evict until under budget; caller holds the lock. Size is the
-        LAST element of each entry tuple (shared with BucketedConcatCache)."""
+        LAST element of each entry tuple (shared with BucketedConcatCache).
+        Evicted bytes are charged to the ambient query's ledger — the query
+        whose puts displaced them (the cache-pressure half of the
+        accounting; `cache_bytes_charged` is ticked at the put sites)."""
+        evicted = 0
         while self._bytes > self._capacity and self._entries:
             _, ent = self._entries.popitem(last=False)
             self._bytes -= ent[-1]
+            evicted += ent[-1]
             self.evictions += 1
             self._m_evictions.inc()
+        if evicted:
+            _accounting.add("cache_bytes_evicted", evicted)
         self._m_bytes.set(self._bytes)
 
     def set_capacity(self, capacity_bytes: int) -> None:
@@ -222,6 +230,7 @@ class ScanCache:
                 key = base + (("names",),)
                 if key not in self._entries:
                     self._entries[key] = (list(table.column_names), 0)
+            charged = 0
             for n, c in table.columns.items():
                 key = base + (self._col_key(n, sel),)
                 if key in self._entries:
@@ -231,6 +240,9 @@ class ScanCache:
                     continue
                 self._entries[key] = (c, size)
                 self._bytes += size
+                charged += size
+            if charged:
+                _accounting.add("cache_bytes_charged", charged)
             self._evict_to_capacity_locked()
 
     # -- footer metadata (parquet zone maps) --------------------------------
@@ -260,6 +272,7 @@ class ScanCache:
                 return
             self._entries[key] = (meta, int(nbytes))
             self._bytes += int(nbytes)
+            _accounting.add("cache_bytes_charged", int(nbytes))
             self._evict_to_capacity_locked()
 
     def clear(self) -> None:
@@ -341,6 +354,7 @@ class BucketedConcatCache:
                 return
             self._entries[key] = (table, starts, size)
             self._bytes += size
+            _accounting.add("cache_bytes_charged", size)
             self._evict_to_capacity_locked()
 
     def clear(self) -> None:

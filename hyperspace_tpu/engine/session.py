@@ -171,25 +171,27 @@ class DataFrame:
         )
 
     def collect(self) -> Table:
-        from ..telemetry import tracing
+        from ..telemetry import accounting, tracing
 
         with tracing.query_span("query:collect") as root:
             with tracing.span("plan"):
                 phys = self.physical_plan()
             out = phys.execute(ExecContext(self.session))
             root.set_attr("rows_out", int(out.num_rows))
+            accounting.set_value("rows_produced", int(out.num_rows))
             return out
 
     def count(self) -> int:
         # Counts never assemble output they don't need: scans answer from parquet
         # footers, joins from verified pair counts (`PhysicalNode.execute_count`).
-        from ..telemetry import tracing
+        from ..telemetry import accounting, tracing
 
         with tracing.query_span("query:count") as root:
             with tracing.span("plan"):
                 phys = self.physical_plan()
             n = phys.execute_count(ExecContext(self.session))
             root.set_attr("rows_out", int(n))
+            accounting.set_value("rows_produced", int(n))
             return n
 
     def to_pydict(self) -> Dict[str, list]:

@@ -521,11 +521,16 @@ def _decode_and_finish(
     file_tables: List[Optional[Table]] = [None] * n_files
     hash_q: "queue.Queue[int | None]" = queue.Queue()
 
+    from ..telemetry import accounting as _accounting
+
+    led = _accounting.current_ledger()  # pool decodes charge the build's ledger
+
     def decode_one(i: int) -> None:
-        with stages.timed("decode"):
-            file_tables[i] = _decode_file(
-                files_in_order[i], file_format, wanted, partitions, lineage
-            )
+        with _accounting.use_ledger(led):
+            with stages.timed("decode"):
+                file_tables[i] = _decode_file(
+                    files_in_order[i], file_format, wanted, partitions, lineage
+                )
         hash_q.put(i)
 
     device = use_device_path()

@@ -33,12 +33,11 @@ from ..engine.schema import BOOL, FLOAT32, FLOAT64, INT32, INT64, STRING
 from ..engine.table import Column, Table
 from ..exceptions import HyperspaceException
 from ..engine.device_cache import device_array
+from ..telemetry.compile_log import observed_jit as _observed_jit
 from .hashing import key64
 
 #: (out_name, fn, column|None) — column is None only for count(*).
 AggTriple = Tuple[str, str, Optional[str]]
-
-from functools import partial as _partial
 
 
 def _group_ids_body(has_valid: tuple, perm, flat, xp=jnp):
@@ -67,7 +66,7 @@ def _group_ids_body(has_valid: tuple, perm, flat, xp=jnp):
     return boundary, gid
 
 
-@_partial(jax.jit, static_argnums=(0,))
+@_observed_jit(label="aggregate.group_ids", static_argnums=(0,))
 def _group_ids_fused(has_valid: tuple, k64, *flat):
     """Device path of the group-id pipeline as ONE compiled program."""
     perm = jnp.argsort(k64)  # stable by default
@@ -187,7 +186,7 @@ def _canon_distinct_traced(x):
     return x
 
 
-@_partial(jax.jit, static_argnums=(0, 1))
+@_observed_jit(label="aggregate.count_distinct", static_argnums=(0, 1))
 def _count_distinct_dev_jit(n_groups: int, has_valid: bool, gid, perm, x, valid=None):
     """Per-group exact distinct counts ON DEVICE, for rows already run through
     the group-id program (`gid`/`perm` from `_group_ids_fused`): sort each
@@ -277,14 +276,14 @@ def _seg_reduce_body(fn: str, n_groups: int, has_valid: bool, gid, perm, x, vali
     return reduce(masked, gid, num_segments=n_groups), n_valid
 
 
-@_partial(jax.jit, static_argnums=(0, 1, 2))
+@_observed_jit(label="aggregate.seg_reduce", static_argnums=(0, 1, 2))
 def _seg_reduce_jit(fn: str, n_groups: int, has_valid: bool, gid, perm, x, valid=None):
     """One aggregate's whole device pipeline as a single compiled program,
     keyed on (fn, n_groups, validity presence, shapes/dtypes)."""
     return _seg_reduce_body(fn, n_groups, has_valid, gid, perm, x, valid)
 
 
-@_partial(jax.jit, static_argnums=(0, 1))
+@_observed_jit(label="aggregate.seg_reduce_multi", static_argnums=(0, 1))
 def _seg_reduce_multi_jit(specs: tuple, n_groups: int, gid, perm, *flat):
     """EVERY aggregate's segment reduction in ONE compiled program — on a
     remote PJRT transport each dispatch is a round-trip, so a 4-aggregate
@@ -882,7 +881,12 @@ def _stream_reduce_fn(n_flat: int, donate: bool):
         return tuple(out)
 
     donate_argnums = tuple(range(2, 5 + n_flat)) if donate else ()
-    fn = jax.jit(body, static_argnums=(0, 1), donate_argnums=donate_argnums)
+    fn = _observed_jit(
+        body,
+        label="aggregate.stream_reduce",
+        static_argnums=(0, 1),
+        donate_argnums=donate_argnums,
+    )
     _STREAM_REDUCE_FNS[key] = fn
     return fn
 

@@ -19,12 +19,13 @@ from __future__ import annotations
 import os
 import time
 
-from functools import partial
 from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from ..telemetry.compile_log import observed_jit as _observed_jit
 
 _PAD = jnp.iinfo(jnp.int64).max
 
@@ -59,7 +60,7 @@ def _cap_pow2(n: int) -> int:
     return 1 << (max(1, n) - 1).bit_length()
 
 
-@partial(jax.jit, static_argnums=(2, 3))
+@_observed_jit(label="bucket_join.pad_scatter", static_argnums=(2, 3))
 def _pad_scatter(keys, starts, num_buckets: int, cap: int):
     """Scatter per-row keys (concatenated in bucket order) into an UNSORTED
     padded [B, cap] matrix (pad = i64 max) + per-bucket lengths — the input
@@ -74,7 +75,7 @@ def _pad_scatter(keys, starts, num_buckets: int, cap: int):
     return padded, lengths
 
 
-@partial(jax.jit, static_argnums=(2, 3))
+@_observed_jit(label="bucket_join.pad_and_sort", static_argnums=(2, 3))
 def _pad_and_sort(keys, starts, num_buckets: int, cap: int):
     """Scatter per-row keys (concatenated in bucket order) into a sorted [B, cap]
     matrix. Returns (sorted_keys [B,cap], order [B,cap] slot→original-slot, lengths).
@@ -86,7 +87,7 @@ def _pad_and_sort(keys, starts, num_buckets: int, cap: int):
     return sorted_keys, order, lengths
 
 
-@jax.jit
+@_observed_jit(label="bucket_join.probe")
 def _probe(ls, rs, l_len, r_len):
     """Batched range probe: for each left slot, the [lo, hi) match range in the
     right bucket, clamped to valid rows; counts zeroed for left pad slots.
@@ -155,7 +156,7 @@ def _expand_np(
     return l_starts[b] + l_slot, r_starts[b] + r_slot
 
 
-@partial(jax.jit, static_argnums=(0, 1))
+@_observed_jit(label="bucket_join.expand_pairs", static_argnums=(0, 1))
 def _expand_pairs_dev(
     out_cap: int,
     has_order: bool,
@@ -195,7 +196,7 @@ def _expand_pairs_dev(
     return ai, bi, j < total
 
 
-@partial(jax.jit, static_argnums=(0,))
+@_observed_jit(label="bucket_join.compact_pairs", static_argnums=(0,))
 def _compact_pairs_dev(out_cap2: int, ai, bi, keep):
     """Stream-compact verified pairs to a static pow2 size. Pad slots repeat
     the FIRST kept pair (a real, verified pair), so downstream group detection
@@ -216,12 +217,12 @@ def _counts_total(counts):
     return _counts_total_jit(counts)
 
 
-@jax.jit
+@_observed_jit(label="bucket_join.counts_total")
 def _counts_total_jit(counts):
     return counts.sum(dtype=jnp.int64)
 
 
-@partial(jax.jit, static_argnums=(2, 3))
+@_observed_jit(label="bucket_join.pad_only", static_argnums=(2, 3))
 def _pad_only(vals, starts, num_buckets: int, cap: int, pad_value):
     """Scatter per-row values (concatenated in bucket order) into a padded [B, cap]
     matrix WITHOUT sorting, plus a per-bucket sortedness check."""

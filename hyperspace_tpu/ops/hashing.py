@@ -20,11 +20,12 @@ shuffle-free bucketed join sound — reference `JoinIndexRule.scala:144-156`).
 from __future__ import annotations
 
 import hashlib
-from functools import partial as _partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from ..telemetry.compile_log import observed_jit as _observed_jit
 
 from typing import TYPE_CHECKING
 
@@ -168,7 +169,7 @@ def _unflatten(kinds, flat, per_str: int):
     return cols
 
 
-@_partial(jax.jit, static_argnums=(0,))
+@_observed_jit(label="hashing.key64", static_argnums=(0,))
 def _key64_fused(kinds, *flat):
     """Both hash lanes + the 64-bit pack in ONE compiled program. Each eager
     jnp op is a dispatch — ~40 per key64 — and on the axon relay every
@@ -180,13 +181,13 @@ def _key64_fused(kinds, *flat):
     return (h1.astype(jnp.int64) << jnp.int64(32)) | h2.astype(jnp.int64)
 
 
-@_partial(jax.jit, static_argnums=(0, 1))
+@_observed_jit(label="hashing.combined_hash", static_argnums=(0, 1))
 def _combined_fused(kinds, seed, *flat):
     cols = _unflatten(kinds, flat, 2)
     return _lane_trace(seed, 0, cols)
 
 
-@_partial(jax.jit, static_argnums=(0, 1))
+@_observed_jit(label="hashing.bucket_id", static_argnums=(0, 1))
 def _bucket_id_fused(kinds, num_buckets, *flat):
     cols = _unflatten(kinds, flat, 2)
     h1 = _lane_trace(_SEED1, 0, cols)

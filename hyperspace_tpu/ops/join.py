@@ -20,6 +20,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..telemetry.compile_log import observed_jit as _observed_jit
+
 
 def stable_argsort_host(x) -> np.ndarray:
     """The host branch of the backend-adaptive sort trade, as a NUMPY
@@ -39,7 +41,7 @@ def _range_probe_body(l_key64, r_key64, l_order, r_order, xp=jnp):
     return lo, hi - lo
 
 
-@jax.jit
+@_observed_jit(label="join.sorted_ranges")
 def _merge_phase_a(l_key64, r_key64):
     """Sort both sides + range-probe in ONE compiled program (each eager op is
     a dispatch, and on the axon relay every dispatch is a round-trip)."""

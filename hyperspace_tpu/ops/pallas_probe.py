@@ -31,7 +31,6 @@ the XLA path is pinned by tests/test_pallas_probe.py (interpret mode off-TPU).
 from __future__ import annotations
 
 import os
-from functools import partial
 from typing import Tuple
 
 import jax
@@ -55,6 +54,7 @@ _pallas_broken: dict = {}  # kind -> first failure message; permanent fallback
 _fallback_counts: dict = {}  # kind -> how many probes fell back to XLA/host
 
 from ..telemetry import metrics as _metrics
+from ..telemetry.compile_log import observed_jit as _observed_jit
 
 # Bound once: after a latch, EVERY subsequent dispatch increments — no name
 # formatting or registry lookup on that path (same convention as the engine's
@@ -178,7 +178,7 @@ def shape_supported(B: int, cap_l: int, cap_r: int) -> bool:
     return cap_l % tl == 0 and cap_r % tr == 0
 
 
-@partial(jax.jit, static_argnums=(4,))
+@_observed_jit(label="pallas.probe", static_argnums=(4,))
 def _probe_pallas_call(lh, ll, rh, rl, interpret: bool):
     B, cap_l = lh.shape
     cap_r = rh.shape[1]

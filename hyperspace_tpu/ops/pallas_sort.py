@@ -30,7 +30,6 @@ on any lowering failure, scoped with the same latch discipline as the probe.
 from __future__ import annotations
 
 import os
-from functools import partial
 from typing import Tuple
 
 import jax
@@ -47,6 +46,7 @@ _sort_broken: dict = {}  # scoped latch (single kind: "sort")
 _fallback_counts: dict = {}  # diverted-dispatch counter after a latch
 
 from ..telemetry import metrics as _metrics
+from ..telemetry.compile_log import observed_jit as _observed_jit
 
 # Bound once: incremented on every diverted dispatch after a latch.
 _FALLBACK_METRIC = _metrics.counter("pallas.sort.fallbacks")
@@ -128,7 +128,7 @@ def shape_supported(B: int, cap: int) -> bool:
     return True
 
 
-@partial(jax.jit, static_argnums=(3,))
+@_observed_jit(label="pallas.sort", static_argnums=(3,))
 def _sort_pallas_call(hi, lo, idx, interpret: bool):
     B, cap = hi.shape
     TB = _bucket_tile(B)
@@ -148,7 +148,7 @@ def _sort_pallas_call(hi, lo, idx, interpret: bool):
     )(hi, lo, idx)
 
 
-@jax.jit
+@_observed_jit(label="pallas.sort_recombine")
 def _recombine(hi, lo):
     """(hi, lo) int32 pair → the original int64 keys (undo `_split_hi_lo`)."""
     h = hi.astype(jnp.int64) << 32

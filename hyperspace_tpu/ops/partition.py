@@ -19,7 +19,6 @@ other.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import List, Sequence, Tuple
 
 import jax
@@ -27,10 +26,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..engine.table import Column, Table
+from ..telemetry.compile_log import observed_jit as _observed_jit
 from .hashing import bucket_id
 
 
-@partial(jax.jit, static_argnums=(2,))
+@_observed_jit(label="partition.sort_perm", static_argnums=(2,))
 def _sort_perm(bucket, keys: Tuple, n: int):
     """Permutation ordering rows by (bucket, key1, key2, ...)."""
     iota = jnp.arange(n, dtype=jnp.int32)
@@ -180,7 +180,11 @@ def _fused_sort_program(n_keys: int, n_chunks: int, num_buckets: int):
         res = jax.lax.sort(operands, num_keys=1 + n_keys)
         return res[-1], res[0]  # (permutation, sorted bucket ids) incl. pad tail
 
-    return jax.jit(impl, donate_argnums=tuple(range(1, 1 + n_keys * n_chunks)))
+    return _observed_jit(
+        impl,
+        label="partition.fused_bucketize_sort",
+        donate_argnums=tuple(range(1, 1 + n_keys * n_chunks)),
+    )
 
 
 def fused_bucketize_sort_perm(

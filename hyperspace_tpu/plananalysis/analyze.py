@@ -75,6 +75,14 @@ def _node_annotation(span, children_of, rendered_ids) -> str:
         v = _subtree_verdict(span, children_of, key, rendered_ids, own_id)
         if v is not None:
             parts.append(f"{key}={v}")
+    compiles = span.attrs.get("xla_compiles")
+    if compiles:
+        # Compile-observatory delta: XLA compiles triggered while this span
+        # was the ambient one (a cold operator shows its compile bill here).
+        parts.append(
+            f"compiles={compiles}"
+            f"(+{_fmt_seconds(span.attrs.get('xla_compile_s') or 0.0)})"
+        )
     if span.status != "ok":
         parts.append(f"status={span.status}")
     return "   [" + ", ".join(parts) + "]"
@@ -117,7 +125,7 @@ def _stage_lines(span, children_of, indent: int) -> List[str]:
 def explain_analyze_string(df) -> str:
     """Execute `df` once under a trace and render the annotated plan tree."""
     from ..engine.physical import ExecContext
-    from ..telemetry import metrics, tracing
+    from ..telemetry import accounting, metrics, tracing
 
     session = df.session
     snap0 = metrics.snapshot()
@@ -127,6 +135,7 @@ def explain_analyze_string(df) -> str:
                 phys = df.physical_plan()
             result = phys.execute(ExecContext(session))
             root.set_attr("rows_out", int(result.num_rows))
+            accounting.set_value("rows_produced", int(result.num_rows))
     snap1 = metrics.snapshot()
     trace = cap.trace
     if trace is None:  # defensive: capture always receives the root above
@@ -197,6 +206,18 @@ def explain_analyze_string(df) -> str:
             lines.append(f"  {d.get('rule')}: {verdict}{suffix}")
     else:
         lines.append("  (none recorded — no optimizer rules fired on this plan)")
+
+    # Resource ledger: what THIS query spent (exact per-query attribution —
+    # the contextvar-scoped ledger, not the process-wide counters below).
+    led = accounting.ledger_for(trace.query_id)
+    lines.append("")
+    lines.append("Resource ledger (this query):")
+    if led is not None:
+        d = led.to_dict()
+        for key in sorted(k for k in d if k not in ("query_id", "name", "start_s")):
+            lines.append(f"  {key}: {d[key]}")
+    else:
+        lines.append("  (no ledger recorded)")
 
     delta = metrics.counters_delta(snap0, snap1)
     lines.append("")

@@ -1,4 +1,4 @@
-from . import metrics, tracing  # noqa: F401
+from . import accounting, compile_log, exporter, metrics, tracing  # noqa: F401
 from .event_logging import (  # noqa: F401
     EventLogger,
     EventLoggerFactory,
@@ -18,3 +18,8 @@ from .events import (  # noqa: F401
     RestoreActionEvent,
     VacuumActionEvent,
 )
+
+# Opt-in continuous metrics stream: HYPERSPACE_METRICS_FILE set at import →
+# the exporter daemon starts here (the engine imports telemetry before any
+# query runs). Unset = no thread, nothing armed.
+exporter.maybe_start_from_env()

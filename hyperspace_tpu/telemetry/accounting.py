@@ -1,0 +1,276 @@
+"""Per-query resource ledger: what each query actually SPENT.
+
+The metrics registry answers "what did the process do" (cumulative counters);
+the span tree answers "where did this query's time go". Neither attributes
+RESOURCES — bytes decoded, cache bytes charged/evicted, decode-pool
+task-seconds, device buffers — to the query that spent them, which is the
+currency an admission controller needs (ROADMAP item 2) and the cost model
+"Evaluating Learned Indexes for External-Memory Joins" argues for: bytes
+moved, per consumer.
+
+One `QueryLedger` rides each root query scope (the same boundary as the root
+span — `tracing.query_span` opens both). Engine hooks call the module-level
+`add(key, n)`, which resolves the ambient ledger through a contextvar; pool
+workers inherit it via `use_ledger` (captured at submit time, exactly like
+the explicit `parent=` contract for worker spans). With no sink active,
+`add` is one contextvar read returning None — the standing off-by-default
+≈zero-cost contract.
+
+Ledger fields (all monotonic within one query):
+
+- ``bytes_decoded`` / ``bytes_skipped`` — ticked at the SAME sites with the
+  SAME values as the process-wide ``io.pruning.bytes_decoded|skipped``
+  counters (`engine.io._record_decoded_bytes`), so per-query totals
+  reconcile with the counters by construction.
+- ``decode_files`` / ``decode_task_s`` — decode-pool work charged to the
+  submitting query (task-seconds, not wall: concurrent decodes sum).
+- ``rows_produced`` — root result rows (collect/count).
+- ``cache_bytes_charged`` / ``cache_bytes_evicted`` — scan/concat-cache
+  residency this query added or displaced.
+- ``device_upload_bytes`` — host→device transfers this query caused.
+- ``device_live_bytes`` — `jax.live_arrays()` byte total SAMPLED at close
+  (only when jax is already imported; a point-in-time reading, not a sum).
+- ``wall_s`` — the root scope's wall clock.
+
+Closed ledgers land on the root span (`attrs["ledger"]`, so the JSONL trace
+carries them), in a bounded history (`recent_ledgers`, what
+`explain(analyze=True)` renders), in the exporter's drain queue, and in the
+``accounting.*`` registry counters (process totals of the attributed work).
+Latency histograms are fed here too: every closed scope observes
+``latency.<root name>`` so `snapshot()` yields p50/p99 distributions even
+when span tracing is off (exporter-only mode).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import sys
+import threading
+import time
+from collections import deque
+from typing import Iterator, List, Optional
+
+from . import metrics as _metrics
+
+ENV_ACCOUNTING = "HYPERSPACE_ACCOUNTING"
+
+#: Integer ledger fields mirrored into ``accounting.<field>`` registry
+#: counters at close (process-wide totals of query-attributed work).
+_COUNTER_FIELDS = (
+    "bytes_decoded",
+    "bytes_skipped",
+    "decode_files",
+    "rows_produced",
+    "cache_bytes_charged",
+    "cache_bytes_evicted",
+    "device_upload_bytes",
+)
+
+_current: "contextvars.ContextVar[Optional[QueryLedger]]" = contextvars.ContextVar(
+    "hyperspace_query_ledger", default=None
+)
+
+_RECENT: "deque[QueryLedger]" = deque(maxlen=32)
+_recent_lock = threading.Lock()
+# Exporter drain queue: bounded so an idle exporter (or none at all) can
+# never grow memory with query count — oldest frames age out silently.
+_PENDING: "deque[dict]" = deque(maxlen=256)
+
+
+class QueryLedger:
+    """Thread-safe resource accumulator for one root query scope."""
+
+    __slots__ = ("query_id", "name", "start_s", "wall_s", "_lock", "_counts")
+
+    def __init__(self, query_id: str, name: str):
+        self.query_id = query_id
+        self.name = name
+        self.start_s = time.time()
+        self.wall_s: Optional[float] = None
+        self._lock = threading.Lock()
+        self._counts: dict = {}
+
+    def add(self, key: str, n) -> None:
+        with self._lock:
+            self._counts[key] = self._counts.get(key, 0) + n
+
+    def set_value(self, key: str, n) -> None:
+        with self._lock:
+            self._counts[key] = n
+
+    def get(self, key: str):
+        with self._lock:
+            return self._counts.get(key, 0)
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            out = {
+                "query_id": self.query_id,
+                "name": self.name,
+                "start_s": round(self.start_s, 6),
+            }
+            if self.wall_s is not None:
+                out["wall_s"] = round(self.wall_s, 6)
+            for k in sorted(self._counts):
+                v = self._counts[k]
+                out[k] = round(v, 6) if isinstance(v, float) else v
+            return out
+
+
+def enabled() -> bool:
+    """Whether query scopes should carry a ledger: any tracing sink is active
+    (a traced query always gets one), the continuous exporter is running, or
+    ``HYPERSPACE_ACCOUNTING=1`` forces it. One predicate on the root-scope
+    path only — per-observation `add` calls gate on the ambient ledger, not
+    on this."""
+    if os.environ.get(ENV_ACCOUNTING) == "1":
+        return True
+    from . import tracing
+
+    if tracing.active():
+        return True
+    from . import exporter
+
+    return exporter.running()
+
+
+def current_ledger() -> Optional[QueryLedger]:
+    return _current.get()
+
+
+def add(key: str, n) -> None:
+    """Charge `n` of `key` to the ambient query's ledger; no-op (one
+    contextvar read) without one."""
+    led = _current.get()
+    if led is not None:
+        led.add(key, n)
+
+
+def set_value(key: str, n) -> None:
+    """Last-write-wins field on the ambient ledger. Used for ROOT facts
+    (`rows_produced`): a nested collect inside an outer query scope writes
+    first, and the outer action's own write lands last — the ledger reports
+    the root result, never an inner+outer sum."""
+    led = _current.get()
+    if led is not None:
+        led.set_value(key, n)
+
+
+@contextlib.contextmanager
+def use_ledger(led: Optional[QueryLedger]) -> Iterator[None]:
+    """Adopt `led` as the ambient ledger on THIS thread (pool workers run in
+    a fresh context; the submitting code captures `current_ledger()` and
+    wraps the worker body — the ledger twin of `span(parent=...)`)."""
+    if led is None:
+        yield
+        return
+    token = _current.set(led)
+    try:
+        yield
+    finally:
+        _current.reset(token)
+
+
+#: Device-buffer sampling rate limit: `jax.live_arrays()` walks EVERY live
+#: buffer, so a serving process with thousands of resident device arrays
+#: must not pay that walk per sub-millisecond query. Ledgers closing inside
+#: the window reuse the last sample — the value is a process-wide
+#: point-in-time reading either way, not per-query attribution.
+_DEVICE_SAMPLE_MIN_INTERVAL_S = 1.0
+_device_sample_lock = threading.Lock()
+_device_sample: list = [-_DEVICE_SAMPLE_MIN_INTERVAL_S, None]  # [mono ts, bytes]
+
+
+def _device_live_bytes() -> Optional[int]:
+    """`jax.live_arrays()` byte total, only when jax is ALREADY imported
+    (accounting must never pay the import) and the probe succeeds; sampled
+    at most once per `_DEVICE_SAMPLE_MIN_INTERVAL_S` (stale value reused)."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    now = time.monotonic()
+    with _device_sample_lock:
+        if now - _device_sample[0] < _DEVICE_SAMPLE_MIN_INTERVAL_S:
+            return _device_sample[1]
+        _device_sample[0] = now  # claim the slot: concurrent closers reuse
+    try:
+        val = int(sum(int(a.nbytes) for a in jax.live_arrays()))
+    except Exception:
+        val = None
+    with _device_sample_lock:
+        _device_sample[1] = val
+    return val
+
+
+@contextlib.contextmanager
+def ledger_scope(query_id: str, name: str, root=None) -> Iterator[QueryLedger]:
+    """Open the ledger of one root query scope. Nested under an existing
+    ledger it yields that ledger unchanged — one ledger per outermost action,
+    matching the one-query_id-per-root-span rule. At close the ledger banks
+    to the bounded history + exporter queue, mirrors into the
+    ``accounting.*`` counters, observes the query-latency histogram, and
+    lands on `root`'s attrs when a span is recording."""
+    existing = _current.get()
+    if existing is not None:
+        yield existing
+        return
+    led = QueryLedger(query_id, name)
+    token = _current.set(led)
+    t0 = time.monotonic()
+    try:
+        yield led
+    finally:
+        _current.reset(token)
+        wall = None
+        if root is not None:
+            wall = getattr(root, "duration_s", None)
+        if wall is None:
+            wall = time.monotonic() - t0
+        led.wall_s = wall
+        dev = _device_live_bytes()
+        if dev is not None:
+            led.add("device_live_bytes", dev)
+            _metrics.gauge("device.live_bytes").set(dev)
+        # Latency distribution: fed HERE (not at span end) so exporter-only
+        # runs still get p50/p99 — and a traced run observes exactly once.
+        _metrics.histogram(f"latency.{name.replace(':', '.')}").observe(wall)
+        for field in _COUNTER_FIELDS:
+            v = led.get(field)
+            if v:
+                _metrics.counter(f"accounting.{field}").inc(v)
+        d = led.to_dict()
+        if root is not None:
+            try:
+                root.set_attr("ledger", d)
+            except Exception:
+                pass
+        with _recent_lock:
+            _RECENT.append(led)
+            _PENDING.append(d)
+
+
+def recent_ledgers() -> List[QueryLedger]:
+    """Closed ledgers, oldest first (bounded history, newest last)."""
+    with _recent_lock:
+        return list(_RECENT)
+
+
+def ledger_for(query_id: str) -> Optional[QueryLedger]:
+    with _recent_lock:
+        for led in reversed(_RECENT):
+            if led.query_id == query_id:
+                return led
+    return None
+
+
+def drain_pending() -> List[dict]:
+    """Hand the exporter every ledger closed since the last drain (bounded
+    queue: with no exporter attached old entries age out instead of
+    growing)."""
+    out: List[dict] = []
+    with _recent_lock:
+        while _PENDING:
+            out.append(_PENDING.popleft())
+    return out

@@ -1,0 +1,245 @@
+"""XLA compile observatory: who is compiling, what, how often, for how long.
+
+The r05 TPU bench died inside a 2400 s compile of `ops/hashing.bucket_id`
+with ZERO telemetry — no record of which program was compiling, how many
+distinct shapes it had already compiled, or how long each took. This module
+makes that failure mode diagnosable:
+
+- A ``jax.monitoring`` duration listener (`install`) observes every backend
+  compile and jaxpr trace the process performs, feeding the registry:
+  ``xla.compiles.count`` / ``xla.compiles.seconds`` (a quantile histogram) /
+  ``xla.compiles.traces``, plus ``xla.compile_cache.*`` counters from the
+  persistent-cache events. Listener cost is zero between compiles — jax only
+  calls it when a compile actually happens.
+- Per-program attribution: the engine's jit entry points in ``ops/`` (and
+  the fused device helpers) are declared through `observed_jit`, a drop-in
+  `jax.jit` wrapper that pushes its label onto a thread-local stack for the
+  duration of each call. Compiles are synchronous inside the call, so the
+  listener reads the top of that stack — compile count, elapsed seconds, and
+  distinct traced shapes per LABEL (`program_summary`), at the cost of one
+  list push/pop per jit call.
+- Operator-span deltas: while a span is recording, each backend compile also
+  increments ``xla_compiles`` / ``xla_compile_s`` attrs on the ambient span,
+  so `explain(analyze=True)` and the JSONL trace show compile time on the
+  operator that triggered it.
+- Recompile-storm warning: when one program label crosses
+  ``HYPERSPACE_COMPILE_STORM_THRESHOLD`` distinct traced shapes (default 32,
+  0 disables), a `warnings.warn` fires ONCE for that label and
+  ``xla.compiles.storm_warnings`` ticks — the silent-hang precursor (a
+  non-quantized shape stream) becomes a loud, attributed signal.
+- Fallback: on a jax build without ``jax.monitoring``, `observed_jit`
+  instead watches the jitted callable's compile-cache size around each call
+  and charges the call's wall time to a detected compile — coarser, but the
+  counters stay nonzero.
+
+`install` never imports jax itself — it is called from `observed_jit`, whose
+call sites have jax imported by definition.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import threading
+import warnings
+from typing import Dict, Optional
+
+from . import metrics as _metrics
+from . import tracing as _tracing
+
+ENV_STORM_THRESHOLD = "HYPERSPACE_COMPILE_STORM_THRESHOLD"
+_DEFAULT_STORM_THRESHOLD = 32
+
+_EVENT_BACKEND_COMPILE = "/jax/core/compile/backend_compile_duration"
+_EVENT_JAXPR_TRACE = "/jax/core/compile/jaxpr_trace_duration"
+_CACHE_EVENT_PREFIX = "/jax/compilation_cache/"
+
+_COMPILES = _metrics.counter("xla.compiles.count")
+_COMPILE_SECONDS = _metrics.histogram("xla.compiles.seconds")
+_TRACES = _metrics.counter("xla.compiles.traces")
+_STORMS = _metrics.counter("xla.compiles.storm_warnings")
+
+_UNLABELED = "<unlabeled>"
+
+_local = threading.local()  # per-thread label stack (compiles are synchronous)
+_lock = threading.Lock()
+_programs: Dict[str, dict] = {}
+_installed = False
+_have_monitoring = False
+
+
+def storm_threshold() -> int:
+    """Distinct traced shapes per program before the storm warning (0 = off)."""
+    try:
+        return int(
+            os.environ.get(ENV_STORM_THRESHOLD, _DEFAULT_STORM_THRESHOLD)
+            or _DEFAULT_STORM_THRESHOLD
+        )
+    except ValueError:
+        return _DEFAULT_STORM_THRESHOLD
+
+
+def _current_label() -> str:
+    stack = getattr(_local, "stack", None)
+    return stack[-1] if stack else _UNLABELED
+
+
+def _program(label: str) -> dict:
+    with _lock:
+        p = _programs.get(label)
+        if p is None:
+            p = _programs[label] = {
+                "compiles": 0,
+                "compile_s": 0.0,
+                "traces": 0,
+                "storm_warned": False,
+            }
+        return p
+
+
+def _check_storm(label: str, p: dict) -> None:
+    if label == _UNLABELED:
+        # The unlabeled bucket aggregates every jit program OUTSIDE the
+        # engine's declared entry points (jax-internal helpers, eager
+        # dispatch fragments) — many distinct programs, so "distinct shapes
+        # of one program" is meaningless there and would warn on any long
+        # session. Storm detection applies to labeled programs only.
+        return
+    threshold = storm_threshold()
+    if threshold <= 0:
+        return
+    with _lock:
+        if p["storm_warned"] or p["traces"] < threshold:
+            return
+        p["storm_warned"] = True
+    _STORMS.inc()
+    warnings.warn(
+        f"hyperspace compile storm: program '{label}' has traced "
+        f"{p['traces']} distinct shapes (threshold {threshold}, "
+        f"{p['compile_s']:.1f}s in backend compiles so far) — a shape that "
+        f"is not pow2-quantized is likely recompiling per call; see "
+        f"docs/observability.md (compile observatory)",
+        RuntimeWarning,
+        stacklevel=2,
+    )
+
+
+def _on_event_duration(event: str, duration: float, **_kw) -> None:
+    """jax.monitoring duration listener — called only when jax compiles."""
+    if event == _EVENT_BACKEND_COMPILE:
+        _COMPILES.inc()
+        _COMPILE_SECONDS.observe(duration)
+        label = _current_label()
+        p = _program(label)
+        with _lock:
+            p["compiles"] += 1
+            p["compile_s"] += float(duration)
+        sp = _tracing.current_span()
+        if sp is not None:
+            sp.inc_attr("xla_compiles", 1)
+            sp.inc_attr("xla_compile_s", round(float(duration), 6))
+    elif event == _EVENT_JAXPR_TRACE:
+        _TRACES.inc()
+        label = _current_label()
+        p = _program(label)
+        with _lock:
+            p["traces"] += 1
+        _check_storm(label, p)
+
+
+def _on_event(event: str, **_kw) -> None:
+    """Plain-event listener: persistent compile-cache traffic counters."""
+    if event.startswith(_CACHE_EVENT_PREFIX):
+        leaf = event[len(_CACHE_EVENT_PREFIX):].replace("/", ".")
+        _metrics.counter(f"xla.compile_cache.{leaf}").inc()
+
+
+def install() -> bool:
+    """Register the monitoring listeners once. Returns whether the
+    ``jax.monitoring`` path is live (False = wrapper fallback mode). Callers
+    have jax imported already; this never triggers the import."""
+    global _installed, _have_monitoring
+    with _lock:
+        if _installed:
+            return _have_monitoring
+        _installed = True
+    try:
+        from jax import monitoring as _monitoring
+
+        _monitoring.register_event_duration_secs_listener(_on_event_duration)
+        _monitoring.register_event_listener(_on_event)
+        _have_monitoring = True
+    except Exception:
+        _have_monitoring = False
+    return _have_monitoring
+
+
+def observed_jit(fun=None, *, label: Optional[str] = None, **jit_kwargs):
+    """Drop-in `jax.jit` replacement that attributes compiles to a program
+    label: ``observed_jit(f, static_argnums=(0,))`` or used as a decorator
+    (optionally ``@observed_jit(label="hashing.bucket_id")``). The wrapper's
+    per-call cost is one thread-local list push/pop — the compile accounting
+    itself only runs inside actual compiles, via the `install` listener."""
+    if fun is None:
+        return lambda f: observed_jit(f, label=label, **jit_kwargs)
+    import jax
+
+    monitoring_live = install()
+    lbl = label or f"{fun.__module__.rsplit('.', 1)[-1]}.{fun.__name__}"
+    jitted = jax.jit(fun, **jit_kwargs)
+    # Fallback compile detection when jax.monitoring is absent: the jitted
+    # callable's cache growing across a call means that call compiled.
+    cache_size = getattr(jitted, "_cache_size", None) if not monitoring_live else None
+
+    @functools.wraps(fun)
+    def wrapper(*args, **kwargs):
+        stack = getattr(_local, "stack", None)
+        if stack is None:
+            stack = _local.stack = []
+        stack.append(lbl)
+        if cache_size is not None:
+            import time as _time
+
+            before = cache_size()
+            t0 = _time.monotonic()
+        try:
+            return jitted(*args, **kwargs)
+        finally:
+            stack.pop()
+            if cache_size is not None and cache_size() > before:
+                dur = _time.monotonic() - t0
+                _COMPILES.inc()
+                _TRACES.inc()
+                _COMPILE_SECONDS.observe(dur)
+                p = _program(lbl)
+                with _lock:
+                    p["compiles"] += 1
+                    p["compile_s"] += dur
+                    p["traces"] += 1
+                _check_storm(lbl, p)
+
+    wrapper._hyperspace_jitted = jitted  # the underlying jit object (tests)
+    return wrapper
+
+
+def program_summary() -> dict:
+    """Per-program compile stats: {label: {compiles, compile_s, traces}},
+    labels sorted, JSON-serializable — consumed by the exporter frames and
+    ``bench_detail.compile_observatory``."""
+    with _lock:
+        return {
+            lbl: {
+                "compiles": p["compiles"],
+                "compile_s": round(p["compile_s"], 4),
+                "traces": p["traces"],
+            }
+            for lbl, p in sorted(_programs.items())
+        }
+
+
+def reset_programs() -> None:
+    """Zero the per-program stats IN PLACE (tests; the registry counters are
+    reset separately via `metrics.reset`). Labels stay registered."""
+    with _lock:
+        for p in _programs.values():
+            p.update(compiles=0, compile_s=0.0, traces=0, storm_warned=False)

@@ -1,0 +1,231 @@
+"""Continuous metrics export: periodic JSONL frames + Prometheus text dumps.
+
+`metrics.snapshot()` and the per-query ledgers are point-in-time reads that
+only bench.py and `explain(analyze=True)` ever consumed — a long-running
+serving process had no way to ship its counters anywhere. This exporter is
+the opt-in stream: a daemon thread that appends one JSON frame per interval
+to ``HYPERSPACE_METRICS_FILE`` (every ``HYPERSPACE_METRICS_INTERVAL_S``
+seconds, default 10), each frame carrying the full registry snapshot (now
+with p50/p90/p99 on every histogram), the ledgers of queries closed since
+the previous frame (`accounting.drain_pending`), the per-program compile
+observatory (`compile_log.program_summary`), and a `jax.live_arrays()`
+device-byte sample when jax is already imported.
+
+Contracts:
+
+- **Off by default, ≈zero cost.** No env var → no thread, no file, nothing
+  on any hot path. The only standing cost with the exporter ON is the
+  ledger/histogram accounting it turns on (integer adds) plus one snapshot
+  per interval.
+- **Clean shutdown.** `stop()` wakes the thread, writes one final frame
+  (``"final": true``) and joins; an `atexit` hook stops a still-running
+  exporter so a process exit never truncates mid-frame. Frames are written
+  with a single `write` + flush per frame under a lock — concurrent stop()
+  and tick never interleave lines.
+- **Self-describing frames.** Every line is one JSON object:
+  ``{"ts", "seq", "interval_s", "snapshot", "ledgers", "compile_programs",
+  "device_live_bytes"?, "final"?}`` — parse failures in a consumer mean a
+  torn file, not a schema guess (pinned by tests + the CI smoke leg).
+
+`prometheus_text()` renders the registry in Prometheus text exposition
+format on demand (counters, gauges, histograms with cumulative ``le``
+buckets + ``_sum``/``_count``) for scrape-style integration without running
+the file stream.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import math
+import os
+import sys
+import threading
+import time
+from typing import Optional
+
+from . import metrics as _metrics
+
+ENV_METRICS_FILE = "HYPERSPACE_METRICS_FILE"
+ENV_METRICS_INTERVAL = "HYPERSPACE_METRICS_INTERVAL_S"
+_DEFAULT_INTERVAL_S = 10.0
+
+_lock = threading.Lock()
+_exporter: Optional["MetricsExporter"] = None
+
+
+def _interval_from_env() -> float:
+    try:
+        v = float(os.environ.get(ENV_METRICS_INTERVAL, "") or _DEFAULT_INTERVAL_S)
+    except ValueError:
+        v = _DEFAULT_INTERVAL_S
+    return max(0.01, v)
+
+
+def _device_live_bytes() -> Optional[int]:
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        return int(sum(int(a.nbytes) for a in jax.live_arrays()))
+    except Exception:
+        return None
+
+
+class MetricsExporter:
+    """One background export stream (the module-level `start`/`stop` manage
+    the process singleton; direct construction is for tests)."""
+
+    def __init__(self, path: str, interval_s: float):
+        self.path = path
+        self.interval_s = float(interval_s)
+        self._stop = threading.Event()
+        self._write_lock = threading.Lock()
+        self._seq = 0
+        self._thread = threading.Thread(
+            target=self._run, name="hyperspace-metrics-exporter", daemon=True
+        )
+
+    def start(self) -> "MetricsExporter":
+        self._thread.start()
+        return self
+
+    @property
+    def running(self) -> bool:
+        return self._thread.is_alive()
+
+    def _frame(self, final: bool = False) -> dict:
+        from . import accounting, compile_log
+
+        out = {
+            "ts": round(time.time(), 6),
+            "seq": self._seq,
+            "interval_s": self.interval_s,
+            "snapshot": _metrics.snapshot(),
+            "ledgers": accounting.drain_pending(),
+            "compile_programs": compile_log.program_summary(),
+        }
+        dev = _device_live_bytes()
+        if dev is not None:
+            out["device_live_bytes"] = dev
+            _metrics.gauge("device.live_bytes").set(dev)
+        if final:
+            out["final"] = True
+        return out
+
+    def _write_frame(self, final: bool = False) -> None:
+        try:
+            line = json.dumps(self._frame(final), default=str)
+            with self._write_lock:
+                self._seq += 1
+                with open(self.path, "a") as f:
+                    f.write(line + "\n")
+                    f.flush()
+        except Exception:
+            pass  # telemetry must never fail the process it observes
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self._write_frame()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        """Wake the thread, join it, then append the final frame (so the last
+        line of the stream always carries the end-state snapshot)."""
+        self._stop.set()
+        self._thread.join(timeout)
+        self._write_frame(final=True)
+
+
+def running() -> bool:
+    e = _exporter
+    return e is not None and e.running
+
+
+def start(path: Optional[str] = None, interval_s: Optional[float] = None) -> bool:
+    """Start the process exporter (idempotent: a live exporter wins). `path`
+    defaults to ``HYPERSPACE_METRICS_FILE``; no path anywhere → False."""
+    global _exporter
+    with _lock:
+        if _exporter is not None and _exporter.running:
+            return True
+        path = path or os.environ.get(ENV_METRICS_FILE)
+        if not path:
+            return False
+        if interval_s is None:
+            interval_s = _interval_from_env()
+        try:
+            _exporter = MetricsExporter(path, interval_s).start()
+        except Exception:
+            _exporter = None
+            return False
+        return True
+
+
+def stop(timeout: float = 5.0) -> None:
+    """Stop the process exporter and write its final frame (no-op without
+    one). Safe to call repeatedly and from `atexit`."""
+    global _exporter
+    with _lock:
+        e = _exporter
+        _exporter = None
+    if e is not None and e.running:
+        e.stop(timeout)
+
+
+def maybe_start_from_env() -> bool:
+    """The import-time hook (`telemetry/__init__`): start the stream iff
+    ``HYPERSPACE_METRICS_FILE`` is set — the single opt-in switch."""
+    if not os.environ.get(ENV_METRICS_FILE):
+        return False
+    return start()
+
+
+atexit.register(stop)
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition (on demand; no server, no thread)
+# ---------------------------------------------------------------------------
+
+
+def _prom_name(name: str) -> str:
+    out = "".join(c if (c.isalnum() or c == "_") else "_" for c in name)
+    return out if not out[:1].isdigit() else "_" + out
+
+
+def _prom_num(v) -> str:
+    if isinstance(v, float) and math.isinf(v):
+        return "+Inf"
+    return repr(float(v)) if isinstance(v, float) else str(v)
+
+
+def prometheus_text(prefix: str = "hyperspace") -> str:
+    """The registry in Prometheus text exposition format: counters as
+    `counter`, gauges as `gauge`, histograms as `histogram` with the
+    log-spaced cumulative buckets (`Histogram.bucket_counts`), `_sum` and
+    `_count`."""
+    reg = _metrics.global_registry()
+    with reg._lock:
+        counters = list(reg._counters.values())
+        gauges = list(reg._gauges.values())
+        hists = list(reg._histograms.values())
+    lines = []
+    for c in counters:
+        n = f"{prefix}_{_prom_name(c.name)}"
+        lines.append(f"# TYPE {n} counter")
+        lines.append(f"{n} {c.value}")
+    for g in gauges:
+        n = f"{prefix}_{_prom_name(g.name)}"
+        lines.append(f"# TYPE {n} gauge")
+        lines.append(f"{n} {_prom_num(g.value)}")
+    for h in hists:
+        n = f"{prefix}_{_prom_name(h.name)}"
+        # One lock hold per histogram: _count must equal the +Inf bucket even
+        # under concurrent observes (Prometheus consistency requirement).
+        count, total, buckets = h.export_state()
+        lines.append(f"# TYPE {n} histogram")
+        for le, cum in buckets:
+            lines.append(f'{n}_bucket{{le="{_prom_num(le)}"}} {cum}')
+        lines.append(f"{n}_sum {_prom_num(round(total, 6))}")
+        lines.append(f"{n}_count {count}")
+    return "\n".join(lines) + "\n"

@@ -22,8 +22,10 @@ Contracts:
 
 from __future__ import annotations
 
+import bisect
+import math
 import threading
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
 
 
 class Counter:
@@ -51,7 +53,9 @@ class Counter:
 
 
 class Gauge:
-    """Last-write-wins scalar (e.g. bytes currently pinned)."""
+    """Last-write-wins scalar (e.g. bytes currently pinned). `add` supports
+    up/down accounting (e.g. the decode pool's in-flight depth), which `set`
+    alone cannot do race-free from concurrent workers."""
 
     __slots__ = ("name", "_lock", "_value")
 
@@ -64,6 +68,22 @@ class Gauge:
         with self._lock:
             self._value = v
 
+    def add(self, n) -> None:
+        with self._lock:
+            self._value += n
+
+    def inc(self, n=1) -> None:
+        self.add(n)
+
+    def dec(self, n=1) -> None:
+        self.add(-n)
+
+    def set_max(self, v) -> None:
+        """High-water mark: keep the larger of the current and new value."""
+        with self._lock:
+            if v > self._value:
+                self._value = v
+
     def reset(self) -> None:
         with self._lock:
             self._value = 0.0
@@ -74,11 +94,24 @@ class Gauge:
             return self._value
 
 
-class Histogram:
-    """Summary histogram: count / total / min / max (no buckets — the
-    consumers want aggregate decode/gather costs, not latency curves)."""
+#: Shared log-spaced bucket upper bounds: 4 per decade over 1e-6 … 1e10
+#: (microseconds → device-byte counts), Prometheus-style cumulative-`le`
+#: semantics, ONE fixed 66-slot array per histogram regardless of observation
+#: count. Quantile error is bounded by the bucket width (≤ 10^0.25 ≈ 1.78×
+#: relative), which is what a latency p99 needs — the exact extremes still
+#: ride `min`/`max`.
+BUCKET_BOUNDS: Tuple[float, ...] = tuple(10.0 ** (k / 4.0) for k in range(-24, 41))
+_N_BUCKETS = len(BUCKET_BOUNDS) + 1  # + overflow (+Inf)
 
-    __slots__ = ("name", "_lock", "count", "total", "min", "max")
+
+class Histogram:
+    """Quantile histogram: count / total / min / max PLUS bounded log-spaced
+    buckets (`BUCKET_BOUNDS`), so `summary()` carries p50/p90/p99. The four
+    summary fields keep their exact pre-bucket semantics — every existing
+    `bench_detail` consumer reads them unchanged; the quantile keys are
+    additive. Fixed memory per metric name, lock-guarded like the counters."""
+
+    __slots__ = ("name", "_lock", "count", "total", "min", "max", "_buckets")
 
     def __init__(self, name: str):
         self.name = name
@@ -87,14 +120,19 @@ class Histogram:
         self.total = 0.0
         self.min: Optional[float] = None
         self.max: Optional[float] = None
+        self._buckets = [0] * _N_BUCKETS
 
     def observe(self, v) -> None:
         v = float(v)
+        # Non-positive observations (0.0 durations exist) land in the first
+        # bucket; bisect_left puts an exact boundary value in its own bucket.
+        idx = bisect.bisect_left(BUCKET_BOUNDS, v) if v > 0.0 else 0
         with self._lock:
             self.count += 1
             self.total += v
             self.min = v if self.min is None or v < self.min else self.min
             self.max = v if self.max is None or v > self.max else self.max
+            self._buckets[idx] += 1
 
     def reset(self) -> None:
         with self._lock:
@@ -102,15 +140,72 @@ class Histogram:
             self.total = 0.0
             self.min = None
             self.max = None
+            self._buckets = [0] * _N_BUCKETS
+
+    def _quantile_locked(self, q: float) -> Optional[float]:
+        if self.count == 0:
+            return None
+        rank = max(1, math.ceil(q * self.count))
+        cum = 0
+        for i, n in enumerate(self._buckets):
+            if n == 0:
+                continue
+            if cum + n >= rank:
+                lo = BUCKET_BOUNDS[i - 1] if i > 0 else 0.0
+                hi = (
+                    BUCKET_BOUNDS[i]
+                    if i < len(BUCKET_BOUNDS)
+                    else (self.max if self.max is not None else lo)
+                )
+                est = lo + (hi - lo) * ((rank - cum) / n)
+                # Clamp to the observed range: an estimate can never claim a
+                # latency outside what was actually seen.
+                return min(max(est, self.min), self.max)
+            cum += n
+        return self.max
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Estimated q-quantile (0 < q ≤ 1) from the log buckets, clamped to
+        the observed [min, max]. None before any observation."""
+        with self._lock:
+            return self._quantile_locked(q)
+
+    def bucket_counts(self) -> List[Tuple[float, int]]:
+        """Cumulative (upper_bound, count≤bound) pairs for the non-empty
+        bucket range plus the +Inf total — the Prometheus text-exposition
+        shape (`exporter.prometheus_text`). Empty list before any
+        observation (no 66-pair noise for untouched metrics)."""
+        return self.export_state()[2]
 
     def summary(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "count": self.count,
                 "total": round(self.total, 6),
                 "min": self.min,
                 "max": self.max,
             }
+            if self.count:
+                for key, q in (("p50", 0.5), ("p90", 0.9), ("p99", 0.99)):
+                    v = self._quantile_locked(q)
+                    out[key] = None if v is None else round(v, 6)
+            return out
+
+    def export_state(self) -> Tuple[int, float, List[Tuple[float, int]]]:
+        """(count, total, cumulative buckets) read under ONE lock hold — the
+        Prometheus exposition needs `_count` to equal the +Inf bucket, which
+        separate `summary()`/`bucket_counts()` reads cannot guarantee under
+        concurrent observes."""
+        with self._lock:
+            out: List[Tuple[float, int]] = []
+            if self.count:
+                cum = 0
+                for i, n in enumerate(self._buckets):
+                    cum += n
+                    if n and i < len(BUCKET_BOUNDS):
+                        out.append((BUCKET_BOUNDS[i], cum))
+                out.append((math.inf, self.count))
+            return self.count, self.total, out
 
 
 class MetricsRegistry:

@@ -112,6 +112,23 @@ def _fallback_delta(before: dict, after: dict) -> dict:
     return out
 
 
+def _observe_stage_histograms(kind: str, summary: dict) -> None:
+    """Feed one stage summary into the registry's quantile histograms:
+    ``latency.<kind>.wall`` for the operation wall clock and
+    ``latency.stage.<kind>.<stage>`` per stage's busy seconds — so
+    `metrics.snapshot()` carries p50/p90/p99 latency DISTRIBUTIONS across
+    operations, not just each operation's last summary. Always on, like the
+    counters: a handful of locked observes per operation."""
+    from . import metrics as _metrics
+
+    wall = summary.get("wall_s")
+    if isinstance(wall, (int, float)):
+        _metrics.histogram(f"latency.{kind}.wall").observe(wall)
+    for key, val in summary.items():
+        if key.endswith("_s") and key != "wall_s" and isinstance(val, (int, float)):
+            _metrics.histogram(f"latency.stage.{kind}.{key[:-2]}").observe(val)
+
+
 def record_build_stages(summary: dict) -> None:
     """Record one build's stage summary. Summaries come from `StageTimings.
     summary()`, which attaches the operation-scoped `pallas_fallbacks` DELTA
@@ -121,6 +138,7 @@ def record_build_stages(summary: dict) -> None:
     d = dict(summary)
     with _build_stages_lock:
         _BUILD_STAGES.append(d)
+    _observe_stage_histograms("build", d)
     tracing.record_stage_spans("build", d)
 
 
@@ -145,6 +163,7 @@ def record_query_stages(summary: dict) -> None:
     d = dict(summary)
     with _build_stages_lock:
         _QUERY_STAGES.append(d)
+    _observe_stage_histograms("query", d)
     tracing.record_stage_spans("query", d)
 
 
@@ -169,6 +188,7 @@ def record_join_stages(summary: dict) -> None:
     d = dict(summary)
     with _build_stages_lock:
         _JOIN_STAGES.append(d)
+    _observe_stage_histograms("join", d)
     tracing.record_stage_spans("join", d)
 
 

@@ -55,7 +55,9 @@ ENV_TRACE_FILE = "HYPERSPACE_TRACE_FILE"
 ENV_TRACING = "HYPERSPACE_TRACING"
 
 #: Spans per trace hard cap (a traced query touching thousands of operators
-#: keeps the tree, further spans are dropped and counted on the root).
+#: keeps the tree; further spans are dropped, counted per trace, and surfaced
+#: at finalize as the root's `spans_dropped` attr + the
+#: `trace.spans.dropped` counter — no silent cap).
 MAX_SPANS_PER_TRACE = 4096
 
 _RECENT: "deque[QueryTrace]" = deque(maxlen=16)
@@ -157,6 +159,12 @@ class Span:
         with self._lock:
             self.attrs.setdefault(key, []).append(value)
 
+    def inc_attr(self, key: str, delta) -> None:
+        """Accumulate a numeric attribute atomically (compile-observatory
+        deltas: several compiles may land on one operator span)."""
+        with self._lock:
+            self.attrs[key] = self.attrs.get(key, 0) + delta
+
     def end(self, status: Optional[str] = None, error: Optional[BaseException] = None) -> None:
         # Locked end-to-end: the exporter's end(status="unclosed") on a
         # worker span that outlived the root must not interleave with the
@@ -210,6 +218,9 @@ class _NoopSpan:
         pass
 
     def append_attr(self, key, value):
+        pass
+
+    def inc_attr(self, key, delta):
         pass
 
     def end(self, status=None, error=None):
@@ -282,9 +293,21 @@ def query_span(name: str, **attrs) -> Iterator:
     Nested under an already-active span (e.g. a scalar subquery's inner
     collect inside the outer query) it degrades to a plain child span — ONE
     query_id per outermost action. When no sink is active it yields the
-    shared no-op span."""
+    shared no-op span.
+
+    The per-query resource ledger (`telemetry.accounting`) shares this exact
+    boundary: a root span carries a ledger; with spans off but accounting on
+    (the continuous exporter, or ``HYPERSPACE_ACCOUNTING=1``) a ledger-only
+    scope opens around the no-op span, so resource attribution and latency
+    histograms survive without paying for span trees."""
+    from . import accounting as _accounting
+
     if not active():
-        yield NOOP_SPAN
+        if not _accounting.enabled():
+            yield NOOP_SPAN
+            return
+        with _accounting.ledger_scope(new_query_id(), name):
+            yield NOOP_SPAN
         return
     parent = _current_span.get()
     if parent is not None:
@@ -295,6 +318,8 @@ def query_span(name: str, **attrs) -> Iterator:
     root = Span(trace, name, None, attrs)
     token = _current_span.set(root)
     ann = _annotation(name)
+    led = _accounting.ledger_scope(trace.query_id, name, root=root)
+    led.__enter__()
     try:
         yield root
         root.end()
@@ -302,6 +327,13 @@ def query_span(name: str, **attrs) -> Iterator:
         root.end(error=e)
         raise
     finally:
+        # Ledger closes AFTER root.end (it reads the root's duration and
+        # writes the `ledger` attr) and BEFORE _finalize (the JSONL export
+        # must carry the closed ledger).
+        try:
+            led.__exit__(None, None, None)
+        except Exception:
+            pass
         if ann is not None:
             try:
                 ann.__exit__(None, None, None)
@@ -415,6 +447,13 @@ def _finalize(trace: QueryTrace) -> None:
     """Root ended: bank the trace, hand it to a same-context capture, and
     export JSONL when the env sink is set. Export failures are swallowed —
     telemetry must never fail the query it observed."""
+    if trace.dropped:
+        # No silent caps: the span-cap overflow rides the root (JSONL +
+        # explain consumers see it) and the process-wide counter.
+        trace.root.set_attr("spans_dropped", trace.dropped)
+        from . import metrics as _metrics
+
+        _metrics.counter("trace.spans.dropped").inc(trace.dropped)
     with _recent_lock:
         _RECENT.append(trace)
     cap = _capture.get()

@@ -1,0 +1,486 @@
+"""Resource ledger, quantile histograms, compile observatory, exporter.
+
+Pins the continuous-accounting contracts on top of the PR-4 tracing layer:
+- `Histogram` gains bounded log-spaced buckets: p50/p90/p99 in `summary()`
+  within bucket tolerance, exact under a concurrent observe hammer, with the
+  legacy count/total/min/max fields byte-compatible.
+- The per-query ledger attributes bytes decoded/skipped (reconciling with
+  the `io.pruning.*` counters), decode-pool work, rows, and cache charges to
+  the right query_id — including across two INTERLEAVED queries on separate
+  threads, and through the decode pool's worker threads.
+- The compile observatory counts XLA compiles per program label and ticks
+  `xla.compiles.*` on a forced recompile.
+- The exporter appends parseable JSONL frames, drains ledgers, shuts down
+  cleanly (final frame, dead thread), and never changes query results.
+- Span-cap drops are surfaced (`spans_dropped` root attr + counter) and the
+  decode pool's in-flight gauge returns to zero with a recorded peak.
+- `tools/bench_compare.py` reports deltas and gates on regressions.
+"""
+
+import json
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu.engine import HyperspaceSession, col
+from hyperspace_tpu.telemetry import (
+    accounting,
+    compile_log,
+    exporter,
+    metrics,
+    tracing,
+)
+
+
+@pytest.fixture()
+def session(tmp_path):
+    return HyperspaceSession(warehouse=str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# Histogram quantiles
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_summary_keeps_legacy_fields_and_adds_quantiles():
+    h = metrics.Histogram("t")
+    for v in (0.001, 0.01, 0.1, 1.0):
+        h.observe(v)
+    s = h.summary()
+    # The pre-bucket consumers' fields, unchanged semantics.
+    assert s["count"] == 4
+    assert s["total"] == pytest.approx(1.111, abs=1e-6)
+    assert s["min"] == 0.001 and s["max"] == 1.0
+    # Additive quantile keys, clamped to the observed range.
+    for k in ("p50", "p90", "p99"):
+        assert s["min"] <= s[k] <= s["max"]
+    assert json.dumps(s)
+
+
+def test_histogram_quantiles_within_bucket_tolerance():
+    h = metrics.Histogram("t")
+    rng = np.random.RandomState(11)
+    vals = rng.uniform(0.001, 1.0, 20000)
+    for v in vals:
+        h.observe(v)
+    # Log buckets are 10^0.25 ≈ 1.78x wide: estimates must land within one
+    # bucket of the true quantile (generous 2x band both ways).
+    for q in (0.5, 0.9, 0.99):
+        true = float(np.quantile(vals, q))
+        est = h.quantile(q)
+        assert true / 2 <= est <= true * 2, (q, est, true)
+    # Degenerate cases.
+    empty = metrics.Histogram("e")
+    assert empty.quantile(0.5) is None and "p50" not in empty.summary()
+    assert empty.bucket_counts() == []
+    one = metrics.Histogram("o")
+    one.observe(0.25)
+    assert one.quantile(0.5) == 0.25  # clamped to the single observation
+    cum = one.bucket_counts()
+    assert cum[-1] == (float("inf"), 1)
+
+
+def test_histogram_concurrent_observe_loses_nothing():
+    h = metrics.histogram("test.obs.hammer")
+    h.reset()
+    n_threads, n_obs = 16, 500
+    # Each thread observes a distinct value so bucket totals are checkable.
+    vals = [0.001 * (i + 1) for i in range(n_threads)]
+
+    def work(i):
+        for _ in range(n_obs):
+            h.observe(vals[i])
+
+    with ThreadPoolExecutor(max_workers=n_threads) as pool:
+        list(pool.map(work, range(n_threads)))
+    s = h.summary()
+    assert s["count"] == n_threads * n_obs
+    assert s["min"] == vals[0] and s["max"] == vals[-1]
+    assert s["total"] == pytest.approx(sum(vals) * n_obs, rel=1e-9)
+    # Bucket mass equals the observation count (no torn increments).
+    assert h.bucket_counts()[-1][1] == n_threads * n_obs
+
+
+def test_gauge_add_and_high_water_mark():
+    g = metrics.Gauge("t")
+    g.inc(3)
+    g.dec()
+    assert g.value == 2
+    g.set_max(10)
+    g.set_max(5)
+    assert g.value == 10
+
+
+# ---------------------------------------------------------------------------
+# Per-query resource ledger
+# ---------------------------------------------------------------------------
+
+
+def _write_sorted_table(session, path, n=4000, offset=0):
+    """A key-sorted 4-row-group file: an equality filter on `k` prunes 3 of 4
+    groups, so pruned decodes (→ ledger bytes_decoded) actually happen."""
+    session.write_parquet(
+        {
+            "k": (np.arange(n, dtype=np.int64) + offset),
+            "v": np.arange(n, dtype=np.int64),
+        },
+        path,
+        row_group_rows=n // 4,
+    )
+
+
+def test_ledger_attributes_decodes_and_reconciles_bytes(session, tmp_path, monkeypatch):
+    monkeypatch.setenv(accounting.ENV_ACCOUNTING, "1")
+    path = os.path.join(str(tmp_path), "t")
+    _write_sorted_table(session, path)
+    before = metrics.counter("io.pruning.bytes_decoded").value
+    df = session.read.parquet(path).filter(col("k") == 7)
+    out = df.collect()
+    assert out.num_rows == 1
+    after = metrics.counter("io.pruning.bytes_decoded").value
+    led = accounting.recent_ledgers()[-1]
+    d = led.to_dict()
+    assert d["name"] == "query:collect"
+    assert d["rows_produced"] == 1
+    assert d["decode_files"] >= 1 and d["decode_task_s"] > 0
+    # Reconciliation: the ledger's bytes_decoded IS the counter's move.
+    assert d["bytes_decoded"] == after - before > 0
+    assert d["bytes_skipped"] > 0
+    assert d["wall_s"] > 0
+
+
+def test_ledger_interleaved_queries_attribute_separately(session, tmp_path, monkeypatch):
+    """Two queries running concurrently on separate threads each get their
+    own ledger; decode work crosses the pool but lands on the right query."""
+    monkeypatch.setenv(accounting.ENV_ACCOUNTING, "1")
+    paths = []
+    for i, n_files in enumerate((3, 5)):
+        root = os.path.join(str(tmp_path), f"t{i}")
+        from hyperspace_tpu.engine import io as engine_io
+        from hyperspace_tpu.engine.table import Table
+
+        for j in range(n_files):
+            engine_io.write_parquet(
+                Table.from_pydict(
+                    {"k": np.arange(500, dtype=np.int64) + 1000 * i + j}
+                ),
+                os.path.join(root, f"part-{j:05d}.parquet"),
+            )
+        paths.append(root)
+
+    barrier = threading.Barrier(2)
+    results = {}
+
+    def run(i):
+        s = HyperspaceSession(warehouse=str(tmp_path))
+        df = s.read.parquet(paths[i])
+        barrier.wait()
+        out = df.collect()
+        results[i] = out.num_rows
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert results == {0: 1500, 1: 2500}
+    by_rows = {}
+    for led in accounting.recent_ledgers():
+        d = led.to_dict()
+        if d["name"] == "query:collect" and d.get("rows_produced") in (1500, 2500):
+            by_rows[d["rows_produced"]] = d
+    assert set(by_rows) == {1500, 2500}
+    # Decode-pool attribution: each query's cold files landed on ITS ledger
+    # (workers adopt the submitter's ledger), not pooled into one.
+    assert by_rows[1500]["decode_files"] == 3
+    assert by_rows[2500]["decode_files"] == 5
+    assert by_rows[1500]["query_id"] != by_rows[2500]["query_id"]
+
+
+def test_ledger_rides_root_span_and_explain_analyze(session, tmp_path):
+    path = os.path.join(str(tmp_path), "t")
+    _write_sorted_table(session, path)
+    df = session.read.parquet(path).filter(col("k") < 100)
+    with tracing.capture() as cap:
+        df.collect()
+    root = cap.trace.root
+    led = root.attrs.get("ledger")
+    assert led is not None and led["query_id"] == cap.trace.query_id
+    assert led["rows_produced"] == 100
+    # explain(analyze=True) renders the ledger section for ITS query.
+    s = df.explain(analyze=True)
+    assert "Resource ledger (this query):" in s
+    assert "rows_produced: 100" in s
+
+
+def test_nested_collect_reports_root_rows_only(session, tmp_path, monkeypatch):
+    """rows_produced is a ROOT fact: a collect nested inside an outer query
+    scope shares the outer LEDGER (one ledger per outermost action), and the
+    outer action's own row count wins — never an inner+outer sum."""
+    monkeypatch.setenv(accounting.ENV_ACCOUNTING, "1")
+    path = os.path.join(str(tmp_path), "t")
+    session.write_parquet({"k": np.arange(500, dtype=np.int64)}, path)
+    df = session.read.parquet(path)
+    with tracing.capture():
+        with tracing.query_span("query:outer"):
+            inner = df.collect()  # nested: writes 500 to the SHARED ledger
+            assert inner.num_rows == 500
+            # The outer action's root fact lands last (what collect() does).
+            accounting.set_value("rows_produced", 7)
+    led = accounting.recent_ledgers()[-1].to_dict()
+    assert led["name"] == "query:outer"
+    assert led["rows_produced"] == 7  # last root write wins, no 500+7 sum
+    # The inner collect's decode work still charges the one shared ledger.
+    assert led["decode_files"] >= 1
+
+
+def test_no_ledger_when_everything_off(session, tmp_path, monkeypatch):
+    monkeypatch.delenv(accounting.ENV_ACCOUNTING, raising=False)
+    monkeypatch.delenv(tracing.ENV_TRACE_FILE, raising=False)
+    monkeypatch.delenv(tracing.ENV_TRACING, raising=False)
+    path = os.path.join(str(tmp_path), "t")
+    session.write_parquet({"k": np.arange(10, dtype=np.int64)}, path)
+    before = len(accounting.recent_ledgers())
+    session.read.parquet(path).collect()
+    assert len(accounting.recent_ledgers()) == before  # zero-cost off contract
+
+
+# ---------------------------------------------------------------------------
+# Compile observatory
+# ---------------------------------------------------------------------------
+
+
+def test_forced_recompile_ticks_compile_counters():
+    from hyperspace_tpu.ops import hashing
+
+    c0 = metrics.counter("xla.compiles.count").value
+    t0 = metrics.counter("xla.compiles.traces").value
+    p0 = compile_log.program_summary().get("hashing.key64", {"compiles": 0})
+    import jax.numpy as jnp
+
+    # Two never-before-seen prime lengths through the fused key64 program:
+    # each is a fresh shape signature → at least one fresh backend compile.
+    from hyperspace_tpu.engine.table import Column
+
+    for n in (1231, 2459):
+        col_ = Column.from_values(np.arange(n, dtype=np.int64))
+        hashing.key64([col_], [jnp.asarray(col_.data)])
+    assert metrics.counter("xla.compiles.count").value > c0
+    assert metrics.counter("xla.compiles.traces").value > t0
+    p1 = compile_log.program_summary()["hashing.key64"]
+    assert p1["compiles"] > p0["compiles"]
+    assert p1["compile_s"] > 0
+
+
+def test_compile_storm_warns_once_per_label(monkeypatch):
+    monkeypatch.setenv(compile_log.ENV_STORM_THRESHOLD, "3")
+    label = "test.storm_program"
+    s0 = metrics.counter("xla.compiles.storm_warnings").value
+    p = compile_log._program(label)
+    p.update(compiles=0, compile_s=0.0, traces=0, storm_warned=False)
+    with pytest.warns(RuntimeWarning, match="compile storm.*storm_program"):
+        for _ in range(4):
+            with compile_log._lock:
+                p["traces"] += 1
+            compile_log._check_storm(label, p)
+    assert metrics.counter("xla.compiles.storm_warnings").value == s0 + 1
+    # Already-warned: more traces never warn again.
+    with compile_log._lock:
+        p["traces"] += 10
+    compile_log._check_storm(label, p)
+    assert metrics.counter("xla.compiles.storm_warnings").value == s0 + 1
+
+
+def test_compile_delta_lands_on_ambient_span():
+    import jax.numpy as jnp
+
+    f = compile_log.observed_jit(lambda x: x * 3 + 1, label="test.span_delta")
+    with tracing.capture() as cap:
+        with tracing.query_span("query:compile_span"):
+            with tracing.span("op:Test") as sp:
+                f(jnp.ones((641,)))  # fresh prime shape → compiles here
+    spans = cap.trace.find("op:Test")
+    assert spans and spans[0].attrs.get("xla_compiles", 0) >= 1
+    assert spans[0].attrs.get("xla_compile_s", 0) > 0
+
+
+# ---------------------------------------------------------------------------
+# Exporter
+# ---------------------------------------------------------------------------
+
+
+def test_exporter_frames_schema_and_clean_shutdown(session, tmp_path, monkeypatch):
+    path = os.path.join(str(tmp_path), "metrics.jsonl")
+    ex = exporter.MetricsExporter(path, interval_s=0.05).start()
+    try:
+        monkeypatch.setenv(accounting.ENV_ACCOUNTING, "1")
+        t = os.path.join(str(tmp_path), "t")
+        _write_sorted_table(session, t)
+        session.read.parquet(t).filter(col("k") == 3).collect()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not os.path.exists(path):
+            time.sleep(0.02)
+    finally:
+        ex.stop()
+    assert not ex.running  # clean shutdown: thread joined
+    frames = [json.loads(line) for line in open(path)]
+    assert len(frames) >= 2
+    for fr in frames:
+        assert {"ts", "seq", "interval_s", "snapshot"} <= set(fr)
+        assert "counters" in fr["snapshot"]
+        assert isinstance(fr["ledgers"], list)
+        assert isinstance(fr["compile_programs"], dict)
+    assert frames[-1]["final"] is True
+    seqs = [fr["seq"] for fr in frames]
+    assert seqs == sorted(seqs)
+    # The query's ledger rode a frame, with its decode work attributed.
+    ledgers = [l for fr in frames for l in fr["ledgers"]]
+    mine = [l for l in ledgers if l.get("rows_produced") == 1]
+    assert mine and mine[0]["bytes_decoded"] > 0
+    # Quantile histograms in the snapshot stream.
+    hists = frames[-1]["snapshot"]["histograms"]
+    lat = [k for k in hists if k.startswith("latency.query.")]
+    assert lat and hists[lat[0]]["p50"] is not None
+    assert hists[lat[0]]["p99"] is not None
+
+
+def test_exporter_env_start_stop_roundtrip(tmp_path, monkeypatch):
+    path = os.path.join(str(tmp_path), "m.jsonl")
+    monkeypatch.setenv(exporter.ENV_METRICS_FILE, path)
+    monkeypatch.setenv(exporter.ENV_METRICS_INTERVAL, "0.05")
+    assert exporter.start() is True
+    assert exporter.running()
+    assert exporter.start() is True  # idempotent on a live exporter
+    exporter.stop()
+    assert not exporter.running()
+    exporter.stop()  # repeat-safe
+    frames = [json.loads(line) for line in open(path)]
+    assert frames and frames[-1]["final"] is True
+
+
+def test_traced_rows_identical_with_exporter_running(session, tmp_path, monkeypatch):
+    path = os.path.join(str(tmp_path), "t")
+    _write_sorted_table(session, path)
+    df = session.read.parquet(path).filter(col("k") < 50)
+    plain = sorted(map(tuple, df.collect().rows()))
+    ex = exporter.MetricsExporter(
+        os.path.join(str(tmp_path), "m.jsonl"), interval_s=0.05
+    ).start()
+    try:
+        monkeypatch.setenv(tracing.ENV_TRACE_FILE, os.path.join(str(tmp_path), "tr.jsonl"))
+        observed = sorted(map(tuple, df.collect().rows()))
+    finally:
+        ex.stop()
+    assert observed == plain
+
+
+def test_prometheus_text_renders_counters_and_histograms():
+    metrics.counter("test.prom.hits").inc(4)
+    h = metrics.histogram("test.prom.lat")
+    h.observe(0.02)
+    text = exporter.prometheus_text()
+    assert "# TYPE hyperspace_test_prom_hits counter" in text
+    assert "hyperspace_test_prom_hits 4" in text
+    assert "# TYPE hyperspace_test_prom_lat histogram" in text
+    assert 'hyperspace_test_prom_lat_bucket{le="+Inf"}' in text
+    assert "hyperspace_test_prom_lat_count 1" in text
+
+
+# ---------------------------------------------------------------------------
+# Satellites: span-cap drops, decode in-flight gauge
+# ---------------------------------------------------------------------------
+
+
+def test_span_cap_drops_surface_on_root_and_counter(monkeypatch):
+    monkeypatch.setattr(tracing, "MAX_SPANS_PER_TRACE", 8)
+    before = metrics.counter("trace.spans.dropped").value
+    with tracing.capture() as cap:
+        with tracing.query_span("query:overflow") as root:
+            for i in range(20):
+                with tracing.span(f"w{i}", parent=root):
+                    pass
+    trace = cap.trace
+    assert trace.dropped > 0
+    assert trace.root.attrs["spans_dropped"] == trace.dropped
+    assert metrics.counter("trace.spans.dropped").value == before + trace.dropped
+
+
+def test_decode_in_flight_gauge_returns_to_zero(session, tmp_path, monkeypatch):
+    from hyperspace_tpu.engine import io as engine_io
+    from hyperspace_tpu.engine.table import Table
+
+    monkeypatch.setenv(engine_io.ENV_DECODE_THREADS, "4")
+    root = os.path.join(str(tmp_path), "multi")
+    for j in range(6):
+        engine_io.write_parquet(
+            Table.from_pydict({"k": np.arange(200, dtype=np.int64) + j}),
+            os.path.join(root, f"part-{j:05d}.parquet"),
+        )
+    peak0 = metrics.gauge("io.decode.in_flight_peak").value
+    metrics.gauge("io.decode.in_flight_peak").set(0)
+    session.read.parquet(root).collect()
+    assert metrics.gauge("io.decode.in_flight").value == 0
+    assert metrics.gauge("io.decode.in_flight_peak").value >= 1
+    metrics.gauge("io.decode.in_flight_peak").set_max(peak0)
+
+
+# ---------------------------------------------------------------------------
+# tools/bench_compare.py
+# ---------------------------------------------------------------------------
+
+
+def _bench_compare():
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(__file__), "..", "tools", "bench_compare.py")
+    if not os.path.exists(path):
+        # The wheel CI job runs the tests copied OUT of the source tree;
+        # tools/ ships with the repo, not the package.
+        pytest.skip("tools/bench_compare.py not present (installed-wheel run)")
+    spec = importlib.util.spec_from_file_location("bench_compare", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_compare_passes_on_improvement(tmp_path, capsys):
+    bc = _bench_compare()
+    a = os.path.join(str(tmp_path), "a.json")
+    b = os.path.join(str(tmp_path), "b.json")
+    json.dump({"bench_detail": {"build_s": 2.0, "rows": 100}}, open(a, "w"))
+    json.dump({"bench_detail": {"build_s": 1.0, "rows": 100}}, open(b, "w"))
+    assert bc.main([a, b]) == 0
+    out = capsys.readouterr().out
+    assert "build_s: 2 -> 1" in out
+
+
+def test_bench_compare_fails_past_threshold(tmp_path, capsys):
+    bc = _bench_compare()
+    a = os.path.join(str(tmp_path), "a.json")
+    b = os.path.join(str(tmp_path), "b.json")
+    json.dump({"q_p50_s": 1.0, "other_count": 5}, open(a, "w"))
+    json.dump({"q_p50_s": 1.5, "other_count": 50}, open(b, "w"))
+    # 50% slower: fails at 25%, passes at 60%; the counter never gates.
+    assert bc.main([a, b, "--threshold", "0.25"]) == 1
+    assert "REGRESSION" in capsys.readouterr().out
+    assert bc.main([a, b, "--threshold", "0.6"]) == 0
+    # Noise floor: the same ratio under min-seconds never gates.
+    json.dump({"q_p50_s": 0.001}, open(a, "w"))
+    json.dump({"q_p50_s": 0.002}, open(b, "w"))
+    assert bc.main([a, b, "--threshold", "0.25"]) == 0
+    # Key filter restricts gating.
+    json.dump({"q_p50_s": 1.0, "build_s": 1.0}, open(a, "w"))
+    json.dump({"q_p50_s": 2.0, "build_s": 1.0}, open(b, "w"))
+    assert bc.main([a, b, "--keys", "build*"]) == 0
+    assert bc.main([a, b, "--keys", "q_*"]) == 1
+
+
+def test_bench_compare_unreadable_input(tmp_path):
+    bc = _bench_compare()
+    a = os.path.join(str(tmp_path), "a.json")
+    json.dump({"x_s": 1.0}, open(a, "w"))
+    assert bc.main([a, os.path.join(str(tmp_path), "missing.json")]) == 2

@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""Compare two bench JSON artifacts and gate on timing regressions.
+
+The BENCH_r01…r05 trajectory has been eyeballed PR over PR; this tool makes
+the comparison mechanical: flatten both files to dotted numeric leaves,
+report per-metric deltas, and exit non-zero when any TIMING metric regressed
+past a configurable threshold.
+
+Usage:
+    python tools/bench_compare.py BASELINE.json CANDIDATE.json \
+        [--threshold 0.25] [--min-seconds 0.01] [--keys glob ...] [--all]
+
+Semantics:
+- A metric is a TIMING (lower is better) when its dotted key's leaf ends in
+  ``_s`` or ``_seconds`` (``build_s``, ``indexed_cold_s``,
+  ``agg_stream_warm_p50_s``, …). Only timings gate the exit code; counters
+  and byte totals are reported informationally (with ``--all``).
+- Regression = ``candidate > baseline * (1 + threshold)`` AND both values ≥
+  ``--min-seconds`` (sub-noise timings never gate — a 2 ms blip is machine
+  jitter, not a regression).
+- ``--keys`` restricts gating to metrics whose dotted key matches any of the
+  given ``fnmatch`` globs (reporting still covers everything shown).
+- Bench files wrap their payload as ``{"bench_detail": {...}}`` (the
+  driver's tail-parse contract); bare dicts work too.
+
+Exit codes: 0 = no gated regressions, 1 = regressions found, 2 = usage or
+unreadable/unparseable input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import fnmatch
+import json
+import sys
+from typing import Dict, List, Tuple
+
+
+def flatten(obj, prefix: str = "") -> Dict[str, float]:
+    """Dotted-key map of every numeric leaf (bools excluded; lists index by
+    position). Non-numeric leaves are dropped — the comparison is about
+    measurements, not labels."""
+    out: Dict[str, float] = {}
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            out.update(flatten(v, f"{prefix}{k}."))
+    elif isinstance(obj, (list, tuple)):
+        for i, v in enumerate(obj):
+            out.update(flatten(v, f"{prefix}{i}."))
+    elif isinstance(obj, bool):
+        pass
+    elif isinstance(obj, (int, float)):
+        out[prefix[:-1]] = float(obj)
+    return out
+
+
+def load_bench(path: str) -> Dict[str, float]:
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, dict) and "bench_detail" in data:
+        data = data["bench_detail"]
+    return flatten(data)
+
+
+def is_timing(key: str) -> bool:
+    leaf = key.rsplit(".", 1)[-1]
+    return leaf.endswith("_s") or leaf.endswith("_seconds")
+
+
+def compare(
+    base: Dict[str, float],
+    cand: Dict[str, float],
+    threshold: float,
+    min_seconds: float,
+    key_globs: List[str],
+) -> Tuple[List[tuple], List[tuple]]:
+    """(rows, regressions): rows = (key, base, cand, delta, ratio, flag) for
+    every shared key; regressions = the gated subset."""
+    rows, regressions = [], []
+    for key in sorted(set(base) & set(cand)):
+        b, c = base[key], cand[key]
+        delta = c - b
+        ratio = (c / b) if b else (float("inf") if c else 1.0)
+        gated = (
+            is_timing(key)
+            and (not key_globs or any(fnmatch.fnmatch(key, g) for g in key_globs))
+            and b >= min_seconds
+            and c >= min_seconds
+            and c > b * (1.0 + threshold)
+        )
+        rows.append((key, b, c, delta, ratio, gated))
+        if gated:
+            regressions.append((key, b, c, delta, ratio))
+    return rows, regressions
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("baseline", help="baseline bench JSON (e.g. BENCH_r04.json)")
+    ap.add_argument("candidate", help="candidate bench JSON (e.g. BENCH_r05.json)")
+    ap.add_argument(
+        "--threshold",
+        type=float,
+        default=0.25,
+        help="allowed fractional timing regression before failing (default 0.25)",
+    )
+    ap.add_argument(
+        "--min-seconds",
+        type=float,
+        default=0.01,
+        help="timings below this on either side never gate (noise floor, default 0.01)",
+    )
+    ap.add_argument(
+        "--keys",
+        nargs="*",
+        default=[],
+        help="fnmatch globs restricting which timing keys gate (default: all)",
+    )
+    ap.add_argument(
+        "--all",
+        action="store_true",
+        help="report every shared numeric leaf, not just timings",
+    )
+    args = ap.parse_args(argv)
+
+    try:
+        base = load_bench(args.baseline)
+        cand = load_bench(args.candidate)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"bench_compare: cannot read inputs: {e}", file=sys.stderr)
+        return 2
+    if not base or not cand:
+        print("bench_compare: no numeric leaves found", file=sys.stderr)
+        return 2
+
+    rows, regressions = compare(
+        base, cand, args.threshold, args.min_seconds, args.keys
+    )
+    shared = [r for r in rows if args.all or is_timing(r[0])]
+    print(
+        f"bench_compare: {args.baseline} -> {args.candidate}  "
+        f"({len(rows)} shared metrics, threshold {args.threshold:+.0%}, "
+        f"noise floor {args.min_seconds}s)"
+    )
+    for key, b, c, delta, ratio, gated in shared:
+        mark = "  REGRESSION" if gated else ""
+        print(f"  {key}: {b:.6g} -> {c:.6g}  ({delta:+.6g}, x{ratio:.3f}){mark}")
+    only_base = sorted(set(base) - set(cand))
+    only_cand = sorted(set(cand) - set(base))
+    if only_base:
+        print(f"  ({len(only_base)} metrics only in baseline)")
+    if only_cand:
+        print(f"  ({len(only_cand)} metrics only in candidate)")
+    if regressions:
+        print(
+            f"FAIL: {len(regressions)} timing metric(s) regressed past "
+            f"{args.threshold:+.0%}",
+            file=sys.stderr,
+        )
+        return 1
+    print("OK: no gated timing regressions")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
