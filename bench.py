@@ -738,6 +738,7 @@ def _cache_section() -> dict:
         global_scan_cache,
     )
 
+    from hyperspace_tpu import resilience
     from hyperspace_tpu.telemetry import compile_log, metrics
     from hyperspace_tpu.telemetry.profiling import pallas_fallback_summary
 
@@ -762,6 +763,12 @@ def _cache_section() -> dict:
         # WHAT compiled, so a compile-bound run (the r05 TPU timeout mode)
         # is attributable from the JSON alone.
         "compile_observatory": compile_log.program_summary(),
+        # Reliability rollup: fault injections, retry traffic, quarantines,
+        # and timeouts — `tools/bench_compare.py` gates on these (a bench
+        # round that passed timings while silently retry-storming regressed).
+        # ONE schema shared with the exporter frames
+        # (`resilience.reliability_rollup`).
+        "reliability": resilience.reliability_rollup(metrics.snapshot()),
     }
 
 

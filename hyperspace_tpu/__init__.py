@@ -9,7 +9,17 @@ joins as XLA/Pallas programs.
 """
 
 from .config import HyperspaceConf, IndexConstants, SessionConf  # noqa: F401
-from .exceptions import HyperspaceException  # noqa: F401
+from .exceptions import (  # noqa: F401
+    CompileTimeoutError,
+    ConcurrentWriteError,
+    CorruptIndexError,
+    HyperspaceException,
+    LogCommitError,
+    PermanentError,
+    QueryTimeoutError,
+    RetryBudgetExceededError,
+    TransientError,
+)
 from .index.index_config import IndexConfig  # noqa: F401
 
 

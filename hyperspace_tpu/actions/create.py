@@ -79,8 +79,17 @@ class CreateAction(Action):
 
     def validate(self) -> None:
         # Existing live index of the same name blocks creation
-        # (reference `CreateAction.scala:44-64`).
+        # (reference `CreateAction.scala:44-64`). A latest entry stuck in a
+        # TRANSIENT state is a dead writer's orphan (a SIGKILLed build):
+        # creation judges the latest STABLE state instead — effectively the
+        # cancel() rollback applied implicitly, with the log CAS arbitrating
+        # should the "dead" writer still be alive (`actions/action._recover_stable`).
         latest = self._log_manager.get_latest_log()
+        if latest is not None and latest.state in states.TRANSIENT_STATES:
+            from .action import _recover_stable
+
+            # None = nothing durable was ever committed: create proceeds.
+            latest = _recover_stable(self._log_manager, latest, missing_ok=True)
         if latest is not None and latest.state != states.DOESNOTEXIST:
             raise HyperspaceException(
                 f"Another Index with name {self._config.index_name} already exists."

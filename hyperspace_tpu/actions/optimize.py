@@ -20,7 +20,7 @@ from ..exceptions import HyperspaceException
 from ..index.log_entry import Content, FileInfo, IndexLogEntry, LogEntry
 from ..telemetry.events import HyperspaceEvent, OptimizeActionEvent
 from . import states
-from .action import Action
+from .action import Action, _recover_stable
 
 OPTIMIZE_FILE_SIZE_THRESHOLD = "hyperspace.index.optimize.fileSizeThreshold"
 OPTIMIZE_FILE_SIZE_THRESHOLD_DEFAULT = 256 * 1024 * 1024
@@ -64,6 +64,11 @@ class OptimizeAction(Action):
             prev = self._log_manager.get_log(self.base_id)
             if prev is None:
                 raise HyperspaceException("Optimize is only supported on an existing index.")
+            if prev.state in states.TRANSIENT_STATES:
+                # A dead writer's orphan (killed mid-action): fall back to the
+                # latest STABLE entry — the operation-log CAS arbitrates if
+                # the "dead" writer turns out to be alive (`actions/action.py`).
+                prev = _recover_stable(self._log_manager, prev)
             self._prev = prev
         return self._prev
 
@@ -114,24 +119,28 @@ class OptimizeAction(Action):
     def op(self) -> None:
         from ..engine import io as engine_io
         from ..engine.table import Table
+        from ..index.staging import stage_commit
         from ..ops.partition import bucketize_table
         import numpy as np
 
         prev = self._previous_entry()
         per_bucket, _ = self._partition_files()
-        os.makedirs(self._index_data_path, exist_ok=True)
-        for b, files in sorted(per_bucket.items()):
-            merged = engine_io.read_files([f.name for f in files], "parquet")
-            # Re-sort within the bucket by the indexed columns (same contract as the
-            # original bucketed write).
-            sorted_t, _ = bucketize_table(merged, prev.indexed_columns, prev.num_buckets)
-            # Same bounded row-group layout as the original bucketed write, so
-            # compacted files stay prunable by the scan pushdown's zone maps.
-            engine_io.write_parquet(
-                sorted_t,
-                os.path.join(self._index_data_path, f"part-{b:05d}.parquet"),
-                row_group_rows=engine_io.index_row_group_rows(),
-            )
+        # Staged commit (crash-safe, same contract as create/refresh): the
+        # compacted files land in `index_data_path` via one atomic rename.
+        with stage_commit(self._index_data_path) as stage:
+            os.makedirs(stage, exist_ok=True)
+            for b, files in sorted(per_bucket.items()):
+                merged = engine_io.read_files([f.name for f in files], "parquet")
+                # Re-sort within the bucket by the indexed columns (same contract as the
+                # original bucketed write).
+                sorted_t, _ = bucketize_table(merged, prev.indexed_columns, prev.num_buckets)
+                # Same bounded row-group layout as the original bucketed write, so
+                # compacted files stay prunable by the scan pushdown's zone maps.
+                engine_io.write_parquet(
+                    sorted_t,
+                    os.path.join(stage, f"part-{b:05d}.parquet"),
+                    row_group_rows=engine_io.index_row_group_rows(),
+                )
 
     def log_entry(self) -> LogEntry:
         import copy

@@ -18,7 +18,7 @@ from ..exceptions import HyperspaceException
 from ..index.log_entry import IndexLogEntry, LogEntry
 from ..telemetry.events import HyperspaceEvent, RefreshActionEvent
 from . import states
-from .action import Action
+from .action import Action, _recover_stable
 from .create import IndexerBuilder
 
 
@@ -43,6 +43,10 @@ class RefreshAction(Action):
             prev = self._log_manager.get_log(self.base_id)
             if prev is None:
                 raise HyperspaceException("Refresh is only supported on an existing index.")
+            if prev.state in states.TRANSIENT_STATES:
+                # Dead writer's orphan (killed mid-action): refresh judges the
+                # latest STABLE entry; the log CAS arbitrates live races.
+                prev = _recover_stable(self._log_manager, prev)
             self._prev = prev
         return self._prev
 

@@ -25,9 +25,15 @@ import pyarrow.csv as pa_csv
 import pyarrow.json as pa_json
 import pyarrow.parquet as pq
 
-from ..exceptions import HyperspaceException
+from .. import resilience as _resilience
+from ..exceptions import (
+    HyperspaceException,
+    QueryTimeoutError,
+    RetryBudgetExceededError,
+)
 from ..storage.filesystem import FileStatus, FileSystem, LocalFileSystem
 from ..telemetry import accounting as _accounting
+from ..telemetry import faults as _faults
 from ..telemetry import metrics as _metrics
 from ..util.path_utils import is_data_path
 from .schema import BOOL, FLOAT32, FLOAT64, INT32, INT64, STRING, Field, Schema
@@ -205,6 +211,7 @@ def _arrow_to_table(at: pa.Table) -> Table:
 
 
 def _read_one(path: str, file_format: str, columns: Optional[List[str]] = None) -> Table:
+    _faults.check("io.decode")
     if file_format == "delta":
         file_format = "parquet"  # delta data files are parquet
     if file_format == "parquet":
@@ -298,6 +305,7 @@ def _stat_value(v):
 def _parse_footer_meta(path: str) -> FileFooterMeta:
     from .pushdown import ZoneStats
 
+    _faults.check("io.footer")
     with pq.ParquetFile(path) as pf:
         md = pf.metadata
         schema = pf.schema_arrow
@@ -355,7 +363,15 @@ def footer_metadata(path: str, file_format: str = "parquet") -> Optional[FileFoo
         return meta
     _FOOTER_MISSES.inc()
     try:
-        meta = _parse_footer_meta(path)
+        # Transient footer-read faults retry with backoff; a PERSISTENT parse
+        # failure still degrades to "no pruning" — a corrupt footer must never
+        # break the scan, only its selectivity.
+        meta = _resilience.retry_io("io.footer", lambda: _parse_footer_meta(path))
+    except (QueryTimeoutError, RetryBudgetExceededError):
+        # Deadline and retry budget are QUERY contracts, not pruning details:
+        # swallowing either here would let a deadlined/budget-blown query limp
+        # on, burning more retries per footer.
+        raise
     except Exception:
         return None  # unreadable/corrupt footer: never break the scan over pruning
     cache.put_meta(path, meta, _meta_nbytes(meta))
@@ -459,12 +475,23 @@ def _decode_into_cache(
         cache = global_scan_cache()
         missing = cache.missing_columns(path, file_columns)
         if missing and missing != list(file_columns or []):
-            cache.put(path, missing, _read_one(path, file_format, missing))
+            cache.put(
+                path,
+                missing,
+                # Transient decode faults retry with backoff (the cache only
+                # ever stores the eventual SUCCESS — a retried decode is
+                # indistinguishable from a clean one downstream).
+                _resilience.retry_io(
+                    "io.decode", lambda: _read_one(path, file_format, missing)
+                ),
+            )
             t = cache.get(path, file_columns, record=False)
             if t is not None:
                 _decode_end(t0)
                 return t  # assembled: warm columns + the freshly decoded rest
-        t = _read_one(path, file_format, file_columns)
+        t = _resilience.retry_io(
+            "io.decode", lambda: _read_one(path, file_format, file_columns)
+        )
         cache.put(path, file_columns, t)
         _decode_end(t0)
         return t
@@ -489,6 +516,7 @@ def _read_row_groups_one(path: str, sel, columns: Optional[List[str]]) -> Table:
     are never decoded. Row order is the file's own (row groups in index
     order), so the surviving rows appear exactly as in a whole-file read
     minus the pruned groups."""
+    _faults.check("io.decode")
     with pq.ParquetFile(path) as pf:
         at = pf.read_row_groups(list(sel), columns=columns)
     return _arrow_to_table(at)
@@ -577,13 +605,22 @@ def _decode_rg_into_cache(
         cache = global_scan_cache()
         missing = cache.missing_columns(path, cols, sel=sel)
         if missing and missing != cols:
-            cache.put(path, missing, _read_row_groups_one(path, sel, missing), sel=sel)
+            cache.put(
+                path,
+                missing,
+                _resilience.retry_io(
+                    "io.decode", lambda: _read_row_groups_one(path, sel, missing)
+                ),
+                sel=sel,
+            )
             t = cache.get(path, cols, record=False, sel=sel)
             if t is not None:
                 _record_decoded_bytes(meta, sel, missing)
                 _decode_end(t0)
                 return t
-        t = _read_row_groups_one(path, sel, cols)
+        t = _resilience.retry_io(
+            "io.decode", lambda: _read_row_groups_one(path, sel, cols)
+        )
         cache.put(path, cols, t, sel=sel)
         _record_decoded_bytes(meta, sel, cols)
         _decode_end(t0)
@@ -645,10 +682,12 @@ def warm_file_cache(
         from concurrent.futures import ThreadPoolExecutor
 
         led = _accounting.current_ledger()  # charge workers to the submitter
+        sc = _resilience.current_scope()  # workers honor the query deadline
 
         def warm_one(job):
             p, sel, cols = job
-            with _accounting.use_ledger(led):
+            with _accounting.use_ledger(led), _resilience.use_scope(sc):
+                _faults.check("pool.worker")
                 if sel is None:
                     _decode_into_cache(p, file_format, file_columns)
                 else:
@@ -702,9 +741,11 @@ def iter_file_tables(
         sel_of = dict(zip(ordered, selections))
 
     led = _accounting.current_ledger()  # pool workers charge the submitter
+    sc = _resilience.current_scope()  # workers honor the query deadline
 
     def decode_one(path: str) -> Table:
-        with _accounting.use_ledger(led):
+        with _accounting.use_ledger(led), _resilience.use_scope(sc):
+            _faults.check("pool.worker")
             t0 = _time.monotonic()
             meta, sel = sel_of.get(path, (None, None))
             if sel is None:
@@ -721,6 +762,9 @@ def iter_file_tables(
     workers = min(decode_pool_size(len(ordered)), depth)
     if workers <= 1:
         for f in ordered:
+            # Chunk/pool-boundary cancellation: a deadlined query stops
+            # between files, before paying for the next decode.
+            _resilience.check_deadline("io.iter_file_tables")
             yield decorate_file_table(decode_one(f), f, partitions, columns)
         return
     from collections import deque
@@ -730,12 +774,15 @@ def iter_file_tables(
         pending: "deque" = deque()
         i = 0
         while i < len(ordered) or pending:
+            _resilience.check_deadline("io.iter_file_tables")
             while i < len(ordered) and len(pending) < depth:
                 pending.append((ordered[i], pool.submit(decode_one, ordered[i])))
                 i += 1
             f, fut = pending.popleft()
             yield decorate_file_table(fut.result(), f, partitions, columns)
     finally:
+        # Cooperative cancellation drains here too: undelivered decodes are
+        # cancelled, in-flight ones finish into the cache harmlessly.
         pool.shutdown(wait=False, cancel_futures=True)
 
 
@@ -806,6 +853,7 @@ def read_files(
     promotion and union dictionaries match the whole-file path exactly."""
     if not files:
         raise HyperspaceException("No data files to read.")
+    _resilience.check_deadline("io.read_files")
     from .scan_cache import global_concat_cache
 
     ordered = sorted(files)
@@ -871,9 +919,11 @@ def read_files(
         from concurrent.futures import ThreadPoolExecutor
 
         led = _accounting.current_ledger()  # charge workers to the submitter
+        sc = _resilience.current_scope()  # workers honor the query deadline
 
         def decode_miss_worker(i: int) -> Table:
-            with _accounting.use_ledger(led):
+            with _accounting.use_ledger(led), _resilience.use_scope(sc):
+                _faults.check("pool.worker")
                 return decode_miss(i)
 
         with ThreadPoolExecutor(max_workers=workers) as pool:
@@ -906,7 +956,9 @@ def infer_schema(files: List[str], file_format: str) -> Schema:
         from pyarrow import orc as pa_orc
 
         return arrow_schema_to_schema(pa_orc.ORCFile(f).schema)
-    return _read_one(f, file_format).schema
+    # csv/json infer by decoding the first file — a lake-touching read like
+    # any other, so it rides the same transient-retry contract.
+    return _resilience.retry_io("io.decode", lambda: _read_one(f, file_format)).schema
 
 
 _ARROW_TO_DTYPE = {
@@ -946,15 +998,31 @@ def table_to_arrow(table: Table) -> pa.Table:
     return pa.table(dict(zip(names, arrays)))
 
 
+def checked_write_table(
+    at: pa.Table, path: str, row_group_rows: Optional[int] = None
+) -> None:
+    """THE parquet write primitive of both index writers (serial
+    `write_parquet` path and the pipelined `_BucketWriter`) and the session
+    helpers: one `storage.write` fault point + bounded transient-retry site.
+    A retried write simply overwrites the partial file — `pq.write_table`
+    truncates — so the committed bytes are always one clean encode."""
+
+    def _write() -> None:
+        _faults.check("storage.write")
+        if row_group_rows is None:
+            pq.write_table(at, path)
+        else:
+            pq.write_table(at, path, row_group_size=int(row_group_rows))
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    _resilience.retry_io("storage.write", _write)
+
+
 def write_parquet(table: Table, path: str, row_group_rows: Optional[int] = None) -> None:
     """`row_group_rows` bounds the written row groups (None = pyarrow's
     default) — the index writers pass `index_row_group_rows()` so footer zone
     maps get sub-file resolution over the key-sorted bucket rows."""
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    if row_group_rows is None:
-        pq.write_table(table_to_arrow(table), path)
-    else:
-        pq.write_table(table_to_arrow(table), path, row_group_size=int(row_group_rows))
+    checked_write_table(table_to_arrow(table), path, row_group_rows)
 
 
 def write_orc(table: Table, path: str) -> None:

@@ -18,6 +18,7 @@ Join strategy (TPU-first):
 
 from __future__ import annotations
 
+import contextlib
 import os
 import re
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -25,7 +26,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import jax.numpy as jnp
 import numpy as np
 
-from ..exceptions import HyperspaceException
+from ..exceptions import CorruptIndexError, HyperspaceException
 from ..ops.hashing import key64
 from ..ops.join import merge_join_pairs, nonzero_indices
 from ..telemetry import metrics as _metrics
@@ -188,6 +189,40 @@ def _set_pruning_attrs(stats: Dict[str, int]) -> None:
     _tracing.set_attr("row_groups_skipped", int(stats.get("row_groups_skipped", 0)))
 
 
+@contextlib.contextmanager
+def _corruption_guard(relation: SourceRelation):
+    """Decode failures on INDEX data files (truncated/corrupt bucket files,
+    vanished files) re-raise as `CorruptIndexError` carrying the index name —
+    the signal `DataFrame.collect/count` quarantines on and re-plans around
+    (source-scan fallback, results stay correct). Classified framework errors
+    pass through unchanged: an injected transient fault that exhausted its
+    retries, a query timeout, or a blown retry budget is NOT corruption and
+    must fail the query, not condemn the index. Classification is limited to
+    DECODE-LAYER error types: the pyarrow exception family (ArrowInvalid ⊂
+    ValueError, ArrowIOError ⊂ OSError, but ArrowTypeError ⊂ TypeError — the
+    whole family counts, a failed index decode is a failed index decode)
+    plus plain ValueError/OSError/EOFError — a MemoryError or an engine bug
+    (bare TypeError, ...) must surface raw, never masquerade as a corrupt
+    index."""
+    try:
+        yield
+    except HyperspaceException:
+        raise
+    except Exception as e:
+        import pyarrow as pa
+
+        decode_layer = isinstance(
+            e, (ValueError, OSError, EOFError, pa.lib.ArrowException)
+        )
+        if relation.index_name and decode_layer:
+            raise CorruptIndexError(
+                f"index '{relation.index_name}' data failed to decode "
+                f"({type(e).__name__}: {e})",
+                index_name=relation.index_name,
+            ) from e
+        raise
+
+
 def _default_scan_columns(relation: SourceRelation, columns):
     """Effective column list when `columns` is None ("everything"): for an
     INDEX relation, "everything" means the VISIBLE schema — the internal
@@ -255,14 +290,15 @@ class ScanExec(PhysicalNode):
         if self.relation.partition_spec is not None:
             partitions = (self.relation.partition_spec, self.relation.root_paths)
         stats: Dict[str, int] = {}
-        out = engine_io.read_files(
-            files,
-            self.relation.file_format,
-            cols,
-            partitions=partitions,
-            pushdown=self._pushdown_pred(ctx),
-            pruning_stats=stats,
-        )
+        with _corruption_guard(self.relation):
+            out = engine_io.read_files(
+                files,
+                self.relation.file_format,
+                cols,
+                partitions=partitions,
+                pushdown=self._pushdown_pred(ctx),
+                pruning_stats=stats,
+            )
         _set_pruning_attrs(stats)
         return out
 
@@ -297,17 +333,18 @@ class ScanExec(PhysicalNode):
         on_decode = None if stages is None else (lambda s: stages.add("decode", s))
         chunk_rows = query_chunk_rows()
         stats: Dict[str, int] = {}
-        for t in engine_io.iter_file_tables(
-            files,
-            self.relation.file_format,
-            cols,
-            partitions,
-            on_decode=on_decode,
-            pushdown=self._pushdown_pred(ctx),
-            pruning_stats=stats,
-        ):
-            for ch in split_chunks(t, chunk_rows):
-                yield ch
+        with _corruption_guard(self.relation):
+            for t in engine_io.iter_file_tables(
+                files,
+                self.relation.file_format,
+                cols,
+                partitions,
+                on_decode=on_decode,
+                pushdown=self._pushdown_pred(ctx),
+                pruning_stats=stats,
+            ):
+                for ch in split_chunks(t, chunk_rows):
+                    yield ch
         _set_pruning_attrs(stats)
 
     def simple_string(self):
@@ -363,12 +400,13 @@ class BucketedIndexScanExec(PhysicalNode):
         # FIRST (pyarrow releases the GIL), then assemble serially from the
         # warm cache — r05 measured 1.34 s of a 1.35 s cold indexed read in
         # back-to-back single-threaded bucket-file decodes here.
-        engine_io.warm_file_cache(
-            [f.path for f in self.relation.files], self.relation.file_format, cols
-        )
-        buckets = self._assemble_buckets(
-            lambda p: engine_io.read_files([p], self.relation.file_format, cols)
-        )
+        with _corruption_guard(self.relation):
+            engine_io.warm_file_cache(
+                [f.path for f in self.relation.files], self.relation.file_format, cols
+            )
+            buckets = self._assemble_buckets(
+                lambda p: engine_io.read_files([p], self.relation.file_format, cols)
+            )
         if self.relation.hybrid_append is not None:
             self._merge_appended(buckets)
         return buckets
@@ -467,14 +505,15 @@ class BucketedIndexScanExec(PhysicalNode):
         # Decode the cold (pruned or whole) files on the shared pool first,
         # then assemble serially from the warm cache — the pruned twin of
         # `execute_buckets`' warm_file_cache step.
-        engine_io.warm_file_cache(
-            [f.path for f in rel.files], rel.file_format, cols, selections=sel_of
-        )
-        buckets = self._assemble_buckets(
-            lambda p: engine_io.pruned_file_table(
-                p, rel.file_format, cols, *sel_of[p]
+        with _corruption_guard(rel):
+            engine_io.warm_file_cache(
+                [f.path for f in rel.files], rel.file_format, cols, selections=sel_of
             )
-        )
+            buckets = self._assemble_buckets(
+                lambda p: engine_io.pruned_file_table(
+                    p, rel.file_format, cols, *sel_of[p]
+                )
+            )
         table, starts = self._concat_with_starts(buckets, self.empty_table)
         # The pruned path never consults the bucketed-concat cache — report
         # that honestly (every cold bucketed scan carries a cache verdict).

@@ -170,29 +170,64 @@ class DataFrame:
             case_sensitive=self.session.hs_conf.case_sensitive,
         )
 
-    def collect(self) -> Table:
-        from ..telemetry import accounting, tracing
+    def _run_with_quarantine_fallback(self, runner):
+        """Plan + execute with the corruption-quarantine fallback: a
+        `CorruptIndexError` (a truncated/corrupt index bucket file surfaced by
+        the scan layer) QUARANTINES the named index, warns, and re-plans — the
+        rules now skip the quarantined index (`rules.rule_utils`), so the
+        retry executes against the source data and the result stays correct.
+        Bounded by construction: every round quarantines a NEW index
+        (`quarantine.mark` returns False on a repeat, which propagates)."""
+        import warnings
 
-        with tracing.query_span("query:collect") as root:
+        from ..exceptions import CorruptIndexError
+        from ..index import quarantine
+        from ..telemetry import tracing
+
+        while True:
             with tracing.span("plan"):
                 phys = self.physical_plan()
-            out = phys.execute(ExecContext(self.session))
-            root.set_attr("rows_out", int(out.num_rows))
-            accounting.set_value("rows_produced", int(out.num_rows))
-            return out
+            try:
+                return runner(phys)
+            except CorruptIndexError as e:
+                if not quarantine.mark(e.index_name, reason=str(e), path=e.path):
+                    raise
+                warnings.warn(
+                    f"hyperspace: index '{e.index_name}' quarantined after a "
+                    f"corrupt data file; the query falls back to the source "
+                    f"scan and stays correct ({e}). Refresh or rebuild the "
+                    "index to lift the quarantine.",
+                    RuntimeWarning,
+                    stacklevel=3,
+                )
+
+    def collect(self) -> Table:
+        from .. import resilience
+        from ..telemetry import accounting, tracing
+
+        with resilience.query_scope("query:collect"):
+            with tracing.query_span("query:collect") as root:
+                out = self._run_with_quarantine_fallback(
+                    lambda phys: phys.execute(ExecContext(self.session))
+                )
+                root.set_attr("rows_out", int(out.num_rows))
+                accounting.set_value("rows_produced", int(out.num_rows))
+                return out
 
     def count(self) -> int:
         # Counts never assemble output they don't need: scans answer from parquet
         # footers, joins from verified pair counts (`PhysicalNode.execute_count`).
+        from .. import resilience
         from ..telemetry import accounting, tracing
 
-        with tracing.query_span("query:count") as root:
-            with tracing.span("plan"):
-                phys = self.physical_plan()
-            n = phys.execute_count(ExecContext(self.session))
-            root.set_attr("rows_out", int(n))
-            accounting.set_value("rows_produced", int(n))
-            return n
+        with resilience.query_scope("query:count"):
+            with tracing.query_span("query:count") as root:
+                n = self._run_with_quarantine_fallback(
+                    lambda phys: phys.execute_count(ExecContext(self.session))
+                )
+                root.set_attr("rows_out", int(n))
+                accounting.set_value("rows_produced", int(n))
+                return n
 
     def to_pydict(self) -> Dict[str, list]:
         return self.collect().to_pydict()

@@ -162,7 +162,12 @@ def stream_aggregate(agg_exec, ctx) -> Optional[Table]:
     template: Optional[Table] = None
     none_idx = np.empty(0, np.int64)
     n_chunks = 0
+    from .. import resilience
+
     for chunk in agg_exec.child.execute_stream(ctx, stages):
+        # Chunk-boundary cancellation: a deadlined query stops between
+        # chunks; nothing partial was cached (only-cache-on-success).
+        resilience.check_deadline("query.stream")
         zero = chunk.take(none_idx)
         template = zero if template is None else Table.concat([template, zero])
         n_chunks += 1
@@ -374,9 +379,15 @@ def stream_join_aggregate(agg_exec, join_exec, chain, ctx) -> Optional[Table]:
             template = t.take(none_idx)
         agg.add_chunk(t)
 
+    from .. import resilience
+
     workers = min(2, engine_io.decode_pool_size(len(slices)))
     if workers <= 1 or len(slices) == 1:
         for lo, hi in slices:
+            # Pair-chunk-boundary cancellation: a mid-stream deadline (like a
+            # mid-stream fault) propagates cleanly — the memos below are
+            # populated only after EVERY chunk streamed successfully.
+            resilience.check_deadline("query.join_stream")
             consume(build_chunk(lo, hi))
     else:
         from collections import deque
@@ -387,6 +398,7 @@ def stream_join_aggregate(agg_exec, join_exec, chain, ctx) -> Optional[Table]:
             pending: "deque" = deque()
             i = 0
             while i < len(slices) or pending:
+                resilience.check_deadline("query.join_stream")
                 # Depth-bounded: at most workers+1 chunks in flight keeps
                 # resident chunk memory bounded while the NEXT chunk's
                 # verify/gather overlaps this one's aggregator fold.

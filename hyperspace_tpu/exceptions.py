@@ -1,8 +1,18 @@
-"""The single framework exception type.
+"""Framework exception taxonomy.
 
 Parity: reference `HyperspaceException.scala:19` — one exception class carrying a
-message, raised for all user-facing error conditions.
+message, raised for all user-facing error conditions. The reproduction extends the
+single class into a transient/permanent taxonomy (absent from the v0 reference,
+which delegated fault handling to Spark's task retry machinery): the resilience
+layer (`hyperspace_tpu.resilience`) retries `TransientError`s with bounded
+exponential backoff, while `PermanentError`s fail fast — and index-data
+corruption (`CorruptIndexError`) routes to quarantine + source-scan fallback
+instead of failing the query at all.
 """
+
+from __future__ import annotations
+
+from typing import Optional
 
 
 class HyperspaceException(Exception):
@@ -11,3 +21,81 @@ class HyperspaceException(Exception):
     def __init__(self, message: str):
         super().__init__(message)
         self.message = message
+
+
+class TransientError(HyperspaceException):
+    """A fault that a bounded retry may clear (flaky IO, injected transient
+    faults). The ONLY HyperspaceException subclass `resilience.retry_io`
+    retries."""
+
+
+class PermanentError(HyperspaceException):
+    """A fault retrying cannot clear (corrupt data, missing files, contract
+    violations). Never retried."""
+
+
+class CorruptIndexError(PermanentError):
+    """An index data file failed to parse (truncated/corrupt bucket file).
+
+    Carries the index name so the query layer can QUARANTINE the index and
+    re-plan against the source data — the query stays correct, the index sits
+    out until rebuilt (`index/quarantine.py`)."""
+
+    def __init__(self, message: str, index_name: str, path: Optional[str] = None):
+        super().__init__(message)
+        self.index_name = index_name
+        self.path = path
+
+
+class ConcurrentWriteError(HyperspaceException):
+    """Lost the operation-log optimistic-concurrency race: another writer
+    committed the contested log id first. The loser aborts cleanly (its staged
+    data is discarded) and may retry from scratch."""
+
+
+class LogCommitError(HyperspaceException):
+    """A metadata-log write that MUST succeed failed for a non-OCC reason
+    (e.g. the latestStable pointer write) — the classified replacement for the
+    silently-ignored `bool` returns the log manager used to hand back."""
+
+
+class QueryTimeoutError(HyperspaceException):
+    """The query exceeded ``HYPERSPACE_QUERY_TIMEOUT_S``. Raised at a chunk or
+    pool boundary (cooperative cancellation) — workers drain and no partial
+    cache/memo entry is left behind (the standing only-cache-on-success
+    contract)."""
+
+    def __init__(self, message: str, elapsed_s: float = 0.0, timeout_s: float = 0.0):
+        super().__init__(message)
+        self.elapsed_s = elapsed_s
+        self.timeout_s = timeout_s
+
+
+class CompileTimeoutError(QueryTimeoutError):
+    """An XLA compile (an `observed_jit` program) exceeded
+    ``HYPERSPACE_COMPILE_TIMEOUT_S`` — the classified, program-attributed
+    replacement for the r05 silent 2400 s compile hang."""
+
+
+class RetryBudgetExceededError(PermanentError):
+    """One query burned through its per-query retry budget
+    (``HYPERSPACE_QUERY_RETRY_BUDGET``) — the fault is transient per site but
+    systemic per query, so failing is better than retrying forever."""
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Whether `exc` is retry-eligible. Hyperspace's own taxonomy decides for
+    framework errors; for foreign exceptions, connection-ish/OS-level IO
+    errors are transient (flaky network filesystems) while parse errors,
+    missing files, and everything else are not."""
+    if isinstance(exc, TransientError):
+        return True
+    if isinstance(exc, HyperspaceException):
+        return False
+    if isinstance(exc, (FileNotFoundError, IsADirectoryError, PermissionError)):
+        return False
+    if isinstance(exc, (ConnectionError, TimeoutError, InterruptedError, OSError)):
+        # Note: pyarrow's ArrowInvalid (corrupt parquet) subclasses ValueError,
+        # not OSError — parse failures are correctly permanent here.
+        return True
+    return False

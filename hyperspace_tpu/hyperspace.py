@@ -42,12 +42,14 @@ class Hyperspace:
     # -- index CRUD (reference Hyperspace.scala:40-104) ---------------------
 
     def create_index(self, df: DataFrame, index_config: IndexConfig) -> None:
+        from . import resilience
         from .telemetry import tracing
 
-        with tracing.query_span(
-            "build:create_index", index_name=index_config.index_name
-        ):
-            self._manager.create(df, index_config)
+        with resilience.query_scope("build:create_index"):
+            with tracing.query_span(
+                "build:create_index", index_name=index_config.index_name
+            ):
+                self._manager.create(df, index_config)
 
     def delete_index(self, index_name: str) -> None:
         self._manager.delete(index_name)
@@ -61,12 +63,14 @@ class Hyperspace:
     def refresh_index(self, index_name: str, mode: str = "full") -> None:
         """mode="full": rebuild from scratch (reference behavior).
         mode="incremental": index only appended source files (extension)."""
+        from . import resilience
         from .telemetry import tracing
 
-        with tracing.query_span(
-            "build:refresh_index", index_name=index_name, mode=mode
-        ):
-            self._manager.refresh(index_name, mode)
+        with resilience.query_scope("build:refresh_index"):
+            with tracing.query_span(
+                "build:refresh_index", index_name=index_name, mode=mode
+            ):
+                self._manager.refresh(index_name, mode)
 
     def optimize_index(self, index_name: str, mode: str = "quick") -> None:
         """Compact small per-bucket index files (extension; quick/full modes)."""

@@ -308,7 +308,6 @@ class _BucketWriter:
         if hi <= lo:
             return  # empty bucket: no file (same contract as the serial path)
         import pyarrow as pa
-        import pyarrow.parquet as pq
 
         out = pa.table(
             {n: self.gathered[n].slice(lo, hi - lo) for n in self.names}
@@ -316,11 +315,12 @@ class _BucketWriter:
         # Bounded row groups over the key-sorted bucket rows: the footer zone
         # maps then resolve point/range filters INSIDE the bucket file (scan
         # pushdown). Same bound as the serial writer — the byte-identity
-        # contract between the two paths includes the row-group layout.
-        pq.write_table(
+        # contract between the two paths includes the row-group layout, and
+        # both paths now write through ONE `storage.write` fault/retry site.
+        engine_io.checked_write_table(
             out,
             os.path.join(self.index_data_path, f"part-{b:05d}.parquet"),
-            row_group_size=engine_io.index_row_group_rows(),
+            row_group_rows=engine_io.index_row_group_rows(),
         )
 
     def run(self, perm: np.ndarray, starts: np.ndarray, pool_size: int) -> None:
@@ -521,12 +521,16 @@ def _decode_and_finish(
     file_tables: List[Optional[Table]] = [None] * n_files
     hash_q: "queue.Queue[int | None]" = queue.Queue()
 
+    from .. import resilience as _resilience
     from ..telemetry import accounting as _accounting
+    from ..telemetry import faults as _faults
 
     led = _accounting.current_ledger()  # pool decodes charge the build's ledger
+    sc = _resilience.current_scope()  # workers honor the build's deadline
 
     def decode_one(i: int) -> None:
-        with _accounting.use_ledger(led):
+        with _accounting.use_ledger(led), _resilience.use_scope(sc):
+            _faults.check("pool.worker")
             with stages.timed("decode"):
                 file_tables[i] = _decode_file(
                     files_in_order[i], file_format, wanted, partitions, lineage

@@ -116,16 +116,18 @@ class CoveringIndexBuilder(IndexerBuilder):
           ``HYPERSPACE_BUILD_DECODE_THREADS=1`` (the bit-for-bit reference
           the pipeline is pinned to by `tests/test_build_pipeline.py`).
 
-        Any failure removes the partially-written index data directory, so an
-        aborted build never leaves files for a later `Content.from_directory`
-        inventory to pick up (the log entry stays uncommitted either way)."""
-        try:
-            self._write_routed(df, index_config, index_data_path)
-        except BaseException:
-            import shutil
+        Crash-safe commit: the build writes into a dot-prefixed STAGING
+        directory that every inventory/scan path ignores, committed to
+        `index_data_path` by ONE atomic rename (`index/staging.py`). A failure
+        deletes the staging dir; a SIGKILL at any point leaves either an
+        invisible staging dir (reclaimed by the next action on the index) or
+        the complete committed dir — never partial visible files for a later
+        `Content.from_directory` inventory to pick up (the log entry stays
+        uncommitted either way)."""
+        from .staging import stage_commit
 
-            shutil.rmtree(index_data_path, ignore_errors=True)
-            raise
+        with stage_commit(index_data_path) as stage:
+            self._write_routed(df, index_config, stage)
 
     def _write_routed(
         self, df: DataFrame, index_config: IndexConfig, index_data_path: str

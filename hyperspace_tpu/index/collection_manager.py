@@ -80,6 +80,12 @@ class IndexCollectionManager(IndexManager):
     def _managers_for(self, name: str):
         index_path = self._resolver.get_index_path(name)
         fs = self._fs_factory.create(index_path)
+        # Startup/steady-state reclamation: every action resolving this index
+        # sweeps staging dirs whose writer died (SIGKILLed builds). Live
+        # writers are pid-checked and never touched.
+        from .staging import reclaim_orphans
+
+        reclaim_orphans(index_path)
         return (
             self._log_factory.create(index_path, fs),
             self._data_factory.create(index_path, fs),
@@ -124,6 +130,10 @@ class IndexCollectionManager(IndexManager):
             data_mgr.get_path(next_version),
             self._event_logger(),
         ).run()
+        # Fresh data supersedes any quarantined corrupt files (`index/quarantine`).
+        from . import quarantine
+
+        quarantine.clear(index_config.index_name)
 
     def refresh(self, index_name: str, mode: str = "full") -> None:
         from ..actions.refresh import RefreshIncrementalAction
@@ -143,6 +153,9 @@ class IndexCollectionManager(IndexManager):
         action_cls(
             builder, log_mgr, index_path, data_mgr.get_path(next_version), self._event_logger()
         ).run()
+        from . import quarantine
+
+        quarantine.clear(index_name)
 
     def optimize(self, index_name: str, mode: str = "quick") -> None:
         from ..actions.optimize import OptimizeAction
@@ -160,6 +173,9 @@ class IndexCollectionManager(IndexManager):
             mode,
             self._event_logger(),
         ).run()
+        from . import quarantine
+
+        quarantine.clear(index_name)
 
     def delete(self, index_name: str) -> None:
         log_mgr, _, _ = self._existing_log_manager(index_name)
@@ -170,8 +186,14 @@ class IndexCollectionManager(IndexManager):
         RestoreAction(log_mgr, self._event_logger()).run()
 
     def vacuum(self, index_name: str) -> None:
-        log_mgr, data_mgr, _ = self._existing_log_manager(index_name)
+        log_mgr, data_mgr, index_path = self._existing_log_manager(index_name)
         VacuumAction(data_mgr, log_mgr, self._event_logger()).run()
+        # Vacuum also sweeps any dead-writer staging dirs (hard-delete pass).
+        from . import quarantine
+        from .staging import reclaim_orphans
+
+        reclaim_orphans(index_path)
+        quarantine.clear(index_name)
 
     def cancel(self, index_name: str) -> None:
         log_mgr, _, _ = self._existing_log_manager(index_name)

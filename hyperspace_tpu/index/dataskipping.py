@@ -234,6 +234,16 @@ class DataSkippingIndexBuilder(IndexerBuilder):
             )
 
     def write(self, df, index_config: DataSkippingIndexConfig, index_data_path: str) -> None:
+        # Same crash-safe staged commit as the covering build: the sketch file
+        # lands via one atomic rename, never as a partial visible write.
+        from .staging import stage_commit
+
+        with stage_commit(index_data_path) as stage:
+            self._write_sketches(df, index_config, stage)
+
+    def _write_sketches(
+        self, df, index_config: DataSkippingIndexConfig, index_data_path: str
+    ) -> None:
         rel = df.plan.relation
         cols = list(dict.fromkeys(s.column for s in index_config.sketches))
         partitions = (

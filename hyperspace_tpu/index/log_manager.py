@@ -11,9 +11,12 @@ from __future__ import annotations
 import os
 from typing import List, Optional
 
+from .. import resilience as _resilience
 from ..actions.states import STABLE_STATES
 from ..config import IndexConstants
+from ..exceptions import is_transient
 from ..storage.filesystem import FileSystem, LocalFileSystem
+from ..telemetry import faults as _faults
 from ..util import json_utils
 from .log_entry import IndexLogEntry, LogEntry
 
@@ -104,13 +107,41 @@ class IndexLogManagerImpl(IndexLogManager):
         if self._fs.exists(path):
             self._fs.delete(path)
         text = json_utils.to_json(entry.to_json())
-        return self._fs.atomic_write_text(path, text)
+        return self._atomic_write_with_retry(path, text)
+
+    def _atomic_write_with_retry(self, path: str, text: str) -> bool:
+        """Retry-safe atomic commit: transient faults retry with backoff, and
+        a fault raised AFTER our own rename landed (e.g. the temp-file cleanup
+        delete failing on a flaky fs) is recognized by re-reading the target —
+        the retry must NOT see our own committed write as a lost OCC race
+        (which would abort the action over its own success). A `False` return
+        is a real OCC loss: a decided outcome, never retried."""
+
+        def _attempt() -> bool:
+            _faults.check("log.write")
+            try:
+                return self._fs.atomic_write_text(path, text)
+            except BaseException as e:
+                if is_transient(e) and self._content_is(path, text):
+                    return True  # our write committed before the fault
+                raise
+
+        return _resilience.retry_io("log.write", _attempt)
+
+    def _content_is(self, path: str, text: str) -> bool:
+        try:
+            return self._fs.exists(path) and self._fs.read_text(path) == text
+        except Exception:
+            return False
 
     def delete_latest_stable_log(self) -> bool:
         path = self._path_for(LATEST_STABLE)
         if not self._fs.exists(path):
             return True
-        self._fs.delete(path)
+        # The real failure mode here is an fs EXCEPTION, not a False return:
+        # transient ones retry; a persistent one propagates for the caller
+        # (`Action.end`) to classify as LogCommitError.
+        _resilience.retry_io("log.write", lambda: self._fs.delete(path))
         return True
 
     def write_log(self, log_id: int, entry: LogEntry) -> bool:
@@ -121,7 +152,7 @@ class IndexLogManagerImpl(IndexLogManager):
         d = entry.to_json()
         d["id"] = log_id
         text = json_utils.to_json(d)
-        ok = self._fs.atomic_write_text(self._path_for(log_id), text)
+        ok = self._atomic_write_with_retry(self._path_for(log_id), text)
         if ok:
             entry.id = log_id
         return ok

@@ -1,0 +1,66 @@
+"""Corruption quarantine: a broken index sits out instead of failing queries.
+
+A truncated or corrupt index bucket file used to kill every query whose plan
+the rules had rewritten onto that index. Now the decode failure surfaces as a
+`CorruptIndexError` carrying the index name (`engine.physical`), the query
+layer marks the index here and RE-PLANS (`DataFrame.collect/count`), and the
+rules skip quarantined indexes at candidate selection
+(`rules.rule_utils.get_candidate_indexes`, ticking
+``rule.<Name>.quarantined``) — the query falls back to the source scan with a
+warning and stays correct.
+
+Quarantine is process-local, advisory state (the lake's log is not touched):
+any mutation of the index (create/refresh/optimize/vacuum/delete) clears its
+entry, since new data supersedes the corrupt files.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from ..telemetry import metrics as _metrics
+
+_EVENTS = _metrics.counter("index.quarantine.events")
+_ACTIVE = _metrics.gauge("index.quarantine.active")
+
+_lock = threading.Lock()
+_entries: Dict[str, dict] = {}
+
+
+def mark(index_name: str, reason: str, path: Optional[str] = None) -> bool:
+    """Quarantine `index_name`; False if it already was (the caller then knows
+    re-planning cannot help and should propagate the failure)."""
+    with _lock:
+        if index_name in _entries:
+            return False
+        _entries[index_name] = {
+            "reason": reason,
+            "path": path,
+            "ts": time.time(),
+        }
+        _ACTIVE.set(len(_entries))
+    _EVENTS.inc()
+    return True
+
+
+def is_quarantined(index_name: str) -> bool:
+    with _lock:
+        return index_name in _entries
+
+
+def clear(index_name: Optional[str] = None) -> None:
+    """Lift the quarantine of one index (rebuilt/refreshed data supersedes the
+    corrupt files) or of all (None)."""
+    with _lock:
+        if index_name is None:
+            _entries.clear()
+        else:
+            _entries.pop(index_name, None)
+        _ACTIVE.set(len(_entries))
+
+
+def snapshot() -> Dict[str, dict]:
+    with _lock:
+        return {k: dict(v) for k, v in _entries.items()}

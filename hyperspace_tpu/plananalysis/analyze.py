@@ -39,6 +39,9 @@ _VERDICT_KEYS = (
     "pairs_memo",
     "row_groups_scanned",
     "row_groups_skipped",
+    # Retries that happened under this node (resilience layer): a plan that
+    # was correct-but-retried shows it inline, per operator.
+    "io_retries",
 )
 
 

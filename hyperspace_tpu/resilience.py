@@ -1,0 +1,267 @@
+"""Resilient execution: bounded retries with backoff, query deadlines, budgets.
+
+The engine's availability story (PAPER.md: all metadata on the lake, optimistic
+concurrency, no external catalog) assumed faults either never happen or kill
+the query. This module is the middle ground, applied at every lake-touching
+site (`engine/io.py` decode-pool reads and footer parses, bucket-file writes,
+`index/log_manager.py` log writes):
+
+- **`retry_io(point, fn)`** — retries `fn` on transient faults
+  (`exceptions.is_transient`) up to ``HYPERSPACE_IO_RETRIES`` times with
+  exponential backoff + jitter, ticking ``io.retries.*`` counters, the active
+  query ledger (``io_retries``), and the ambient span (``io_retries`` attr, so
+  `explain(analyze=True)` shows what was retried). Permanent faults and
+  exhausted retries propagate unchanged.
+- **`query_scope(name)`** — one per root query action (collect / count /
+  create_index / refresh_index; nested scopes reuse the outer one). Carries
+  the query DEADLINE (``HYPERSPACE_QUERY_TIMEOUT_S``) and the per-query RETRY
+  BUDGET (``HYPERSPACE_QUERY_RETRY_BUDGET``) — a query whose sites each retry
+  within bounds can still exceed its budget under systemic faults, which
+  raises `RetryBudgetExceededError` instead of limping on.
+- **`check_deadline(where)`** — the cooperative cancellation hook, called at
+  chunk/pool boundaries in the streaming and decode paths. Past the deadline
+  it raises a classified `QueryTimeoutError`; pools then drain through their
+  existing try/finally shutdowns and the only-cache-on-success contract
+  guarantees no partial cache/memo entries survive.
+- **`use_scope(scope)`** — pool workers run in fresh contexts; the submitting
+  code captures `current_scope()` and adopts it in the worker body, exactly
+  like `accounting.use_ledger` / `tracing.span(parent=...)`.
+
+Cost when idle: `check_deadline` is one contextvar read; `retry_io`'s happy
+path is one function call around the operation.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import random
+import threading
+import time
+from typing import Callable, Iterator, Optional, TypeVar
+
+from .exceptions import (
+    QueryTimeoutError,
+    RetryBudgetExceededError,
+    is_transient,
+)
+from .telemetry import accounting as _accounting
+from .telemetry import metrics as _metrics
+from .telemetry import tracing as _tracing
+
+ENV_IO_RETRIES = "HYPERSPACE_IO_RETRIES"
+ENV_RETRY_BACKOFF_S = "HYPERSPACE_RETRY_BACKOFF_S"
+ENV_QUERY_RETRY_BUDGET = "HYPERSPACE_QUERY_RETRY_BUDGET"
+ENV_QUERY_TIMEOUT_S = "HYPERSPACE_QUERY_TIMEOUT_S"
+
+_DEFAULT_IO_RETRIES = 2  # retries per operation (attempts = retries + 1)
+_DEFAULT_BACKOFF_S = 0.02
+_DEFAULT_RETRY_BUDGET = 256
+_BACKOFF_CAP_S = 2.0
+
+_RETRY_ATTEMPTS = _metrics.counter("io.retries.attempts")
+_RETRY_EXHAUSTED = _metrics.counter("io.retries.exhausted")
+_TIMEOUTS = _metrics.counter("query.timeouts")
+
+
+def max_retries() -> int:
+    try:
+        return max(0, int(os.environ.get(ENV_IO_RETRIES, "") or _DEFAULT_IO_RETRIES))
+    except ValueError:
+        return _DEFAULT_IO_RETRIES
+
+
+def _backoff_base_s() -> float:
+    try:
+        return max(
+            0.0, float(os.environ.get(ENV_RETRY_BACKOFF_S, "") or _DEFAULT_BACKOFF_S)
+        )
+    except ValueError:
+        return _DEFAULT_BACKOFF_S
+
+
+def retry_budget() -> int:
+    try:
+        return max(
+            0,
+            int(os.environ.get(ENV_QUERY_RETRY_BUDGET, "") or _DEFAULT_RETRY_BUDGET),
+        )
+    except ValueError:
+        return _DEFAULT_RETRY_BUDGET
+
+
+def query_timeout_s() -> Optional[float]:
+    raw = os.environ.get(ENV_QUERY_TIMEOUT_S)
+    if not raw:
+        return None
+    try:
+        v = float(raw)
+    except ValueError:
+        return None
+    return v if v > 0 else None
+
+
+class QueryScope:
+    """Deadline + retry-budget state of one root query action."""
+
+    __slots__ = ("name", "start_mono", "deadline_mono", "timeout_s", "_lock", "retries")
+
+    def __init__(self, name: str, timeout_s: Optional[float]):
+        self.name = name
+        self.start_mono = time.monotonic()
+        self.timeout_s = timeout_s
+        self.deadline_mono = (
+            None if timeout_s is None else self.start_mono + timeout_s
+        )
+        self._lock = threading.Lock()
+        self.retries = 0
+
+    def charge_retry(self) -> int:
+        with self._lock:
+            self.retries += 1
+            return self.retries
+
+
+_scope: "contextvars.ContextVar[Optional[QueryScope]]" = contextvars.ContextVar(
+    "hyperspace_query_scope", default=None
+)
+
+
+def current_scope() -> Optional[QueryScope]:
+    return _scope.get()
+
+
+@contextlib.contextmanager
+def query_scope(name: str) -> Iterator[QueryScope]:
+    """Open the resilience scope of one root action; nested under an existing
+    scope it yields that scope unchanged (one deadline/budget per outermost
+    action, matching the one-query_id-per-root rule)."""
+    existing = _scope.get()
+    if existing is not None:
+        yield existing
+        return
+    sc = QueryScope(name, query_timeout_s())
+    token = _scope.set(sc)
+    try:
+        yield sc
+    finally:
+        _scope.reset(token)
+
+
+@contextlib.contextmanager
+def use_scope(sc: Optional[QueryScope]) -> Iterator[None]:
+    """Adopt `sc` on THIS thread (pool workers run in fresh contexts; the
+    submitter captures `current_scope()` — the scope twin of `use_ledger`)."""
+    if sc is None:
+        yield
+        return
+    token = _scope.set(sc)
+    try:
+        yield
+    finally:
+        _scope.reset(token)
+
+
+def check_deadline(where: str = "") -> None:
+    """Cooperative cancellation: raise a classified `QueryTimeoutError` when
+    the ambient query scope's deadline has passed. One contextvar read when no
+    scope or no deadline is set."""
+    sc = _scope.get()
+    if sc is None or sc.deadline_mono is None:
+        return
+    now = time.monotonic()
+    if now < sc.deadline_mono:
+        return
+    _TIMEOUTS.inc()
+    elapsed = now - sc.start_mono
+    at = f" at {where}" if where else ""
+    raise QueryTimeoutError(
+        f"query '{sc.name}' exceeded HYPERSPACE_QUERY_TIMEOUT_S="
+        f"{sc.timeout_s:g}s (elapsed {elapsed:.3f}s{at}); workers drained, "
+        "no partial cache/memo entries were committed",
+        elapsed_s=elapsed,
+        timeout_s=sc.timeout_s or 0.0,
+    )
+
+
+def remaining_s() -> Optional[float]:
+    """Seconds until the ambient deadline (None = no deadline)."""
+    sc = _scope.get()
+    if sc is None or sc.deadline_mono is None:
+        return None
+    return max(0.0, sc.deadline_mono - time.monotonic())
+
+
+def reliability_rollup(snapshot: Optional[dict] = None) -> dict:
+    """Compact reliability summary over a `metrics.snapshot()` — THE shared
+    schema of `bench_detail.reliability` and the exporter frames'
+    `reliability` key (one producer, so the gates/alerts reading either can
+    never see drifted field sets)."""
+    if snapshot is None:
+        snapshot = _metrics.snapshot()
+    c = snapshot.get("counters", {})
+    try:
+        from .index import quarantine as _quarantine
+
+        quarantined = sorted(_quarantine.snapshot())
+    except Exception:
+        quarantined = []
+    return {
+        "faults_injected": c.get("faults.injected", 0),
+        "io_retries": c.get("io.retries.attempts", 0),
+        "retries_exhausted": c.get("io.retries.exhausted", 0),
+        "query_timeouts": c.get("query.timeouts", 0),
+        "quarantine_events": c.get("index.quarantine.events", 0),
+        "staging_reclaimed": c.get("index.staging.reclaimed", 0),
+        "quarantined": quarantined,
+    }
+
+
+T = TypeVar("T")
+
+
+def retry_io(point: str, fn: Callable[[], T]) -> T:
+    """Run `fn`, retrying transient failures with exponential backoff + jitter.
+
+    `point` names the site for the ``io.retries.<point>`` counter (the fault
+    points of `telemetry.faults` reuse their names here, so a chaos run's
+    injections and retries line up by name). The retry sleep never outlives
+    the ambient deadline — a query about to time out fails promptly rather
+    than sleeping through its budget."""
+    retries = max_retries()
+    attempt = 0
+    while True:
+        check_deadline(point)
+        try:
+            return fn()
+        except BaseException as e:
+            transient = is_transient(e)
+            if not transient or attempt >= retries:
+                # "Exhausted" means precisely: a RETRYABLE fault hit the
+                # attempt cap (including a cap of zero) — a permanent error
+                # raised after some retries is a different outcome and must
+                # not inflate the gated counter.
+                if transient:
+                    _RETRY_EXHAUSTED.inc()
+                raise
+            attempt += 1
+            sc = _scope.get()
+            if sc is not None and sc.charge_retry() > retry_budget():
+                raise RetryBudgetExceededError(
+                    f"query '{sc.name}' exceeded its retry budget "
+                    f"({retry_budget()} retries; HYPERSPACE_QUERY_RETRY_BUDGET)"
+                ) from e
+            _RETRY_ATTEMPTS.inc()
+            _metrics.counter(f"io.retries.{point}").inc()
+            _accounting.add("io_retries", 1)
+            sp = _tracing.current_span()
+            if sp is not None:
+                sp.inc_attr("io_retries", 1)
+            delay = _backoff_base_s() * (2 ** (attempt - 1))
+            delay = min(delay, _BACKOFF_CAP_S) * (0.5 + random.random())
+            rem = remaining_s()
+            if rem is not None:
+                delay = min(delay, rem)
+            if delay > 0:
+                time.sleep(delay)

@@ -94,6 +94,7 @@ class DataSkippingFilterRule:
                     # valid — deletes need no lineage here.
                     deletes_without_lineage_ok=True,
                     kind=DATA_SKIPPING_KIND,
+                    rule_name="DataSkippingFilterRule",
                 )
                 if not candidates:
                     return node

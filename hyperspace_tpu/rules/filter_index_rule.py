@@ -91,7 +91,10 @@ class FilterIndexRule:
                 )
                 filter_columns = sorted(filt.condition.references())
                 candidates = get_candidate_indexes(
-                    index_manager, scan, hybrid_scan=session.hs_conf.hybrid_scan_enabled
+                    index_manager,
+                    scan,
+                    hybrid_scan=session.hs_conf.hybrid_scan_enabled,
+                    rule_name="FilterIndexRule",
                 )
                 if not candidates:
                     record_rule_decision(

@@ -222,8 +222,12 @@ class JoinIndexRule:
                 )
 
                 hybrid = session.hs_conf.hybrid_scan_enabled
-                l_candidates = get_candidate_indexes(index_manager, l_scan, hybrid)
-                r_candidates = get_candidate_indexes(index_manager, r_scan, hybrid)
+                l_candidates = get_candidate_indexes(
+                    index_manager, l_scan, hybrid, rule_name="JoinIndexRule"
+                )
+                r_candidates = get_candidate_indexes(
+                    index_manager, r_scan, hybrid, rule_name="JoinIndexRule"
+                )
                 l_usable = _usable_indexes(l_candidates, lkeys, l_required, cs)
                 r_usable = _usable_indexes(r_candidates, rkeys, r_required, cs)
                 compatible = _compatible_pairs(l_usable, r_usable, l_to_r, cs)

@@ -23,6 +23,7 @@ def get_candidate_indexes(
     hybrid_scan: bool = False,
     kind: str = "CoveringIndex",
     deletes_without_lineage_ok: bool = False,
+    rule_name: Optional[str] = None,
 ) -> List["CandidateIndex"]:
     """ACTIVE indexes applicable to `plan` (normally a relation node).
 
@@ -82,9 +83,21 @@ def get_candidate_indexes(
             return None
         return appended, sorted(deleted)
 
+    from ..index import quarantine
+
     out: List[CandidateIndex] = []
     for e in index_manager.get_indexes([states.ACTIVE]):
         if e.kind != kind or not e.created:
+            continue
+        if quarantine.is_quarantined(e.name):
+            # A corrupt data file condemned this index (`index/quarantine`):
+            # it sits out until rebuilt, and the skip is attributed to the
+            # asking rule so the fallback is visible in the metrics snapshot.
+            from ..telemetry import metrics
+
+            metrics.counter(
+                f"rule.{rule_name}.quarantined" if rule_name else "rule.quarantined"
+            ).inc()
             continue
         if not _hash_scheme_compatible(e):
             # Built under a different bucket/sketch hash scheme: bucket

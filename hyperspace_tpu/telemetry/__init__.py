@@ -1,4 +1,4 @@
-from . import accounting, compile_log, exporter, metrics, tracing  # noqa: F401
+from . import accounting, compile_log, exporter, faults, metrics, tracing  # noqa: F401
 from .event_logging import (  # noqa: F401
     EventLogger,
     EventLoggerFactory,

@@ -44,11 +44,32 @@ import threading
 import warnings
 from typing import Dict, Optional
 
+from ..exceptions import CompileTimeoutError
+from . import faults as _faults
 from . import metrics as _metrics
 from . import tracing as _tracing
 
 ENV_STORM_THRESHOLD = "HYPERSPACE_COMPILE_STORM_THRESHOLD"
 _DEFAULT_STORM_THRESHOLD = 32
+
+#: Compile/dispatch deadline per `observed_jit` call (seconds; unset/0 = off).
+#: The r05 TPU bench hung 2400 s inside ONE `bucket_id` compile with no
+#: deadline and no attribution; with this set, the call runs under a watchdog
+#: and a runaway compile becomes a classified, program-labeled
+#: `CompileTimeoutError` instead of a silent hang. Whether a given call WILL
+#: compile is not knowable up front, so the watchdog wraps every call: the
+#: ~0.1 ms thread handoff per dispatch prices this as a build/bench/first-
+#: deploy supervision knob, not a hot-serving default (docs/configuration.md).
+ENV_COMPILE_TIMEOUT_S = "HYPERSPACE_COMPILE_TIMEOUT_S"
+
+_DEADLINE_EXCEEDED = _metrics.counter("xla.compiles.deadline_exceeded")
+
+
+def compile_timeout_s() -> float:
+    try:
+        return max(0.0, float(os.environ.get(ENV_COMPILE_TIMEOUT_S, "") or 0.0))
+    except ValueError:
+        return 0.0
 
 _EVENT_BACKEND_COMPILE = "/jax/core/compile/backend_compile_duration"
 _EVENT_JAXPR_TRACE = "/jax/core/compile/jaxpr_trace_duration"
@@ -191,8 +212,15 @@ def observed_jit(fun=None, *, label: Optional[str] = None, **jit_kwargs):
     # callable's cache growing across a call means that call compiled.
     cache_size = getattr(jitted, "_cache_size", None) if not monitoring_live else None
 
+    from .. import resilience as _resilience
+
     @functools.wraps(fun)
     def wrapper(*args, **kwargs):
+        # Reliability hooks BEFORE dispatch: the `device.compile` fault point,
+        # and the ambient query deadline — a deadlined query must not start
+        # another potentially-compiling program.
+        _faults.check("device.compile")
+        _resilience.check_deadline(lbl)
         stack = getattr(_local, "stack", None)
         if stack is None:
             stack = _local.stack = []
@@ -203,6 +231,9 @@ def observed_jit(fun=None, *, label: Optional[str] = None, **jit_kwargs):
             before = cache_size()
             t0 = _time.monotonic()
         try:
+            limit = compile_timeout_s()
+            if limit > 0.0:
+                return _call_under_deadline(jitted, args, kwargs, lbl, limit)
             return jitted(*args, **kwargs)
         finally:
             stack.pop()
@@ -220,6 +251,49 @@ def observed_jit(fun=None, *, label: Optional[str] = None, **jit_kwargs):
 
     wrapper._hyperspace_jitted = jitted  # the underlying jit object (tests)
     return wrapper
+
+
+def _call_under_deadline(fn, args, kwargs, label: str, limit_s: float):
+    """Run one jitted call on a watchdog thread with a hard deadline. On
+    timeout the caller gets a classified, program-attributed
+    `CompileTimeoutError`; the abandoned daemon thread may finish its compile
+    in the background (XLA compiles are not preemptible), but the query is no
+    longer hostage to it. The worker pushes the program label onto ITS OWN
+    thread-local stack so the monitoring listener still attributes the
+    compile correctly."""
+    result: list = []
+    err: list = []
+
+    def run() -> None:
+        stack = getattr(_local, "stack", None)
+        if stack is None:
+            stack = _local.stack = []
+        stack.append(label)
+        try:
+            result.append(fn(*args, **kwargs))
+        except BaseException as e:  # re-raised on the calling thread
+            err.append(e)
+        finally:
+            stack.pop()
+
+    t = threading.Thread(
+        target=run, name=f"hyperspace-compile-watchdog:{label}", daemon=True
+    )
+    t.start()
+    t.join(limit_s)
+    if t.is_alive():
+        _DEADLINE_EXCEEDED.inc()
+        raise CompileTimeoutError(
+            f"program '{label}' did not complete within "
+            f"HYPERSPACE_COMPILE_TIMEOUT_S={limit_s:g}s — likely a runaway XLA "
+            "compile (a shape stream that is not pow2-quantized recompiles per "
+            "call); see docs/reliability.md",
+            elapsed_s=limit_s,
+            timeout_s=limit_s,
+        )
+    if err:
+        raise err[0]
+    return result[0]
 
 
 def program_summary() -> dict:

@@ -39,6 +39,7 @@ import atexit
 import json
 import math
 import os
+import signal
 import sys
 import threading
 import time
@@ -50,7 +51,10 @@ ENV_METRICS_FILE = "HYPERSPACE_METRICS_FILE"
 ENV_METRICS_INTERVAL = "HYPERSPACE_METRICS_INTERVAL_S"
 _DEFAULT_INTERVAL_S = 10.0
 
-_lock = threading.Lock()
+# RLock: the SIGTERM/SIGINT handler runs stop() on the main thread, and a
+# signal can land while the main thread itself holds this lock (an idempotent
+# start()/stop() call) — a plain Lock would self-deadlock the handler.
+_lock = threading.RLock()
 _exporter: Optional["MetricsExporter"] = None
 
 
@@ -105,6 +109,12 @@ class MetricsExporter:
             "ledgers": accounting.drain_pending(),
             "compile_programs": compile_log.program_summary(),
         }
+        # Compact reliability rollup (the raw counters also ride `snapshot`):
+        # what a retry-storm alert or `tools/bench_compare.py` gate reads —
+        # ONE schema shared with `bench_detail.reliability`.
+        from .. import resilience as _resilience
+
+        out["reliability"] = _resilience.reliability_rollup(out["snapshot"])
         dev = _device_live_bytes()
         if dev is not None:
             out["device_live_bytes"] = dev
@@ -141,6 +151,41 @@ def running() -> bool:
     return e is not None and e.running
 
 
+_signals_installed = False
+
+
+def _install_signal_handlers() -> None:
+    """Chain SIGTERM/SIGINT so a KILLED (not just exited) serving process
+    still flushes its ``final: true`` frame — atexit alone loses the last
+    interval of frames on a signal death. The previous handler (or the
+    default action) runs after the flush, so termination semantics are
+    unchanged. Main-thread-only (the `signal` module's rule); non-main
+    callers keep the atexit-only behavior."""
+    global _signals_installed
+    if _signals_installed:
+        return
+    if threading.current_thread() is not threading.main_thread():
+        return
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            prev = signal.getsignal(sig)
+
+            def _handler(signum, frame, _prev=prev):
+                stop()
+                if callable(_prev):
+                    _prev(signum, frame)
+                elif _prev == signal.SIG_DFL:
+                    # Restore the default action and re-deliver, so the exit
+                    # status still reports death-by-signal.
+                    signal.signal(signum, signal.SIG_DFL)
+                    os.kill(os.getpid(), signum)
+
+            signal.signal(sig, _handler)
+        except (ValueError, OSError):
+            return  # not installable here (embedded interpreter, etc.)
+    _signals_installed = True
+
+
 def start(path: Optional[str] = None, interval_s: Optional[float] = None) -> bool:
     """Start the process exporter (idempotent: a live exporter wins). `path`
     defaults to ``HYPERSPACE_METRICS_FILE``; no path anywhere → False."""
@@ -158,7 +203,10 @@ def start(path: Optional[str] = None, interval_s: Optional[float] = None) -> boo
         except Exception:
             _exporter = None
             return False
-        return True
+    # Outside the module lock: a signal arriving the instant a handler is
+    # installed runs stop() on this same (main) thread.
+    _install_signal_handlers()
+    return True
 
 
 def stop(timeout: float = 5.0) -> None:

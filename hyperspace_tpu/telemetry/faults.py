@@ -1,0 +1,269 @@
+"""Deterministic, seeded fault injection: named fault points wired into CI.
+
+PRs 1/3/5 each pinned a per-subsystem fault contract (no partial index dir, no
+partial memo, no partial cache entry) by monkeypatching internals from tests.
+This module turns those ad-hoc patches into one system-wide discipline: the
+engine's lake-touching sites declare NAMED fault points, and a seeded registry
+decides per call whether to inject — so the chaos CI leg can run the full
+oracle equivalence suites under ambient 5% transient decode faults and assert
+byte-identical results.
+
+Fault points (each site calls ``faults.check("<point>")`` right before the
+real operation):
+
+- ``io.decode``    — a data/index file decode (`engine.io._read_one` /
+  `_read_row_groups_one`)
+- ``io.footer``    — a parquet footer parse (`engine.io._parse_footer_meta`)
+- ``storage.write``— a bucket/index/table file write (`engine.io.checked_write_table`)
+- ``log.write``    — an operation-log entry write (`IndexLogManagerImpl.write_log`)
+- ``pool.worker``  — a decode/build pool worker task body (worker-crash paths)
+- ``device.compile``— an `observed_jit` program dispatch (`telemetry.compile_log`)
+
+Configuration — ``HYPERSPACE_FAULTS`` (comma-separated specs) or the
+programmatic API (`configure` / `inject`, which take precedence over the env):
+
+    point:rate[:kind[:limit[:after]]]
+
+- ``rate``  — injection probability per eligible call (1.0 = every call).
+- ``kind``  — ``transient`` (default; raises `TransientError`, retry-eligible),
+  ``permanent`` (raises `PermanentError`), or ``hang``/``hang<secs>`` (sleeps
+  <secs> — default 30 — then proceeds; the window the SIGKILL crash tests aim at).
+- ``limit`` — max injections for this spec (blank/0 = unlimited).
+- ``after`` — skip the first N eligible calls (targets a specific call, e.g.
+  ``log.write:1:hang300:1:1`` hangs the SECOND log write = an action's end()).
+
+Determinism: decisions hash ``(seed, point, call_index)`` — the seed is
+``HYPERSPACE_FAULTS_SEED`` (default 0), the call index is the per-point call
+counter — so a serial run injects at exactly the same calls every time.
+Every injection ticks ``faults.injected`` + ``faults.<point>.injected`` and is
+charged to the active query ledger (``faults_injected``).
+
+Cost when off: one `os.environ` lookup per `check` (the same budget as the
+engine's other per-call env knobs).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import os
+import threading
+import time
+from typing import Dict, Iterator, List, Optional
+
+from ..exceptions import PermanentError, TransientError
+from . import accounting as _accounting
+from . import metrics as _metrics
+
+ENV_FAULTS = "HYPERSPACE_FAULTS"
+ENV_FAULTS_SEED = "HYPERSPACE_FAULTS_SEED"
+
+#: The named fault points the engine declares. `check` accepts only these —
+#: a typo'd point name must fail loudly in tests, not silently never fire.
+FAULT_POINTS = (
+    "io.decode",
+    "io.footer",
+    "storage.write",
+    "log.write",
+    "pool.worker",
+    "device.compile",
+)
+
+_INJECTED = _metrics.counter("faults.injected")
+
+_lock = threading.Lock()
+_programmatic: Optional[Dict[str, "FaultSpec"]] = None
+_env_raw: Optional[str] = None
+_env_parsed: Dict[str, "FaultSpec"] = {}
+# Per-point call counters live OUTSIDE the specs: reconfiguring (or the env
+# cache refreshing) must not reset call indices mid-run.
+_calls: Dict[str, int] = {}
+_injections: Dict[str, int] = {}
+
+
+class FaultSpec:
+    """One fault point's injection policy."""
+
+    __slots__ = ("point", "rate", "kind", "limit", "after", "hang_s")
+
+    def __init__(
+        self,
+        point: str,
+        rate: float,
+        kind: str = "transient",
+        limit: Optional[int] = None,
+        after: int = 0,
+        hang_s: float = 30.0,
+    ):
+        if point not in FAULT_POINTS:
+            raise ValueError(f"Unknown fault point '{point}'; known: {FAULT_POINTS}")
+        if kind.startswith("hang"):
+            suffix = kind[4:]
+            hang_s = float(suffix) if suffix else hang_s
+            kind = "hang"
+        if kind not in ("transient", "permanent", "hang"):
+            raise ValueError(f"Unknown fault kind '{kind}'")
+        self.point = point
+        self.rate = float(rate)
+        self.kind = kind
+        self.limit = limit if limit else None
+        self.after = int(after)
+        self.hang_s = hang_s
+
+
+def _parse_specs(raw: str) -> Dict[str, FaultSpec]:
+    out: Dict[str, FaultSpec] = {}
+    for item in raw.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        parts = item.split(":")
+        if len(parts) < 2:
+            raise ValueError(f"Bad fault spec '{item}' (need point:rate)")
+        point, rate = parts[0], float(parts[1])
+        kind = parts[2] if len(parts) > 2 and parts[2] else "transient"
+        limit = int(parts[3]) if len(parts) > 3 and parts[3] else None
+        after = int(parts[4]) if len(parts) > 4 and parts[4] else 0
+        out[point] = FaultSpec(point, rate, kind, limit, after)
+    return out
+
+
+def _seed() -> str:
+    return os.environ.get(ENV_FAULTS_SEED, "0") or "0"
+
+
+def _active_specs() -> Optional[Dict[str, FaultSpec]]:
+    """The effective spec map, or None when injection is fully off (the fast
+    path: one env read). Programmatic config wins over the env; the parsed env
+    value is cached against the raw string so repeated checks don't reparse."""
+    global _env_raw, _env_parsed
+    if _programmatic is not None:
+        return _programmatic or None
+    raw = os.environ.get(ENV_FAULTS)
+    if not raw:
+        return None
+    if raw != _env_raw:
+        with _lock:
+            if raw != _env_raw:
+                try:
+                    _env_parsed = _parse_specs(raw)
+                except ValueError as e:
+                    # A malformed spec surfaces as a CLASSIFIED config error:
+                    # a raw ValueError from here would be indistinguishable
+                    # from a parquet parse failure at the decode-layer guards
+                    # (and could bogusly quarantine a healthy index).
+                    from ..exceptions import HyperspaceException
+
+                    raise HyperspaceException(
+                        f"Bad {ENV_FAULTS} spec {raw!r}: {e}"
+                    ) from e
+                _env_raw = raw
+    return _env_parsed or None
+
+
+def _decide(point: str, n: int, rate: float) -> bool:
+    """Deterministic pseudo-uniform draw for call `n` of `point`."""
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0:
+        return False
+    h = hashlib.sha256(f"{_seed()}|{point}|{n}".encode()).digest()
+    return int.from_bytes(h[:8], "big") / float(1 << 64) < rate
+
+
+def check(point: str) -> None:
+    """The fault point hook: no-op unless a spec targets `point`, else count
+    the call and (per the seeded decision) inject — raise `TransientError` /
+    `PermanentError`, or sleep (``hang``) and proceed."""
+    specs = _active_specs()
+    if specs is None:
+        return
+    spec = specs.get(point)
+    if spec is None:
+        return
+    with _lock:
+        n = _calls.get(point, 0)
+        _calls[point] = n + 1
+        if n < spec.after:
+            return
+        if spec.limit is not None and _injections.get(point, 0) >= spec.limit:
+            return
+        fire = _decide(point, n, spec.rate)
+        if fire:
+            _injections[point] = _injections.get(point, 0) + 1
+    if not fire:
+        return
+    _INJECTED.inc()
+    _metrics.counter(f"faults.{point}.injected").inc()
+    _accounting.add("faults_injected", 1)
+    if spec.kind == "hang":
+        time.sleep(spec.hang_s)
+        return
+    msg = f"injected {spec.kind} fault at {point} (call #{n})"
+    if spec.kind == "permanent":
+        raise PermanentError(msg)
+    raise TransientError(msg)
+
+
+def configure(specs) -> None:
+    """Programmatic configuration (takes precedence over ``HYPERSPACE_FAULTS``):
+    a spec string in the env grammar, a list of `FaultSpec`s, or a dict
+    point → FaultSpec. Call counters are NOT reset (see `reset_counters`)."""
+    global _programmatic
+    if isinstance(specs, str):
+        parsed = _parse_specs(specs)
+    elif isinstance(specs, dict):
+        parsed = dict(specs)
+    else:
+        parsed = {s.point: s for s in specs}
+    with _lock:
+        _programmatic = parsed
+
+
+def clear() -> None:
+    """Drop the programmatic configuration (the env, if set, applies again)."""
+    global _programmatic
+    with _lock:
+        _programmatic = None
+
+
+def reset_counters() -> None:
+    """Zero the per-point call/injection counters (tests)."""
+    with _lock:
+        _calls.clear()
+        _injections.clear()
+
+
+def injected_count(point: Optional[str] = None) -> int:
+    with _lock:
+        if point is not None:
+            return _injections.get(point, 0)
+        return sum(_injections.values())
+
+
+def call_count(point: str) -> int:
+    with _lock:
+        return _calls.get(point, 0)
+
+
+@contextlib.contextmanager
+def inject(
+    point: str,
+    rate: float = 1.0,
+    kind: str = "transient",
+    limit: Optional[int] = None,
+    after: int = 0,
+) -> Iterator[None]:
+    """Test scope: inject at `point` for the duration, restoring the previous
+    configuration (programmatic or env) on exit."""
+    global _programmatic
+    with _lock:
+        prev = _programmatic
+        merged = dict(prev or {})
+        merged[point] = FaultSpec(point, rate, kind, limit, after)
+        _programmatic = merged
+    try:
+        yield
+    finally:
+        with _lock:
+            _programmatic = prev
