@@ -78,6 +78,17 @@ def _now():
     return time.time()
 
 
+def _tree_bytes(*roots) -> int:
+    """Total on-disk bytes under the given directory trees — the ONE walk
+    behind every source-size / GB/s denominator in this file."""
+    return sum(
+        os.path.getsize(os.path.join(r, f))
+        for root in roots
+        for r, _ds, fs in os.walk(root)
+        for f in fs
+    )
+
+
 def timed_p50(fn, n: int) -> float:
     times = []
     for _ in range(n):
@@ -324,11 +335,8 @@ def run_bench(deadline: float = None) -> dict:
                 2,
             )
             d["datagen_s"] = round(_now() - t0, 1)
-            d["source_bytes"] = sum(
-                os.path.getsize(os.path.join(r, f))
-                for tdir in ("lineitem", "orders", "part")
-                for r, _, fs in os.walk(os.path.join(base, tdir))
-                for f in fs
+            d["source_bytes"] = _tree_bytes(
+                *(os.path.join(base, t) for t in ("lineitem", "orders", "part"))
             )
 
         ph.run("datagen", gen_data, host_only=True)
@@ -481,6 +489,10 @@ def run_bench(deadline: float = None) -> dict:
         # -- scan pushdown: row-group pruning on clustered data (cold on/off
         #    splits + the row-group/byte counters that prove the prune)
         ph.run("scan_pushdown", lambda: d.update(_pushdown_section(s, base, col, runs, hs)))
+        # -- encoded execution: dictionary-code string keys kept as codes
+        #    through scan/build/join (cold on/off splits + effective GB/s +
+        #    the encoded/materialized byte counters that prove the path)
+        ph.run("encoded_exec", lambda: d.update(_encoded_section(s, base, col, runs, hs)))
         # Cache stats AFTER the variants: the hybrid-scan queries are the
         # per-file scan cache's real workload (query-time re-reads the higher
         # cache levels cannot hold).
@@ -728,6 +740,163 @@ def _pushdown_section(s, base, col, runs, hs) -> dict:
             os.environ[env_key] = saved
     out["io_pruning_totals"] = io_pruning_summary()
     return {"io_pruning": out}
+
+
+def _encoded_section(s, base, col, runs, hs) -> dict:
+    """Encoded execution's own shapes, on a dictionary-heavy string-keyed
+    source (moderate cardinality — exactly where keeping codes beats
+    flattening):
+
+    - a cold multi-file scan + string-key aggregate, measured with
+      ``HYPERSPACE_ENCODED_EXEC`` on vs off (the flatten fallback), with the
+      on-disk byte total → EFFECTIVE GB/s for both modes;
+    - a cold covering-index build on the string key (dictionary-preserving
+      bucket writes vs N-string decode per bucket);
+    - the indexed string-key join p50, warm, on vs off.
+
+    ``encoded_bytes`` carries the measured byte-split and per-column
+    counters of the ON runs — the proof the win is bytes not moved."""
+    from hyperspace_tpu import IndexConfig
+    from hyperspace_tpu.engine import io as _eio
+    from hyperspace_tpu.engine.physical import clear_device_memos
+    from hyperspace_tpu.engine.scan_cache import (
+        global_bucketed_cache,
+        global_concat_cache,
+        global_filtered_cache,
+        global_scan_cache,
+    )
+    from hyperspace_tpu.engine.table import Table as _T
+    from hyperspace_tpu.hyperspace import disable_hyperspace, enable_hyperspace
+    from hyperspace_tpu.telemetry import metrics
+
+    n = int(os.environ.get("BENCH_ENCODED_ROWS", 2_000_000))
+    n_dim = max(n // 8, 1000)
+    card = max(min(n // 20, 100_000), 100)
+    files = 4
+    enc_dir = os.path.join(base, "events_enc")
+    dim_dir = os.path.join(base, "dim_enc")
+    rng = np.random.RandomState(13)
+    dictionary = np.asarray([f"cust#{i:08d}" for i in range(card)])
+    for i in range(files):
+        per = n // files
+        _eio.write_parquet(
+            _T.from_pydict(
+                {
+                    "k": dictionary[rng.randint(0, card, per)].tolist(),
+                    "v": rng.randint(0, 1000, per).astype(np.int64).tolist(),
+                }
+            ),
+            os.path.join(enc_dir, f"part-{i:05d}.parquet"),
+        )
+    _eio.write_parquet(
+        _T.from_pydict(
+            {
+                "k": dictionary[rng.randint(0, card, n_dim)].tolist(),
+                "w": rng.randint(0, 100, n_dim).astype(np.int64).tolist(),
+            }
+        ),
+        os.path.join(dim_dir, "part-00000.parquet"),
+    )
+    # The scan query reads enc_dir ONLY — its effective-GB/s denominator must
+    # not be credited with the dim file's bytes.
+    scan_src_bytes = _tree_bytes(enc_dir)
+    src_bytes = scan_src_bytes + _tree_bytes(dim_dir)
+
+    def q_scan():
+        return (
+            s.read.parquet(enc_dir)
+            .group_by("k")
+            .agg(total=("v", "sum"), cnt=("v", "count"))
+        )
+
+    def q_join():
+        return s.read.parquet(enc_dir).join(
+            s.read.parquet(dim_dir), col("k") == col("k")
+        )
+
+    env_key = "HYPERSPACE_ENCODED_EXEC"
+    saved = os.environ.get(env_key)
+
+    def clear():
+        global_scan_cache().clear()
+        global_concat_cache().clear()
+        global_filtered_cache().clear()
+        global_bucketed_cache().clear()
+        clear_device_memos()
+
+    def counters():
+        return {
+            k: metrics.counter(name).value
+            for k, name in (
+                ("bytes_encoded_kept", "io.pruning.bytes_encoded_kept"),
+                ("bytes_materialized", "io.pruning.bytes_materialized"),
+                ("columns_encoded", "io.encoded.columns_encoded"),
+                ("columns_flattened", "io.encoded.columns_flattened"),
+                ("columns_dict_written", "io.encoded.columns_dict_written"),
+                ("scan_encoded_hits", "cache.scan.encoded_hits"),
+            )
+        }
+
+    out = {}
+    try:
+        disable_hyperspace(s)
+        for label, flag in (("on", "1"), ("off", "0")):
+            os.environ[env_key] = flag
+            clear()
+            c0 = counters()
+            t0 = _now()
+            q_scan().collect()
+            dt = _now() - t0
+            out[f"scan_cold_{label}_s"] = round(dt, 3)
+            out[f"scan_cold_{label}_gbps"] = round(
+                scan_src_bytes / max(dt, 1e-9) / 1e9, 3
+            )
+            if label == "on":
+                c1 = counters()
+                out["scan_counters"] = {k: c1[k] - c0[k] for k in c1}
+        os.environ[env_key] = "1"
+        q_scan().collect()  # warm per-file cache for the steady-state p50
+        out["scan_warm_p50_s"] = round(timed_p50(lambda: q_scan().collect(), runs), 4)
+
+        for label, flag in (("on", "1"), ("off", "0")):
+            os.environ[env_key] = flag
+            clear()
+            c0 = counters()
+            t0 = _now()
+            hs.create_index(
+                s.read.parquet(enc_dir), IndexConfig(f"encK{label}", ["k"], ["v"])
+            )
+            hs.create_index(
+                s.read.parquet(dim_dir), IndexConfig(f"encD{label}", ["k"], ["w"])
+            )
+            out[f"build_cold_{label}_s"] = round(_now() - t0, 3)
+            if label == "on":
+                out["build_counters"] = {
+                    k: v - c0[k] for k, v in counters().items()
+                }
+            enable_hyperspace(s)
+            clear()
+            rows = q_join().count()  # cold indexed pass (also correctness probe)
+            out.setdefault("join_rows", rows)
+            assert out["join_rows"] == rows, (out["join_rows"], rows)
+            out[f"join_p50_{label}_s"] = round(
+                timed_p50(lambda: q_join().count(), runs), 4
+            )
+            disable_hyperspace(s)
+            hs.delete_index(f"encK{label}"), hs.vacuum_index(f"encK{label}")
+            hs.delete_index(f"encD{label}"), hs.vacuum_index(f"encD{label}")
+        out["src_bytes"] = src_bytes
+        out["scan_src_bytes"] = scan_src_bytes
+        # Rows actually written: files * (n // files) — the floor division
+        # drops a remainder when BENCH_ENCODED_ROWS isn't a multiple of files.
+        out["rows"] = files * (n // files)
+        out["key_cardinality"] = card
+    finally:
+        if saved is None:
+            os.environ.pop(env_key, None)
+        else:
+            os.environ[env_key] = saved
+    return {"encoded_exec": out}
 
 
 def _cache_section() -> dict:

@@ -1,0 +1,248 @@
+"""Encoded execution: dictionary-code string columns end to end.
+
+The engine's `Column` representation has always been codes + sorted
+dictionary (`engine/table.py`), and every key-touching kernel already runs in
+code space — hashing gathers a per-dictionary blake2b table through the codes
+(`ops/hashing.host_hash_dictionary`), the build's partition sort orders by
+codes (`ops/partition.host_sort_perm`), and join verification compares
+union-aligned codes (`physical._aligned_key_codes`). What DIDN'T run encoded
+was the lake boundary: every parquet read flattened dictionary-encoded string
+columns to N python strings and re-derived the dictionary with an
+O(N log N) string sort (`io._arrow_to_table` → `Column.from_values`), and
+every index bucket write decoded N strings back out (`io.table_to_arrow`).
+At CPU_BENCH_r05 shapes that flatten/re-sort IS the scan — ~0.7 % of machine
+bandwidth reached the kernels (ROADMAP item 4).
+
+This module is the home of the encoded lake boundary:
+
+- **Read** (`dictionary_array_to_column`): a parquet column chunk that is
+  dictionary-encoded on disk (the footer's `has_dictionary_page`, recorded
+  per column by `io.footer_metadata`) is read with pyarrow's
+  ``read_dictionary`` and converted to a `Column` entirely in code space:
+  O(N) integer remaps plus one O(D log D) sort of the D *distinct* values —
+  never an O(N) string materialization. The result is byte-identical to the
+  flatten path (same sorted-unique dictionary of PRESENT values, same codes,
+  same validity), pinned by tests/test_encoded_exec.py.
+- **Write** (`dictionary_arrow_array`): string columns encode to parquet as
+  compacted `pa.DictionaryArray`s — D distinct strings cross the arrow
+  boundary instead of N. Both index writers (serial `table_to_arrow` and the
+  pipelined `_BucketWriter`) funnel through this ONE helper, so the
+  serial == pipelined byte-identity contract holds with the flag on or off.
+- **Fallback policy**: a column that isn't dictionary-encoded on disk, or
+  whose combined dictionary exceeds ``HYPERSPACE_ENCODED_DICT_MAX`` (near-
+  unique strings: code space stops paying), silently takes the flatten path
+  — per column, per file. ``HYPERSPACE_ENCODED_EXEC=0`` disables the whole
+  path: reads flatten and writes decode exactly as before (the byte-identical
+  decoded oracle, same contract style as ``HYPERSPACE_SCAN_PUSHDOWN=0``).
+
+Accounting: `io.pruning.bytes_encoded_kept` counts bytes that entered the
+engine still encoded (codes + dictionary), `io.pruning.bytes_materialized`
+counts bytes flattened to raw values — together the honest denominator of
+the bench's effective-GB/s number (both mirrored into the per-query ledger
+and rendered by ``explain(analyze=True)``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from ..telemetry import accounting as _accounting
+from ..telemetry import metrics as _metrics
+
+#: Master switch. Default ON; ``0`` restores the decoded lake boundary
+#: byte-for-byte (reads flatten + re-sort, writes decode N strings).
+ENV_ENCODED_EXEC = "HYPERSPACE_ENCODED_EXEC"
+
+#: Columns whose COMBINED per-file dictionary exceeds this many entries fall
+#: back to the flatten path: near-unique strings make the dictionary itself
+#: the data, and code-space conversion stops beating the O(N) decode.
+ENV_ENCODED_DICT_MAX = "HYPERSPACE_ENCODED_DICT_MAX"
+_DEFAULT_ENCODED_DICT_MAX = 1 << 20
+
+
+def encoded_exec_enabled() -> bool:
+    """Default ON; ``HYPERSPACE_ENCODED_EXEC=0`` is the byte-identical
+    decoded fallback (pinned by tests/test_encoded_exec.py)."""
+    return os.environ.get(ENV_ENCODED_EXEC, "") != "0"
+
+
+def encoded_dict_max() -> int:
+    """Dictionary-entry ceiling of the encoded read path (≥1)."""
+    return max(
+        1,
+        int(
+            os.environ.get(ENV_ENCODED_DICT_MAX, _DEFAULT_ENCODED_DICT_MAX)
+            or _DEFAULT_ENCODED_DICT_MAX
+        ),
+    )
+
+
+# Per-column decode outcomes (one tick per column per real decode — cache
+# hits never inflate these) and the byte split behind the bench's effective
+# GB/s: encoded_kept = bytes that entered the engine still as codes +
+# dictionary; materialized = bytes flattened to raw value arrays.
+COLUMNS_ENCODED = _metrics.counter("io.encoded.columns_encoded")
+COLUMNS_FLATTENED = _metrics.counter("io.encoded.columns_flattened")
+COLUMNS_DICT_WRITTEN = _metrics.counter("io.encoded.columns_dict_written")
+BYTES_ENCODED_KEPT = _metrics.counter("io.pruning.bytes_encoded_kept")
+BYTES_MATERIALIZED = _metrics.counter("io.pruning.bytes_materialized")
+# Join-verify dictionary reconciliation: both sides sharing one dictionary
+# compare codes directly; a mismatch pays the union re-encode once per pair.
+VERIFY_SHARED_DICT = _metrics.counter("encoded.verify.shared_dict")
+VERIFY_REALIGNED = _metrics.counter("encoded.verify.realigned")
+
+
+def column_nbytes(c) -> int:
+    """TRUE in-memory size of a Column: codes + dictionary + validity. The
+    ONE size definition shared by the encoded_kept counter and the scan-cache
+    byte charge — keep them from diverging."""
+    total = c.data.nbytes
+    if c.dictionary is not None:
+        total += c.dictionary.nbytes
+    if c.validity is not None:
+        total += c.validity.nbytes
+    return total
+
+
+def record_encoded_kept(nbytes: int) -> None:
+    BYTES_ENCODED_KEPT.inc(nbytes)
+    _accounting.add("bytes_encoded_kept", nbytes)
+
+
+def record_materialized(nbytes: int) -> None:
+    BYTES_MATERIALIZED.inc(nbytes)
+    _accounting.add("bytes_materialized", nbytes)
+
+
+def _stringish_value_type(t: "pa.DataType") -> bool:
+    return pa.types.is_string(t) or pa.types.is_large_string(t)
+
+
+def dict_read_columns(meta, columns: Optional[List[str]]) -> List[str]:
+    """The subset of a read's columns to request AS DICTIONARY from pyarrow —
+    the per-column encoded-execution decision, made from the PR-5 footer
+    cache's per-column-chunk encoding facts (`io.footer_metadata` records
+    `dict_cols`: every row-group chunk carries a dictionary page AND the
+    value type is string). Empty when the flag is off or nothing qualifies —
+    the read then runs the plain decoded path untouched."""
+    if not encoded_exec_enabled() or meta is None:
+        return []
+    dict_cols = getattr(meta, "dict_cols", None)
+    if not dict_cols:
+        return []
+    names = columns if columns is not None else meta.names
+    return [c for c in names if dict_cols.get(c)]
+
+
+def _present_codes(valid_codes: np.ndarray, dict_len: int) -> np.ndarray:
+    """Ascending distinct codes among `valid_codes` — a presence mask over the
+    D dictionary slots, O(N + D), never an O(N log N) sort of the N row codes
+    (this runs per column per cold file read and per bucket write)."""
+    if not len(valid_codes):
+        return np.empty(0, np.int64)
+    seen = np.zeros(dict_len, bool)
+    seen[valid_codes] = True
+    return np.flatnonzero(seen)
+
+
+def dictionary_array_to_column(arr):
+    """Code-space conversion of one arrow dictionary column → engine `Column`,
+    or None to fall back to the flatten path (non-string values, dictionary
+    over the size knob). BYTE-IDENTICAL to the flatten path by construction:
+
+    - dictionary = sorted unique of the values PRESENT in the data (plus the
+      ``""`` null-fill when the column has nulls) — exactly what
+      ``np.unique`` over the filled flat values produces;
+    - codes = each row's position in that sorted dictionary, with null slots
+      canonicalized to 0 (the same refill `io._arrow_to_table` applies);
+    - validity = the arrow null mask.
+
+    Work: O(N) integer ops + O(D log D) string sort over the D distinct
+    values. The N string objects are never materialized."""
+    from .table import Column
+    from .schema import STRING
+
+    if isinstance(arr, pa.ChunkedArray):
+        if not _stringish_value_type(arr.type.value_type):
+            return None
+        # The size knob must bail BEFORE the O(N) chunk unification: summed
+        # per-chunk dictionary sizes bound the unified size from above, so a
+        # near-unique column — what the knob exists to exempt — never pays
+        # combine_chunks only to fall back anyway. (Conservative: chunks
+        # sharing values may unify under the knob yet flatten here; the
+        # fallback is byte-identical, so only the routing differs.)
+        if sum(len(c.dictionary) for c in arr.chunks) > encoded_dict_max():
+            return None
+        arr = arr.combine_chunks()  # unifies per-chunk dictionaries
+    elif not _stringish_value_type(arr.type.value_type):
+        return None
+    if len(arr.dictionary) > encoded_dict_max():
+        return None
+
+    validity = None
+    indices = arr.indices
+    if arr.null_count > 0:
+        validity = ~np.asarray(arr.is_null().to_numpy(zero_copy_only=False))
+        indices = indices.fill_null(0)
+    codes = np.asarray(indices)
+    dvals = arr.dictionary.to_numpy(zero_copy_only=False)
+    # Same stringification the flatten path applies to its object array —
+    # but over D entries, not N.
+    dvals = (
+        np.empty(0, dtype="<U1")
+        if len(dvals) == 0
+        else np.asarray([str(x) for x in dvals])
+    )
+    # Present values come from VALID slots only: null slots' filled indices
+    # are representation noise (an all-null column may even carry an EMPTY
+    # disk dictionary), and the decoded path's uniquing sees the null fill
+    # "" — appended below — not the value a null slot happened to sit on.
+    valid_codes = codes if validity is None else codes[validity]
+    present = _present_codes(valid_codes, len(dvals))
+    vals = dvals[present]
+    if validity is not None:
+        # The decoded path fills nulls with "" BEFORE uniquing, so the fill
+        # value is part of the dictionary whenever the column has nulls.
+        vals = np.concatenate([vals, np.asarray([""], dtype=vals.dtype)])
+    sorted_dict, inv = np.unique(vals, return_inverse=True)
+    remap = np.zeros(max(len(dvals), 1), np.int32)
+    remap[present] = inv[: len(present)].astype(np.int32)
+    new_codes = remap[codes].astype(np.int32, copy=False)
+    if validity is not None:
+        new_codes[~validity] = 0  # canonical null fill (matches from_values)
+    col = Column(STRING, new_codes, sorted_dict, validity)
+    col._encoded_read = True  # cache marker: this column never flattened
+    return col
+
+
+def dictionary_arrow_array(
+    codes: np.ndarray, dictionary: np.ndarray, mask: Optional[np.ndarray]
+) -> "pa.DictionaryArray":
+    """Compacted arrow dictionary array of one string column slice — THE
+    write-side primitive shared by `io.table_to_arrow` and the pipelined
+    `_BucketWriter` (the serial == pipelined byte-identity contract rides on
+    there being exactly one implementation). Compaction matters: a bucket
+    slice's codes point into the full union dictionary, and writing that
+    dictionary verbatim would replicate every distinct value of the TABLE
+    into every `part-<bucket>` file.
+
+    Null slots are EXCLUDED from the present-value set and canonicalized to
+    index 0: the two writers reach here with different code values under
+    their masks (the pipelined gather round-trips codes through arrow nulls),
+    and the written bytes must not depend on that invisible difference."""
+    valid_codes = codes if mask is None else codes[~mask]
+    present = _present_codes(valid_codes, len(dictionary))
+    sub = dictionary[present]  # ascending subset of a sorted dict stays sorted
+    remap = np.zeros(max(len(dictionary), 1), np.int32)
+    remap[present] = np.arange(len(present), dtype=np.int32)
+    new_codes = remap[codes]
+    if mask is not None:
+        new_codes[mask] = 0
+    COLUMNS_DICT_WRITTEN.inc()
+    return pa.DictionaryArray.from_arrays(
+        pa.array(new_codes, mask=mask), pa.array(sub)
+    )

@@ -12,6 +12,15 @@ row-group granularity through those zone maps: only qualifying row groups
 decode (`pruned_file_table`), cached under selection-aware keys.
 ``HYPERSPACE_SCAN_PUSHDOWN=0`` disables all of it — the byte-identical
 whole-file fallback.
+
+Encoded execution (ISSUE 8): dictionary-encoded string columns — identified
+per column chunk from the same footer cache (`FileFooterMeta.dict_cols`) —
+are read with pyarrow's ``read_dictionary`` and converted to engine columns
+in CODE SPACE (`engine.encoding.dictionary_array_to_column`), and string
+columns write back out as compacted arrow dictionary arrays
+(`table_to_arrow(encode_dictionaries=True)`); the N decoded strings never
+materialize at either boundary. ``HYPERSPACE_ENCODED_EXEC=0`` is the
+byte-identical decoded fallback (docs/encoded-execution.md).
 """
 
 from __future__ import annotations
@@ -36,6 +45,7 @@ from ..telemetry import accounting as _accounting
 from ..telemetry import faults as _faults
 from ..telemetry import metrics as _metrics
 from ..util.path_utils import is_data_path
+from . import encoding as _encoding
 from .schema import BOOL, FLOAT32, FLOAT64, INT32, INT64, STRING, Field, Schema
 from .table import Column, Table
 
@@ -172,6 +182,19 @@ def _arrow_to_table(at: pa.Table) -> Table:
     cols: Dict[str, Column] = {}
     for name in at.column_names:
         arr = at.column(name)
+        if pa.types.is_dictionary(arr.type) and _encoding.encoded_exec_enabled():
+            # Encoded execution: a dictionary-typed arrow column converts in
+            # CODE SPACE (O(N) int remap + O(D log D) dict sort) — the N
+            # string objects are never materialized. Byte-identical to the
+            # flatten path below; None = fall back (non-string values or a
+            # dictionary over HYPERSPACE_ENCODED_DICT_MAX).
+            c = _encoding.dictionary_array_to_column(arr)
+            if c is not None:
+                _encoding.COLUMNS_ENCODED.inc()
+                _encoding.record_encoded_kept(_encoding.column_nbytes(c))
+                cols[name] = c
+                continue
+            _encoding.COLUMNS_FLATTENED.inc()
         if pa.types.is_temporal(arr.type):
             # Dates/timestamps ride as strings (CSV/JSON readers infer them; the
             # engine's type system keeps them lexicographically ordered strings).
@@ -200,6 +223,10 @@ def _arrow_to_table(at: pa.Table) -> Table:
                 if len(np_arr) == 0
                 else np.asarray([str(x) for x in np_arr])
             )
+        # Materialized half of the byte split: this column crossed the lake
+        # boundary as flat raw values (for strings, the full N-value array
+        # the encoded path avoids).
+        _encoding.record_materialized(np_arr.nbytes)
         c = Column.from_values(np_arr)
         if validity is not None:
             # Re-apply canonical fills in code/data space (from_values saw fills).
@@ -215,7 +242,36 @@ def _read_one(path: str, file_format: str, columns: Optional[List[str]] = None) 
     if file_format == "delta":
         file_format = "parquet"  # delta data files are parquet
     if file_format == "parquet":
-        return _arrow_to_table(pq.read_table(path, columns=columns))
+        if not _encoding.encoded_exec_enabled():
+            return _arrow_to_table(pq.read_table(path, columns=columns))
+        # Encoded execution: the per-column dictionary-read choice comes from
+        # the footer cache's encoding facts (`FileFooterMeta.dict_cols`). A
+        # WARM footer decides with no file open at all; a cache miss parses
+        # from THIS read's own open — so a file that takes no dictionary read
+        # (numeric-only index buckets, plain-encoded strings) costs exactly
+        # ONE open, same as the decoded path, and its zone maps land in the
+        # cache for free. Only a file that really reads dictionary pays a
+        # second open (dwarfed by the decode it avoids), and only when cold.
+        from .scan_cache import global_scan_cache
+
+        meta = global_scan_cache().get_meta(path)
+        if meta is not None:
+            _FOOTER_HITS.inc()  # the same accounting footer_metadata would do
+            rd = _encoding.dict_read_columns(meta, columns)
+            if rd:
+                return _arrow_to_table(
+                    pq.read_table(path, columns=columns, read_dictionary=rd)
+                )
+            with pq.ParquetFile(path) as pf:
+                return _arrow_to_table(pf.read(columns=columns))
+        with pq.ParquetFile(path) as pf:
+            meta = footer_metadata(path, file_format, _pf=pf)
+            rd = _encoding.dict_read_columns(meta, columns)
+            if not rd:
+                return _arrow_to_table(pf.read(columns=columns))
+        return _arrow_to_table(
+            pq.read_table(path, columns=columns, read_dictionary=rd)
+        )
     if file_format == "orc":
         # Reference format whitelist includes ORC (LogicalPlanSerDeUtils.scala:223-243).
         from pyarrow import orc as pa_orc
@@ -280,15 +336,19 @@ class RowGroupMeta:
 
 class FileFooterMeta:
     """One parquet file's footer facts: row count, arrow schema (for empty
-    reads and columns=None name order), and the row-group zone maps."""
+    reads and columns=None name order), the row-group zone maps, and
+    `dict_cols` — per column, whether EVERY row-group chunk is
+    dictionary-encoded on disk (string values only): the fact the encoded
+    execution path reads to choose codes-through vs flatten per column."""
 
-    __slots__ = ("num_rows", "names", "arrow_schema", "row_groups")
+    __slots__ = ("num_rows", "names", "arrow_schema", "row_groups", "dict_cols")
 
-    def __init__(self, num_rows, names, arrow_schema, row_groups):
+    def __init__(self, num_rows, names, arrow_schema, row_groups, dict_cols=None):
         self.num_rows = num_rows
         self.names = names
         self.arrow_schema = arrow_schema
         self.row_groups = row_groups
+        self.dict_cols = dict_cols or {}
 
 
 def _stat_value(v):
@@ -302,43 +362,67 @@ def _stat_value(v):
     return v
 
 
-def _parse_footer_meta(path: str) -> FileFooterMeta:
+def _parse_footer_meta(path: str, pf: Optional["pq.ParquetFile"] = None) -> FileFooterMeta:
+    """`pf` reuses a caller's already-open handle (the cold decode path parses
+    the footer from the SAME open that will serve the read — one footer open
+    per cold file, not two); the caller keeps ownership of its handle."""
     from .pushdown import ZoneStats
 
     _faults.check("io.footer")
-    with pq.ParquetFile(path) as pf:
-        md = pf.metadata
-        schema = pf.schema_arrow
-        names = list(schema.names)
-        # Column-chunk order == schema leaf order; zone maps are recorded only
-        # for FLAT schemas (leaf count == field count) — nested leaves would
-        # mis-align names, and the engine reads flat tables anyway.
-        flat = md.num_columns == len(names)
-        row_groups: List[RowGroupMeta] = []
-        for i in range(md.num_row_groups):
-            rg = md.row_group(i)
-            stats: Dict[str, object] = {}
-            col_bytes: Dict[str, int] = {}
-            if flat:
-                for j in range(rg.num_columns):
-                    chunk = rg.column(j)
-                    col_bytes[names[j]] = int(chunk.total_uncompressed_size)
-                    st = chunk.statistics
-                    if st is None:
-                        stats[names[j]] = ZoneStats()
-                        continue
-                    mn = mx = None
-                    has = bool(st.has_min_max)
-                    if has:
-                        mn = _stat_value(st.min)
-                        mx = _stat_value(st.max)
-                        has = mn is not None and mx is not None
-                    nulls = st.null_count if st.has_null_count else None
-                    stats[names[j]] = ZoneStats(mn, mx, has, nulls)
-            row_groups.append(
-                RowGroupMeta(rg.num_rows, rg.total_byte_size, stats, col_bytes)
-            )
-    return FileFooterMeta(md.num_rows, names, schema, row_groups)
+    if pf is not None:
+        return _footer_meta_from_open(pf)
+    with pq.ParquetFile(path) as f:
+        return _footer_meta_from_open(f)
+
+
+def _footer_meta_from_open(pf: "pq.ParquetFile") -> FileFooterMeta:
+    from .pushdown import ZoneStats
+
+    md = pf.metadata
+    schema = pf.schema_arrow
+    names = list(schema.names)
+    # Column-chunk order == schema leaf order; zone maps are recorded only
+    # for FLAT schemas (leaf count == field count) — nested leaves would
+    # mis-align names, and the engine reads flat tables anyway.
+    flat = md.num_columns == len(names)
+    # Per-column encoded-execution eligibility: string values AND a
+    # dictionary page in EVERY row-group chunk (the encodings tuple always
+    # lists PLAIN for the dictionary page itself, so `has_dictionary_page`
+    # is the reliable discriminator).
+    dict_cols: Dict[str, bool] = {}
+    if flat:
+        for f in schema:
+            vt = f.type.value_type if pa.types.is_dictionary(f.type) else f.type
+            dict_cols[f.name] = bool(
+                pa.types.is_string(vt) or pa.types.is_large_string(vt)
+            ) and md.num_row_groups > 0
+    row_groups: List[RowGroupMeta] = []
+    for i in range(md.num_row_groups):
+        rg = md.row_group(i)
+        stats: Dict[str, object] = {}
+        col_bytes: Dict[str, int] = {}
+        if flat:
+            for j in range(rg.num_columns):
+                chunk = rg.column(j)
+                col_bytes[names[j]] = int(chunk.total_uncompressed_size)
+                if not chunk.has_dictionary_page:
+                    dict_cols[names[j]] = False
+                st = chunk.statistics
+                if st is None:
+                    stats[names[j]] = ZoneStats()
+                    continue
+                mn = mx = None
+                has = bool(st.has_min_max)
+                if has:
+                    mn = _stat_value(st.min)
+                    mx = _stat_value(st.max)
+                    has = mn is not None and mx is not None
+                nulls = st.null_count if st.has_null_count else None
+                stats[names[j]] = ZoneStats(mn, mx, has, nulls)
+        row_groups.append(
+            RowGroupMeta(rg.num_rows, rg.total_byte_size, stats, col_bytes)
+        )
+    return FileFooterMeta(md.num_rows, names, schema, row_groups, dict_cols)
 
 
 def _meta_nbytes(meta: FileFooterMeta) -> int:
@@ -348,10 +432,14 @@ def _meta_nbytes(meta: FileFooterMeta) -> int:
     return 512 + per_rg * max(1, len(meta.row_groups))
 
 
-def footer_metadata(path: str, file_format: str = "parquet") -> Optional[FileFooterMeta]:
+def footer_metadata(
+    path: str, file_format: str = "parquet", _pf=None
+) -> Optional[FileFooterMeta]:
     """Footer metadata of one parquet file through the scan cache (freshness =
     the cache's (path, size, mtime) base). None for non-parquet formats or an
-    unreadable footer — callers then skip pruning for the file."""
+    unreadable footer — callers then skip pruning for the file. `_pf` lets the
+    cold decode path donate its already-open `pq.ParquetFile` so a cache miss
+    costs no second footer open (the caller keeps handle ownership)."""
     if file_format not in ("parquet", "delta"):
         return None
     from .scan_cache import global_scan_cache
@@ -366,7 +454,9 @@ def footer_metadata(path: str, file_format: str = "parquet") -> Optional[FileFoo
         # Transient footer-read faults retry with backoff; a PERSISTENT parse
         # failure still degrades to "no pruning" — a corrupt footer must never
         # break the scan, only its selectivity.
-        meta = _resilience.retry_io("io.footer", lambda: _parse_footer_meta(path))
+        meta = _resilience.retry_io(
+            "io.footer", lambda: _parse_footer_meta(path, _pf)
+        )
     except (QueryTimeoutError, RetryBudgetExceededError):
         # Deadline and retry budget are QUERY contracts, not pruning details:
         # swallowing either here would let a deadlined/budget-blown query limp
@@ -517,9 +607,18 @@ def _read_row_groups_one(path: str, sel, columns: Optional[List[str]]) -> Table:
     order), so the surviving rows appear exactly as in a whole-file read
     minus the pruned groups."""
     _faults.check("io.decode")
+    rd = []
+    if _encoding.encoded_exec_enabled():
+        # The pruning decision that produced `sel` already cached this
+        # footer, so the encoding facts are a cache hit by construction —
+        # deciding before the open keeps every pruned read at ONE open.
+        meta = footer_metadata(path, "parquet")
+        rd = _encoding.dict_read_columns(meta, columns)
+    if rd:
+        with pq.ParquetFile(path, read_dictionary=rd) as pf:
+            return _arrow_to_table(pf.read_row_groups(list(sel), columns=columns))
     with pq.ParquetFile(path) as pf:
-        at = pf.read_row_groups(list(sel), columns=columns)
-    return _arrow_to_table(at)
+        return _arrow_to_table(pf.read_row_groups(list(sel), columns=columns))
 
 
 def selection_columns(
@@ -988,13 +1087,25 @@ def arrow_schema_to_schema(sch: pa.Schema) -> Schema:
     return Schema(fields)
 
 
-def table_to_arrow(table: Table) -> pa.Table:
+def table_to_arrow(table: Table, encode_dictionaries: bool = False) -> pa.Table:
+    """`encode_dictionaries` (the parquet writer's setting, under the
+    ``HYPERSPACE_ENCODED_EXEC`` flag) emits string columns as COMPACTED
+    arrow dictionary arrays — D distinct strings cross the boundary instead
+    of N decoded ones, and the written bucket files stay dictionary-encoded
+    for the encoded read path. The CSV/ORC/JSON writers keep decoded arrays
+    (their writers don't all take dictionary input)."""
     arrays = []
     names = []
+    encode = encode_dictionaries and _encoding.encoded_exec_enabled()
     for name, col in table.columns.items():
         names.append(name)
         mask = None if col.validity is None else ~col.validity
-        arrays.append(pa.array(col.decode(), mask=mask))
+        if encode and col.is_string:
+            arrays.append(
+                _encoding.dictionary_arrow_array(col.data, col.dictionary, mask)
+            )
+        else:
+            arrays.append(pa.array(col.decode(), mask=mask))
     return pa.table(dict(zip(names, arrays)))
 
 
@@ -1022,7 +1133,9 @@ def write_parquet(table: Table, path: str, row_group_rows: Optional[int] = None)
     """`row_group_rows` bounds the written row groups (None = pyarrow's
     default) — the index writers pass `index_row_group_rows()` so footer zone
     maps get sub-file resolution over the key-sorted bucket rows."""
-    checked_write_table(table_to_arrow(table), path, row_group_rows)
+    checked_write_table(
+        table_to_arrow(table, encode_dictionaries=True), path, row_group_rows
+    )
 
 
 def write_orc(table: Table, path: str) -> None:

@@ -11,6 +11,11 @@ satisfying `col op literal`?" decision, shared by every pruning consumer:
 - `DataSkippingFilterRule`'s MinMaxSketch — both the per-FILE sketch and its
   per-ROW-GROUP variant prune through `minmax_keeps`/`zone_keeps` here.
 
+The footer cache these decisions read (`engine.io.footer_metadata`) also
+records per-column-chunk ENCODING facts (`FileFooterMeta.dict_cols`), which
+is how the encoded execution path chooses codes-through vs flatten per
+column without decoding anything (docs/encoded-execution.md).
+
 Soundness contract: a zone is pruned only when NO row in it can satisfy the
 conjunct under the engine's evaluation semantics (`engine.evaluate`):
 comparisons with null are unknown and WHERE drops unknowns, so an all-null

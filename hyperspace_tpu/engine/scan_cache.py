@@ -25,6 +25,7 @@ from typing import List, Optional, Tuple
 
 from ..telemetry import accounting as _accounting
 from ..telemetry import metrics as _metrics
+from .encoding import column_nbytes as _column_nbytes
 from .table import Table
 
 # 1 GiB of decoded columns. The per-file level is the DECODE backstop: repeat
@@ -39,31 +40,35 @@ DEFAULT_CAPACITY_BYTES = int(
 )
 
 
-def _bind_cache_metrics(cache, name: Optional[str]) -> None:
+def _bind_cache_metrics(
+    cache, name: Optional[str], encoded_hits: bool = False
+) -> None:
     """Bind a cache instance's registry mirrors once (warm-path cost = one
     locked int add). Only the NAMED process-wide singletons report to the
     registry; an ad-hoc unnamed instance (tests construct ScanCache directly)
     gets private unregistered metric objects, so it can never double-count
-    into — or clobber the byte gauge of — the global caches' series."""
+    into — or clobber the byte gauge of — the global caches' series.
+    `encoded_hits` registers cache.<name>.encoded_hits — only ScanCache ticks
+    it, so other cache kinds must not emit a permanently-zero series."""
     if name is None:
         cache._m_hits = _metrics.Counter("unregistered")
         cache._m_misses = _metrics.Counter("unregistered")
         cache._m_evictions = _metrics.Counter("unregistered")
         cache._m_bytes = _metrics.Gauge("unregistered")
+        cache._m_enc_hits = _metrics.Counter("unregistered")
         return
     cache._m_hits = _metrics.counter(f"cache.{name}.hits")
     cache._m_misses = _metrics.counter(f"cache.{name}.misses")
     cache._m_evictions = _metrics.counter(f"cache.{name}.evictions")
     cache._m_bytes = _metrics.gauge(f"cache.{name}.bytes")
-
-
-def _column_nbytes(c) -> int:
-    total = c.data.nbytes
-    if c.dictionary is not None:
-        total += c.dictionary.nbytes
-    if c.validity is not None:
-        total += c.validity.nbytes
-    return total
+    # Hits whose served columns include at least one ENCODED-read entry
+    # (codes + dictionary that never flattened — engine/encoding.py): the
+    # measure of how much of the warm working set stays in code space.
+    cache._m_enc_hits = (
+        _metrics.counter(f"cache.{name}.encoded_hits")
+        if encoded_hits
+        else _metrics.Counter("unregistered")
+    )
 
 
 def _table_nbytes(t: Table) -> int:
@@ -74,7 +79,12 @@ class ScanCache:
     """Per-column store behind a table-level get/put API.
 
     Entry kinds under one (path, size, mtime) freshness base:
-      - ("col", name)       → one decoded Column (+ its byte size)
+      - ("col", name)       → one decoded Column (an `encoded` marker records
+                              whether it arrived via the encoded read path —
+                              codes + dictionary, never flattened — plus its
+                              byte size: the TRUE encoded bytes
+                              `_column_nbytes` charges, codes + dictionary +
+                              validity, never a hypothetical decoded size)
       - ("col", name, sel)  → the column decoded from the row-group subset
                               `sel` (a tuple of row-group indices — the scan
                               pushdown's pruned decodes; a partial decode must
@@ -98,18 +108,23 @@ class ScanCache:
     ):
         self._capacity = capacity_bytes
         self._lock = threading.Lock()
-        self._entries: "OrderedDict[tuple, Tuple[object, int]]" = OrderedDict()
+        # Entry arity differs by kind — col: (column, encoded, nbytes);
+        # names/meta: (value, nbytes). The byte charge is ALWAYS ent[-1]
+        # (what eviction reads); ent[1] is only meaningful under a col key.
+        self._entries: "OrderedDict[tuple, tuple]" = OrderedDict()
         self._bytes = 0
         self.hits = 0
         self.misses = 0
         self.evictions = 0
-        _bind_cache_metrics(self, name)
+        self.encoded_hits = 0
+        _bind_cache_metrics(self, name, encoded_hits=True)
 
     def stats(self) -> dict:
         return {
             "hits": self.hits,
             "misses": self.misses,
             "evictions": self.evictions,
+            "encoded_hits": self.encoded_hits,
             "bytes": self._bytes,
             "budget": self._capacity,
         }
@@ -178,6 +193,7 @@ class ScanCache:
         with self._lock:
             names = self._names_for_locked(base, columns)
             cols = {}
+            any_encoded = False
             if names is not None:
                 for n in names:
                     ent = self._entries.get(base + (self._col_key(n, sel),))
@@ -185,6 +201,9 @@ class ScanCache:
                         cols = None
                         break
                     cols[n] = ent[0]
+                    # Col entries are uniformly (column, encoded, nbytes) —
+                    # the only entry kind fetched under a _col_key.
+                    any_encoded = any_encoded or ent[1]
             else:
                 cols = None
             if cols is None:
@@ -197,6 +216,9 @@ class ScanCache:
             if record:
                 self.hits += 1
                 self._m_hits.inc()
+                if any_encoded:
+                    self.encoded_hits += 1
+                    self._m_enc_hits.inc()
             return Table(cols)
 
     def missing_columns(
@@ -235,10 +257,13 @@ class ScanCache:
                 key = base + (self._col_key(n, sel),)
                 if key in self._entries:
                     continue
+                # The charged size is the ENCODED truth — codes + dictionary
+                # + validity (`_column_nbytes`) — never the flattened N-value
+                # size the decoded representation would occupy.
                 size = _column_nbytes(c)
                 if size > self._capacity:
                     continue
-                self._entries[key] = (c, size)
+                self._entries[key] = (c, getattr(c, "_encoded_read", False), size)
                 self._bytes += size
                 charged += size
             if charged:

@@ -37,6 +37,12 @@ chain per chunk on the shared decode-pool contract — straight into the same
 `StreamAggregator`, so the join output never materializes whole
 (docs/join-pipeline.md).
 
+Late materialization rides both streams by construction: chunks carry string
+columns as dictionary codes (under encoded execution the decode stage
+produces them without ever flattening — docs/encoded-execution.md), filters
+and group-bys and pair verification all run on codes, and only the gathered
+survivors that reach the output boundary ever decode.
+
 Per-stage busy timings (decode/eval/partial/merge), wall clock, and the
 overlap ratio ride `telemetry.profiling.record_query_stages` and surface in
 ``bench.py``'s ``bench_detail.query_stages``.

@@ -117,9 +117,24 @@ def _remap_codes(col: Column, new_dictionary: np.ndarray) -> np.ndarray:
 
 def align_dictionaries(a: Column, b: Column):
     """Re-encode two string columns over their union dictionary so codes are directly
-    comparable across tables (needed for cross-table joins on strings)."""
+    comparable across tables (needed for cross-table joins on strings).
+
+    Shared-dictionary fast path (encoded execution): when both sides already
+    carry the SAME sorted dictionary — e.g. two scans of one index, or both
+    sides of a self-join — the union is the dictionary itself and every remap
+    is the identity, so the columns come back untouched and comparisons run
+    directly on the existing codes. Only a real dictionary MISMATCH (files
+    built over different value sets) pays the union re-encode."""
     if not (a.is_string and b.is_string):
         raise ValueError("align_dictionaries requires string columns")
+    if a.dictionary is b.dictionary or np.array_equal(a.dictionary, b.dictionary):
+        from .encoding import VERIFY_SHARED_DICT
+
+        VERIFY_SHARED_DICT.inc()
+        return a, b
+    from .encoding import VERIFY_REALIGNED
+
+    VERIFY_REALIGNED.inc()
     union = np.union1d(a.dictionary, b.dictionary)
     return (
         Column(STRING, _remap_codes(a, union), union, a.validity),

@@ -257,8 +257,15 @@ class _BucketWriter:
 
     `prepare()` assembles ONE arrow array per output column over the chunk
     concatenation — decoded values + null mask, exactly what the serial path's
-    `table_to_arrow` feeds the writer (the dictionary representation never
-    reaches the file). `write_bucket` then gathers `perm[lo:hi]` with
+    `table_to_arrow` feeds the writer. Under encoded execution
+    (``HYPERSPACE_ENCODED_EXEC``), string columns stay CODES end to end
+    instead: the chunk columns re-encode over their union dictionary (the
+    exact `Table.concat` implementation the serial path runs), the gather
+    moves int32 codes, and `write_bucket` emits a compacted
+    `pa.DictionaryArray` through the SAME `encoding.dictionary_arrow_array`
+    helper the serial `table_to_arrow` uses — so serial == pipelined stays
+    byte-identical in both flag states, and the N decoded strings never
+    materialize. `write_bucket` gathers `perm[lo:hi]` with
     `pyarrow.compute.take` and encodes — both C++ paths that release the GIL,
     so the writer pool runs bucket gathers and encodes truly in parallel
     (the earlier numpy per-bucket gather serialized the pool on the GIL).
@@ -272,10 +279,14 @@ class _BucketWriter:
         self.index_data_path = index_data_path
         self.stages = stages
         self.arrays: Dict[str, "object"] = {}
+        self.dicts: Dict[str, np.ndarray] = {}  # union dict of encoded string cols
 
     def prepare(self) -> None:
         import pyarrow as pa
 
+        from ..engine import encoding as _encoding
+
+        encode = _encoding.encoded_exec_enabled()
         with self.stages.timed("concat"):
             for name in self.names:
                 cols = [t.column(name) for t in self.chunks]
@@ -294,24 +305,52 @@ class _BucketWriter:
                     mask = ~validity
                 else:
                     mask = None
-                if cols[0].is_string:
-                    # Decode per chunk through its own dictionary — value-
-                    # identical to the serial union-dictionary decode.
+                if cols[0].is_string and encode:
+                    # Encoded path: ONE union re-encode over the chunk
+                    # dictionaries (`Table.concat` — the serial concat's own
+                    # implementation, so codes and dictionary are bit-equal
+                    # to the serial path's) — the gather below then moves
+                    # int32 codes, never decoded strings.
+                    merged = Table.concat([Table({name: c}) for c in cols])
+                    mc = merged.column(name)
+                    self.dicts[name] = mc.dictionary
+                    self.arrays[name] = pa.array(mc.data, mask=mask)
+                elif cols[0].is_string:
+                    # Decoded fallback: decode per chunk through its own
+                    # dictionary — value-identical to the serial union-
+                    # dictionary decode.
                     values = np.concatenate([c.dictionary[c.data] for c in cols])
+                    self.arrays[name] = pa.array(values, mask=mask)
                 elif len(cols) == 1:
-                    values = cols[0].data
+                    self.arrays[name] = pa.array(cols[0].data, mask=mask)
                 else:
-                    values = np.concatenate([c.data for c in cols])
-                self.arrays[name] = pa.array(values, mask=mask)
+                    self.arrays[name] = pa.array(
+                        np.concatenate([c.data for c in cols]), mask=mask
+                    )
+
+    def _bucket_array(self, n: str, lo: int, hi: int):
+        """One column's arrow array for rows [lo, hi): a zero-copy slice, or
+        — for encoded string columns — the compacted dictionary array built
+        from the sliced codes (the shared write-side primitive)."""
+        from ..engine import encoding as _encoding
+
+        sl = self.gathered[n].slice(lo, hi - lo)
+        if n not in self.dicts:
+            return sl
+        if sl.null_count:
+            mask = np.asarray(sl.is_null())
+            codes = np.asarray(sl.fill_null(0))
+        else:
+            mask = None
+            codes = np.asarray(sl)
+        return _encoding.dictionary_arrow_array(codes, self.dicts[n], mask)
 
     def write_bucket(self, b: int, lo: int, hi: int) -> None:
         if hi <= lo:
             return  # empty bucket: no file (same contract as the serial path)
         import pyarrow as pa
 
-        out = pa.table(
-            {n: self.gathered[n].slice(lo, hi - lo) for n in self.names}
-        )
+        out = pa.table({n: self._bucket_array(n, lo, hi) for n in self.names})
         # Bounded row groups over the key-sorted bucket rows: the footer zone
         # maps then resolve point/range filters INSIDE the bucket file (scan
         # pushdown). Same bound as the serial writer — the byte-identity

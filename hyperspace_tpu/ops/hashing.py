@@ -8,6 +8,10 @@ ops (murmur3 finalizer mixing), so the index build's partitioning step
 - Numeric columns hash on device from their bit patterns.
 - String columns hash via their dictionary: one host-side blake2b per *unique* value,
   then a device gather through the codes — O(dict) host work, O(n) device work.
+  This IS the encoded-execution hash path (docs/encoded-execution.md): keys
+  arrive as dictionary codes from the reader and are never decoded to hash —
+  the per-column dictionary-hash table is the only place the string bytes
+  are ever touched, once per distinct value.
 - Multi-column keys combine per-column hashes with a murmur-style mixer.
 - Join keys are 64-bit (two independent 32-bit lanes packed), verified exactly at join
   time, so hash collisions can never produce wrong results.

@@ -61,6 +61,11 @@ ENV_ACCOUNTING = "HYPERSPACE_ACCOUNTING"
 _COUNTER_FIELDS = (
     "bytes_decoded",
     "bytes_skipped",
+    # Encoded-execution byte split (engine/encoding.py): bytes that entered
+    # the engine still as codes + dictionary vs bytes flattened to raw
+    # values — together the honest denominator of effective GB/s.
+    "bytes_encoded_kept",
+    "bytes_materialized",
     "decode_files",
     "rows_produced",
     "cache_bytes_charged",

@@ -237,6 +237,13 @@ def io_pruning_summary() -> dict:
         "row_groups_skipped": _metrics.counter("io.pruning.row_groups_skipped").value,
         "bytes_decoded": _metrics.counter("io.pruning.bytes_decoded").value,
         "bytes_skipped": _metrics.counter("io.pruning.bytes_skipped").value,
+        # Encoded-execution byte split: kept-as-codes vs flattened-to-values
+        # (engine/encoding.py) — distinguishes what `bytes_decoded` cannot,
+        # so effective GB/s is computed over bytes actually moved.
+        "bytes_encoded_kept": _metrics.counter("io.pruning.bytes_encoded_kept").value,
+        "bytes_materialized": _metrics.counter("io.pruning.bytes_materialized").value,
+        "columns_encoded": _metrics.counter("io.encoded.columns_encoded").value,
+        "columns_flattened": _metrics.counter("io.encoded.columns_flattened").value,
         "footer_hits": _metrics.counter("io.footer.hits").value,
         "footer_misses": _metrics.counter("io.footer.misses").value,
     }

@@ -1,0 +1,485 @@
+"""Encoded execution: dictionary-code keys through build/scan/join with late
+materialization (ISSUE 8 tentpole).
+
+The contract under test: with ``HYPERSPACE_ENCODED_EXEC`` on (the default),
+dictionary-encoded parquet string columns enter the engine as codes + a
+sorted dictionary WITHOUT ever materializing the N decoded strings
+(`engine/encoding.dictionary_array_to_column`), index bucket files are
+written as compacted arrow dictionary arrays through ONE shared helper for
+the serial and pipelined writers, and every result — values, row order,
+aggregate GROUP order, dtypes — is BYTE-IDENTICAL to the
+``HYPERSPACE_ENCODED_EXEC=0`` decoded fallback. The oracle matrix covers
+nulls, unicode, empty (all-null) dictionaries, dictionary mismatch across
+files, the ``HYPERSPACE_ENCODED_DICT_MAX`` large-dictionary fallback, mixed
+encoded/plain columns inside one join, and a decode fault mid-scan leaving
+no partial encoded cache entry (the PR-7 fault contract).
+"""
+
+import glob
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import IndexConfig, IndexConstants
+from hyperspace_tpu.engine import HyperspaceSession, col
+from hyperspace_tpu.engine import encoding
+from hyperspace_tpu.engine import io as engine_io
+from hyperspace_tpu.engine.table import Column, Table
+from hyperspace_tpu.hyperspace import Hyperspace, enable_hyperspace
+from hyperspace_tpu.telemetry import metrics
+
+ENV = encoding.ENV_ENCODED_EXEC
+
+
+@pytest.fixture()
+def session(tmp_path):
+    s = HyperspaceSession(warehouse=str(tmp_path))
+    s.conf.set(IndexConstants.INDEX_SYSTEM_PATH, str(tmp_path / "indexes"))
+    s.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
+    return s
+
+
+def _clear_caches():
+    from hyperspace_tpu.engine.physical import clear_device_memos
+    from hyperspace_tpu.engine.scan_cache import (
+        global_bucketed_cache,
+        global_concat_cache,
+        global_filtered_cache,
+        global_scan_cache,
+    )
+
+    global_scan_cache().clear()
+    global_concat_cache().clear()
+    global_filtered_cache().clear()
+    global_bucketed_cache().clear()
+    clear_device_memos()
+
+
+def _encoded_counters():
+    return {
+        "encoded": encoding.COLUMNS_ENCODED.value,
+        "flattened": encoding.COLUMNS_FLATTENED.value,
+        "kept_bytes": encoding.BYTES_ENCODED_KEPT.value,
+        "mat_bytes": encoding.BYTES_MATERIALIZED.value,
+        "dict_written": encoding.COLUMNS_DICT_WRITTEN.value,
+        "shared_dict": encoding.VERIFY_SHARED_DICT.value,
+        "realigned": encoding.VERIFY_REALIGNED.value,
+    }
+
+
+def _tables_identical(a: Table, b: Table):
+    """Byte-level equality: codes, dictionaries, validity, dtype labels, and
+    column order — stronger than row equality (the on/off contract)."""
+    assert a.column_names == b.column_names
+    assert a.schema.names == b.schema.names
+    for n in a.column_names:
+        ca, cb = a.columns[n], b.columns[n]
+        assert ca.dtype == cb.dtype, n
+        assert np.array_equal(ca.data, cb.data), n
+        if ca.is_string:
+            assert np.array_equal(ca.dictionary, cb.dictionary), n
+        assert (ca.validity is None) == (cb.validity is None), n
+        if ca.validity is not None:
+            assert np.array_equal(ca.validity, cb.validity), n
+
+
+def _on_off(monkeypatch, make_result):
+    """(result_on, result_off), each produced COLD (caches cleared)."""
+    monkeypatch.setenv(ENV, "1")
+    _clear_caches()
+    on = make_result()
+    monkeypatch.setenv(ENV, "0")
+    _clear_caches()
+    off = make_result()
+    monkeypatch.delenv(ENV, raising=False)
+    return on, off
+
+
+def _write_string_source(base: str, name: str, n_files: int = 2, rows: int = 400):
+    """Dictionary-heavy multi-file source: moderate-cardinality string key,
+    nulls, unicode, empty strings, plus numeric payloads."""
+    rng = np.random.RandomState(3)
+    src = os.path.join(base, name)
+    names = np.asarray([f"cust#{i:03d}" for i in range(40)] + ["δ-ünïcode", ""])
+    for i in range(n_files):
+        ks = names[rng.randint(0, len(names), rows)]
+        t = Table.from_pydict(
+            {
+                "k": [None if j % 11 == 0 else str(ks[j]) for j in range(rows)],
+                "v": rng.randint(0, 50, rows).tolist(),
+                "f": rng.randn(rows).tolist(),
+            }
+        )
+        engine_io.write_parquet(t, os.path.join(src, f"part-{i:05d}.parquet"))
+    return src
+
+
+class TestEncodedDecodedOracle:
+    def test_scan_collect_identical_and_encoded_counted(
+        self, session, tmp_path, monkeypatch
+    ):
+        src = _write_string_source(str(tmp_path), "src")
+        c0 = _encoded_counters()
+        on, off = _on_off(monkeypatch, lambda: session.read.parquet(src).collect())
+        c1 = _encoded_counters()
+        _tables_identical(on, off)
+        # The ON run really took the encoded path (one string column per
+        # file) and charged the byte split to both halves.
+        assert c1["encoded"] - c0["encoded"] >= 2
+        assert c1["kept_bytes"] > c0["kept_bytes"]
+
+    def test_group_by_string_key_group_order_identical(
+        self, session, tmp_path, monkeypatch
+    ):
+        src = _write_string_source(str(tmp_path), "src")
+
+        def q():
+            return (
+                session.read.parquet(src)
+                .group_by("k")
+                .agg(n=("v", "count"), sv=("v", "sum"))
+                .collect()
+            )
+
+        on, off = _on_off(monkeypatch, q)
+        _tables_identical(on, off)  # includes GROUP ORDER via codes equality
+
+    def test_filter_and_pushdown_compose(self, session, tmp_path, monkeypatch):
+        """Encoded execution composes with PR-5 row-group pushdown: a
+        clustered numeric filter prunes row groups while the string payload
+        rides encoded — including the all-pruned file's 0-row dictionary
+        schema table."""
+        src = os.path.join(str(tmp_path), "clustered")
+        for i in range(2):
+            t = Table.from_pydict(
+                {
+                    "ts": (np.arange(300, dtype=np.int64) + i * 300).tolist(),
+                    "s": [f"tag{j % 7}" for j in range(300)],
+                }
+            )
+            engine_io.write_parquet(
+                t, os.path.join(src, f"part-{i:05d}.parquet"), row_group_rows=100
+            )
+        monkeypatch.setenv("HYPERSPACE_SCAN_PUSHDOWN", "1")
+
+        def q():
+            return session.read.parquet(src).filter(col("ts") < 150).collect()
+
+        on, off = _on_off(monkeypatch, q)
+        _tables_identical(on, off)
+        assert on.num_rows == 150
+
+    def test_dictionary_mismatch_across_files(self, session, tmp_path, monkeypatch):
+        """Two files with DISJOINT value sets: the concat's union dictionary
+        must come out identical in both modes (codes included)."""
+        src = os.path.join(str(tmp_path), "mismatch")
+        engine_io.write_parquet(
+            Table.from_pydict({"k": ["a", "b", "c"], "v": [1, 2, 3]}),
+            os.path.join(src, "part-00000.parquet"),
+        )
+        engine_io.write_parquet(
+            Table.from_pydict({"k": ["x", "y", "a"], "v": [4, 5, 6]}),
+            os.path.join(src, "part-00001.parquet"),
+        )
+        on, off = _on_off(monkeypatch, lambda: session.read.parquet(src).collect())
+        _tables_identical(on, off)
+        assert list(on.columns["k"].dictionary) == ["a", "b", "c", "x", "y"]
+
+    def test_empty_dictionary_all_null_column(self, session, tmp_path, monkeypatch):
+        """An all-null string column writes an EMPTY disk dictionary; the
+        encoded read must reproduce the decoded path's ['' ] fill dictionary
+        and all-zero codes."""
+        src = os.path.join(str(tmp_path), "allnull")
+        engine_io.write_parquet(
+            Table.from_pydict({"k": [None, None, None], "v": [1, 2, 3]}),
+            os.path.join(src, "part-00000.parquet"),
+        )
+        on, off = _on_off(monkeypatch, lambda: session.read.parquet(src).collect())
+        _tables_identical(on, off)
+        assert on.to_pydict()["k"] == [None, None, None]
+
+    def test_large_dict_fallback(self, session, tmp_path, monkeypatch):
+        """A dictionary above HYPERSPACE_ENCODED_DICT_MAX silently takes the
+        flatten path — identical results, `columns_flattened` ticked."""
+        src = os.path.join(str(tmp_path), "bigdict")
+        engine_io.write_parquet(
+            Table.from_pydict(
+                {"k": [f"u{i}" for i in range(64)], "v": list(range(64))}
+            ),
+            os.path.join(src, "part-00000.parquet"),
+        )
+        monkeypatch.setenv(encoding.ENV_ENCODED_DICT_MAX, "8")
+        c0 = _encoded_counters()
+        on, off = _on_off(monkeypatch, lambda: session.read.parquet(src).collect())
+        c1 = _encoded_counters()
+        _tables_identical(on, off)
+        assert c1["flattened"] > c0["flattened"]
+
+    def test_mixed_encoded_plain_columns_in_one_join(
+        self, session, tmp_path, monkeypatch
+    ):
+        """One join side's key column written PLAIN (no dictionary page — the
+        footer marks it ineligible), the other dictionary-encoded: the
+        per-column decision flattens only the plain one, and the join result
+        matches the decoded oracle exactly."""
+        import pyarrow.parquet as pq
+
+        left = os.path.join(str(tmp_path), "left")
+        right = os.path.join(str(tmp_path), "right")
+        lt = Table.from_pydict(
+            {"k": ["a", "b", "c", "a", None], "lv": [1, 2, 3, 4, 5]}
+        )
+        rt = Table.from_pydict({"k": ["b", "c", "d", None], "rv": [10, 20, 30, 40]})
+        engine_io.write_parquet(lt, os.path.join(left, "part-00000.parquet"))
+        os.makedirs(right, exist_ok=True)
+        pq.write_table(  # plain-encoded string column: encoded path ineligible
+            engine_io.table_to_arrow(rt),
+            os.path.join(right, "part-00000.parquet"),
+            use_dictionary=False,
+        )
+        meta = engine_io.footer_metadata(os.path.join(right, "part-00000.parquet"))
+        assert meta is not None and meta.dict_cols.get("k") is False
+
+        def q():
+            l = session.read.parquet(left)
+            r = session.read.parquet(right)
+            return (
+                l.join(r, col("k") == col("k"))
+                .select("k", "lv", "rv")
+                .collect()
+            )
+
+        on, off = _on_off(monkeypatch, q)
+        _tables_identical(on, off)
+        assert sorted(on.rows()) == [("b", 2, 10), ("c", 3, 20)]
+
+    def test_fault_mid_scan_leaves_no_partial_encoded_entry(
+        self, session, tmp_path, monkeypatch
+    ):
+        """A decode fault on the encoded path propagates cleanly and caches
+        NOTHING — the clean retry decodes from scratch and matches (the PR-7
+        only-cache-on-success contract)."""
+        from hyperspace_tpu.engine.scan_cache import global_scan_cache
+
+        src = _write_string_source(str(tmp_path), "src", n_files=2)
+        monkeypatch.setenv(ENV, "1")
+        monkeypatch.setenv("HYPERSPACE_BUILD_DECODE_THREADS", "1")
+        _clear_caches()
+
+        real = engine_io._read_one
+        boom = {"path": None}
+
+        def failing(path, file_format, columns=None):
+            if boom["path"] is None:
+                boom["path"] = path
+            if path == boom["path"]:
+                raise OSError("injected decode fault")
+            return real(path, file_format, columns)
+
+        monkeypatch.setattr(engine_io, "_read_one", failing)
+        with pytest.raises(OSError, match="injected"):
+            session.read.parquet(src).collect()
+        assert boom["path"] is not None
+        missing = global_scan_cache().missing_columns(boom["path"], ["k", "v", "f"])
+        assert missing == ["k", "v", "f"]  # no partial encoded entry
+        monkeypatch.setattr(engine_io, "_read_one", real)
+        t = session.read.parquet(src).collect()
+        assert t.num_rows == 800
+
+    def test_chaos_fault_point_oracle(self, session, tmp_path, monkeypatch):
+        """Riding the PR-7 seeded fault registry: transient io.decode faults
+        under the encoded path retry to an identical result."""
+        from hyperspace_tpu.telemetry import faults
+
+        src = _write_string_source(str(tmp_path), "src")
+        monkeypatch.setenv(ENV, "1")
+        monkeypatch.setenv("HYPERSPACE_BUILD_DECODE_THREADS", "1")
+        monkeypatch.setenv("HYPERSPACE_IO_RETRIES", "6")
+        monkeypatch.setenv("HYPERSPACE_RETRY_BACKOFF_S", "0.001")
+        _clear_caches()
+        clean = session.read.parquet(src).collect()
+        faults.configure("io.decode:0.4:transient")
+        try:
+            _clear_caches()
+            chaotic = session.read.parquet(src).collect()
+        finally:
+            faults.clear()
+        assert metrics.counter("faults.io.decode.injected").value > 0
+        _tables_identical(clean, chaotic)
+
+
+class TestEncodedBuild:
+    def test_indexed_join_identical_on_off(self, session, tmp_path, monkeypatch):
+        """Covering-index build + bucketed string-key join: flag on vs off
+        produce identical query results (rows, order, dtypes); the encoded
+        build writes dictionary-typed bucket files."""
+        import pyarrow.parquet as pq
+
+        left = _write_string_source(str(tmp_path), "left", n_files=2, rows=300)
+        right = _write_string_source(str(tmp_path), "right", n_files=1, rows=120)
+        hs = Hyperspace(session)
+
+        def run():
+            hs.create_index(
+                session.read.parquet(left), IndexConfig("encL", ["k"], ["v"])
+            )
+            hs.create_index(
+                session.read.parquet(right), IndexConfig("encR", ["k"], ["f"])
+            )
+            enable_hyperspace(session)
+            out = (
+                session.read.parquet(left)
+                .join(session.read.parquet(right), col("k") == col("k"))
+                .group_by("k")
+                .agg(n=("v", "count"))
+                .collect()
+            )
+            hs.delete_index("encL"), hs.vacuum_index("encL")
+            hs.delete_index("encR"), hs.vacuum_index("encR")
+            return out
+
+        on, off = _on_off(monkeypatch, run)
+        _tables_identical(on, off)
+
+    def test_bucket_files_dictionary_preserving(self, session, tmp_path, monkeypatch):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        src = _write_string_source(str(tmp_path), "src", n_files=1, rows=100)
+        monkeypatch.setenv(ENV, "1")
+        _clear_caches()
+        Hyperspace(session).create_index(
+            session.read.parquet(src), IndexConfig("dp", ["k"], ["v"])
+        )
+        parts = glob.glob(str(tmp_path / "indexes" / "dp" / "v__=0" / "*.parquet"))
+        assert parts
+        seen_dict = False
+        for p in parts:
+            at = pq.read_table(p)
+            f = at.schema.field("k")
+            assert pa.types.is_dictionary(f.type), f.type
+            seen_dict = True
+            # Compaction: no bucket file carries values absent from its rows.
+            darr = at.column("k").combine_chunks()
+            present = set(at.column("k").to_pylist()) - {None}
+            assert set(darr.dictionary.to_pylist()) == present
+        assert seen_dict
+
+    def test_serial_pipelined_byte_identical_encoded(
+        self, session, tmp_path, monkeypatch
+    ):
+        src = _write_string_source(str(tmp_path), "src", n_files=3, rows=200)
+        monkeypatch.setenv(ENV, "1")
+
+        def build(threads: str, name: str):
+            monkeypatch.setenv("HYPERSPACE_BUILD_DECODE_THREADS", threads)
+            _clear_caches()
+            Hyperspace(session).create_index(
+                session.read.parquet(src), IndexConfig(name, ["k"], ["v", "f"])
+            )
+            files = sorted(
+                glob.glob(str(tmp_path / "indexes" / name / "v__=0" / "*.parquet"))
+            )
+            return {
+                os.path.basename(f): hashlib.sha256(open(f, "rb").read()).hexdigest()
+                for f in files
+            }
+
+        assert build("1", "serIdx") == build("4", "pipIdx")
+
+
+class TestEncodedCacheAndVerify:
+    def test_encoded_hits_counter_and_true_size_charge(
+        self, session, tmp_path, monkeypatch
+    ):
+        """Warm reads of encoded entries tick `cache.scan.encoded_hits`, and
+        `cache_bytes_charged` charges the TRUE encoded size (codes +
+        dictionary + validity), not the flattened decoded size."""
+        from hyperspace_tpu.engine.scan_cache import ScanCache, _column_nbytes
+
+        src = _write_string_source(str(tmp_path), "solo", n_files=1, rows=200)
+        path = os.path.join(src, "part-00000.parquet")
+        monkeypatch.setenv(ENV, "1")
+        _clear_caches()
+        t = engine_io.read_files([path], "parquet")
+        kc = t.columns["k"]
+        true_size = _column_nbytes(kc)
+        decoded_size = kc.dictionary[kc.data].nbytes
+        assert true_size < decoded_size  # codes+dict beat N flat strings
+        c0 = metrics.counter("cache.scan.encoded_hits").value
+        engine_io.read_files([path], "parquet")  # whole-file per-column hit
+        assert metrics.counter("cache.scan.encoded_hits").value > c0
+
+        cache = ScanCache(capacity_bytes=1 << 20)
+        cache.put(path, ["k"], Table({"k": kc}))
+        assert cache.stats()["bytes"] == true_size
+
+    def test_ledger_byte_split(self, session, tmp_path, monkeypatch):
+        """The per-query ledger distinguishes bytes_encoded_kept from
+        bytes_materialized (rendered by explain(analyze=True))."""
+        from hyperspace_tpu.telemetry import accounting
+
+        src = _write_string_source(str(tmp_path), "src", n_files=1, rows=200)
+        monkeypatch.setenv(ENV, "1")
+        monkeypatch.setenv("HYPERSPACE_ACCOUNTING", "1")
+        _clear_caches()
+        session.read.parquet(src).collect()
+        led = accounting.recent_ledgers()[-1].to_dict()
+        assert led.get("bytes_encoded_kept", 0) > 0
+        assert led.get("bytes_materialized", 0) > 0  # numeric cols flatten
+
+    def test_explain_analyze_renders_byte_split(self, session, tmp_path, monkeypatch):
+        src = _write_string_source(str(tmp_path), "src", n_files=1, rows=100)
+        monkeypatch.setenv(ENV, "1")
+        _clear_caches()
+        s = session.read.parquet(src).explain(analyze=True)
+        assert "bytes_encoded_kept" in s
+        assert "bytes_materialized" in s
+
+    def test_shared_dictionary_verify_fast_path(self):
+        """Equal dictionaries skip the union re-encode entirely (codes come
+        back untouched); a real mismatch still realigns."""
+        from hyperspace_tpu.engine.table import align_dictionaries
+
+        d = np.asarray(["a", "b", "c"])
+        a = Column("string", np.asarray([0, 1, 2], np.int32), d)
+        b = Column("string", np.asarray([2, 1, 0], np.int32), d.copy())
+        s0 = encoding.VERIFY_SHARED_DICT.value
+        ra, rb = align_dictionaries(a, b)
+        assert ra is a and rb is b
+        assert encoding.VERIFY_SHARED_DICT.value == s0 + 1
+        c = Column("string", np.asarray([0], np.int32), np.asarray(["z"]))
+        r0 = encoding.VERIFY_REALIGNED.value
+        ra, rc = align_dictionaries(a, c)
+        assert list(ra.dictionary) == ["a", "b", "c", "z"]
+        assert encoding.VERIFY_REALIGNED.value == r0 + 1
+
+    def test_streamed_aggregate_oracle_under_encoded(
+        self, session, tmp_path, monkeypatch
+    ):
+        """The streaming executor (PR 2) consumes encoded chunks unchanged:
+        streamed == materialized == decoded-fallback, group order included."""
+        src = _write_string_source(str(tmp_path), "src", n_files=2, rows=300)
+
+        def q():
+            return (
+                session.read.parquet(src)
+                .filter(col("v") < 40)
+                .group_by("k")
+                .agg(n=("v", "count"))
+                .collect()
+            )
+
+        monkeypatch.setenv("HYPERSPACE_QUERY_STREAMING", "1")
+        on_stream, off_stream = _on_off(monkeypatch, q)
+        _tables_identical(on_stream, off_stream)
+        # The materialized leg agrees on VALUES (its group order for
+        # nullable keys is first-occurrence, the stream's is the one-pass
+        # sort order — the standing PR-2 contract, independent of this flag).
+        monkeypatch.setenv("HYPERSPACE_QUERY_STREAMING", "0")
+        monkeypatch.setenv(ENV, "1")
+        _clear_caches()
+        mat = q()
+        assert mat.sorted_rows() == on_stream.sorted_rows()
