@@ -10,6 +10,7 @@ joins as XLA/Pallas programs.
 
 from .config import HyperspaceConf, IndexConstants, SessionConf  # noqa: F401
 from .exceptions import (  # noqa: F401
+    AdmissionRejectedError,
     CompileTimeoutError,
     ConcurrentWriteError,
     CorruptIndexError,
@@ -34,6 +35,10 @@ def __getattr__(name):
         from .engine.session import HyperspaceSession
 
         return HyperspaceSession
+    if name == "QueryServer":
+        from .serve import QueryServer
+
+        return QueryServer
     raise AttributeError(name)
 
 

@@ -40,6 +40,7 @@ from ..exceptions import (
     QueryTimeoutError,
     RetryBudgetExceededError,
 )
+from ..serve import singleflight as _singleflight
 from ..storage.filesystem import FileStatus, FileSystem, LocalFileSystem
 from ..telemetry import accounting as _accounting
 from ..telemetry import faults as _faults
@@ -450,22 +451,34 @@ def footer_metadata(
         _FOOTER_HITS.inc()
         return meta
     _FOOTER_MISSES.inc()
-    try:
-        # Transient footer-read faults retry with backoff; a PERSISTENT parse
-        # failure still degrades to "no pruning" — a corrupt footer must never
-        # break the scan, only its selectivity.
-        meta = _resilience.retry_io(
-            "io.footer", lambda: _parse_footer_meta(path, _pf)
-        )
-    except (QueryTimeoutError, RetryBudgetExceededError):
-        # Deadline and retry budget are QUERY contracts, not pruning details:
-        # swallowing either here would let a deadlined/budget-blown query limp
-        # on, burning more retries per footer.
-        raise
-    except Exception:
-        return None  # unreadable/corrupt footer: never break the scan over pruning
-    cache.put_meta(path, meta, _meta_nbytes(meta))
-    return meta
+
+    def _parse_and_cache() -> Optional[FileFooterMeta]:
+        try:
+            # Transient footer-read faults retry with backoff; a PERSISTENT
+            # parse failure still degrades to "no pruning" — a corrupt footer
+            # must never break the scan, only its selectivity.
+            meta = _resilience.retry_io(
+                "io.footer", lambda: _parse_footer_meta(path, _pf)
+            )
+        except (QueryTimeoutError, RetryBudgetExceededError):
+            # Deadline and retry budget are QUERY contracts, not pruning
+            # details: swallowing either here would let a deadlined/budget-
+            # blown query limp on, burning more retries per footer.
+            raise
+        except Exception:
+            return None  # unreadable footer: never break the scan over pruning
+        cache.put_meta(path, meta, _meta_nbytes(meta))
+        return meta
+
+    # Single-flight: concurrent cold scans of the same lake otherwise parse
+    # every footer once per caller. A follower is served from the entry the
+    # leader cached; an unreadable footer (leader returned None, nothing
+    # cached) degrades to each caller paying its own parse attempt — exactly
+    # the pre-serving cost. The donated `_pf` handle is only ever touched by
+    # the thread that owns it (the leader path of its own call).
+    return _singleflight.shared(
+        ("meta", path), _parse_and_cache, lambda: cache.get_meta(path)
+    )
 
 
 def _pushdown_selections(ordered: List[str], file_format: str, pushdown):
@@ -549,12 +562,38 @@ def file_table(path: str, file_format: str, file_columns: Optional[List[str]]) -
     return _decode_into_cache(path, file_format, file_columns)
 
 
+def _cols_key(columns: Optional[List[str]]) -> tuple:
+    """Flight-key spelling of a projection (None = all columns must never
+    alias an explicit empty projection — same rule as the concat key)."""
+    return ("<all>",) if columns is None else tuple(columns)
+
+
 def _decode_into_cache(
     path: str, file_format: str, file_columns: Optional[List[str]]
 ) -> Table:
-    """The miss half of `file_table`: decode only the cold columns when the
-    cache can tell which those are, else the full projection. The caller has
-    already counted the miss (no double accounting)."""
+    """The miss half of `file_table`, under single-flight: N concurrent cold
+    requests for the same (file, projection) run ONE decode — the leader runs
+    `_decode_into_cache_miss`, followers block and are served from the entry
+    it cached (`serve.singleflight`; record=False because each follower's own
+    request already counted its miss at the probe — one request, one count).
+    A leader failure clears the flight and each follower retries
+    independently: no poisoned entries, composing with the retry contract
+    inside the miss body."""
+    from .scan_cache import global_scan_cache
+
+    return _singleflight.shared(
+        ("file", path, _cols_key(file_columns)),
+        lambda: _decode_into_cache_miss(path, file_format, file_columns),
+        lambda: global_scan_cache().get(path, file_columns, record=False),
+    )
+
+
+def _decode_into_cache_miss(
+    path: str, file_format: str, file_columns: Optional[List[str]]
+) -> Table:
+    """Decode only the cold columns when the cache can tell which those are,
+    else the full projection. The caller has already counted the miss (no
+    double accounting)."""
     import time as _time
 
     from .scan_cache import global_scan_cache
@@ -690,10 +729,27 @@ def _record_decoded_bytes(
 def _decode_rg_into_cache(
     path: str, cols: List[str], sel: tuple, meta: Optional[FileFooterMeta] = None
 ) -> Table:
-    """The miss half of `pruned_file_table`: decode only the cold columns of
-    the selection when the cache can tell which those are. The cache only
-    ever stores successful decodes — a fault mid-scan leaves no partial
-    selection entry behind (pinned by tests/test_scan_pushdown.py)."""
+    """The miss half of `pruned_file_table`, under single-flight keyed by the
+    SELECTION-aware cache key: two concurrent identical pruned reads decode
+    once, while DISTINCT selections (or a whole-file read) of the same file
+    can never share a flight — exactly the aliasing rule of the cache entries
+    the flights guard."""
+    from .scan_cache import global_scan_cache
+
+    return _singleflight.shared(
+        ("file", path, tuple(cols), tuple(sel)),
+        lambda: _decode_rg_into_cache_miss(path, cols, sel, meta),
+        lambda: global_scan_cache().get(path, cols, record=False, sel=sel),
+    )
+
+
+def _decode_rg_into_cache_miss(
+    path: str, cols: List[str], sel: tuple, meta: Optional[FileFooterMeta] = None
+) -> Table:
+    """Decode only the cold columns of the selection when the cache can tell
+    which those are. The cache only ever stores successful decodes — a fault
+    mid-scan leaves no partial selection entry behind (pinned by
+    tests/test_scan_pushdown.py)."""
     import time as _time
 
     from .scan_cache import global_scan_cache
@@ -972,76 +1028,99 @@ def read_files(
     )
     if cached is not None:
         return cached
-    if selections is not None:
-        # Past the concat probe: this scan really assembles, so its pruning
-        # decision counts (a warm repeat served above never gets here).
-        _record_pruning(selections, pruning_stats)
 
-    from .scan_cache import global_scan_cache
+    def _assemble() -> Table:
+        if selections is not None:
+            # Past the concat probe: this scan really assembles, so its
+            # pruning decision counts (a warm repeat served above never gets
+            # here).
+            _record_pruning(selections, pruning_stats)
 
-    cache = global_scan_cache()
-    if selections is None:
-        tables: List[Optional[Table]] = [cache.get(f, file_columns) for f in ordered]
-        missing = [i for i, t in enumerate(tables) if t is None]
-        decode_miss = lambda i: _decode_into_cache(
-            ordered[i], file_format, file_columns
-        )
-    else:
-        tables = []
-        for f, (meta, sel) in zip(ordered, selections):
-            if sel is None:
-                tables.append(cache.get(f, file_columns))
-            elif len(sel) == 0:
-                tables.append(_empty_file_table(meta, file_columns))
-            else:
-                tables.append(
-                    cache.get(f, selection_columns(file_columns, meta), sel=tuple(sel))
-                )
-        missing = [i for i, t in enumerate(tables) if t is None]
+        from .scan_cache import global_scan_cache
 
-        def decode_miss(i: int) -> Table:
-            meta, sel = selections[i]
-            if sel is None:
-                return _decode_into_cache(ordered[i], file_format, file_columns)
-            return _decode_rg_into_cache(
-                ordered[i], selection_columns(file_columns, meta), tuple(sel), meta
+        cache = global_scan_cache()
+        if selections is None:
+            tables: List[Optional[Table]] = [
+                cache.get(f, file_columns) for f in ordered
+            ]
+            missing = [i for i, t in enumerate(tables) if t is None]
+            decode_miss = lambda i: _decode_into_cache(
+                ordered[i], file_format, file_columns
             )
+        else:
+            tables = []
+            for f, (meta, sel) in zip(ordered, selections):
+                if sel is None:
+                    tables.append(cache.get(f, file_columns))
+                elif len(sel) == 0:
+                    tables.append(_empty_file_table(meta, file_columns))
+                else:
+                    tables.append(
+                        cache.get(
+                            f, selection_columns(file_columns, meta), sel=tuple(sel)
+                        )
+                    )
+            missing = [i for i, t in enumerate(tables) if t is None]
 
-    workers = decode_pool_size(len(missing)) if missing else 0
-    if len(missing) > 1 and workers > 1:
-        # Decode cache misses concurrently: parquet/csv decode is pyarrow C++ work
-        # that releases the GIL, so a thread pool gives real parallelism (SURVEY §7
-        # "overlap decode; don't let the device idle on file I/O"). Fully-warm
-        # scans never pay the pool setup. The worker count rides the shared
-        # HYPERSPACE_BUILD_DECODE_THREADS contract (`decode_pool_size`), so
-        # `=1` forces the serial path here exactly as it does for the build.
-        from concurrent.futures import ThreadPoolExecutor
+            def decode_miss(i: int) -> Table:
+                meta, sel = selections[i]
+                if sel is None:
+                    return _decode_into_cache(ordered[i], file_format, file_columns)
+                return _decode_rg_into_cache(
+                    ordered[i], selection_columns(file_columns, meta), tuple(sel), meta
+                )
 
-        led = _accounting.current_ledger()  # charge workers to the submitter
-        sc = _resilience.current_scope()  # workers honor the query deadline
+        workers = decode_pool_size(len(missing)) if missing else 0
+        if len(missing) > 1 and workers > 1:
+            # Decode cache misses concurrently: parquet/csv decode is pyarrow
+            # C++ work that releases the GIL, so a thread pool gives real
+            # parallelism (SURVEY §7 "overlap decode; don't let the device
+            # idle on file I/O"). Fully-warm scans never pay the pool setup.
+            # The worker count rides the shared HYPERSPACE_BUILD_DECODE_THREADS
+            # contract (`decode_pool_size`), so `=1` forces the serial path
+            # here exactly as it does for the build.
+            from concurrent.futures import ThreadPoolExecutor
 
-        def decode_miss_worker(i: int) -> Table:
-            with _accounting.use_ledger(led), _resilience.use_scope(sc):
-                _faults.check("pool.worker")
-                return decode_miss(i)
+            led = _accounting.current_ledger()  # charge workers to the submitter
+            sc = _resilience.current_scope()  # workers honor the query deadline
 
-        with ThreadPoolExecutor(max_workers=workers) as pool:
-            decoded = list(pool.map(decode_miss_worker, missing))
-        for i, t in zip(missing, decoded):
-            tables[i] = t
-    else:
-        for i in missing:
-            tables[i] = decode_miss(i)
+            def decode_miss_worker(i: int) -> Table:
+                with _accounting.use_ledger(led), _resilience.use_scope(sc):
+                    _faults.check("pool.worker")
+                    return decode_miss(i)
 
-    if partitions is not None:
-        tables = [
-            decorate_file_table(t, f, partitions, columns)
-            for f, t in zip(ordered, tables)
-        ]
-    out = tables[0] if len(tables) == 1 else Table.concat(tables)
-    if concat_key is not None:
-        global_concat_cache().put(concat_key, out, None)
-    return out
+            with ThreadPoolExecutor(max_workers=workers) as pool:
+                decoded = list(pool.map(decode_miss_worker, missing))
+            for i, t in zip(missing, decoded):
+                tables[i] = t
+        else:
+            for i in missing:
+                tables[i] = decode_miss(i)
+
+        if partitions is not None:
+            tables = [
+                decorate_file_table(t, f, partitions, columns)
+                for f, t in zip(ordered, tables)
+            ]
+        out = tables[0] if len(tables) == 1 else Table.concat(tables)
+        if concat_key is not None:
+            global_concat_cache().put(concat_key, out, None)
+        return out
+
+    if concat_key is None:
+        # Single file (the per-file flights inside `_decode_into_cache`
+        # dedup those) or unstattable inventory: no concat entry to share.
+        return _assemble()
+
+    def _reprobe() -> Optional[Table]:
+        hit = global_concat_cache().get(concat_key)
+        return hit[0] if hit is not None else None
+
+    # Scan-level single-flight: N identical concurrent cold multi-file scans
+    # assemble (decode + concat + dictionary union) ONCE; followers are
+    # served from the concat entry the leader put — their re-probe records
+    # the concat HIT their request really is.
+    return _singleflight.shared(("scan",) + concat_key, _assemble, _reprobe)
 
 
 def infer_schema(files: List[str], file_format: str) -> Schema:

@@ -580,11 +580,30 @@ class BucketedIndexScanExec(PhysicalNode):
             # not be stored — a rerun can NOT hit, and the annotated tree
             # must not suggest otherwise.
             _tracing.set_attr("bucketed_cache", "uncacheable")
-        buckets = self.execute_buckets(ctx)
-        table, starts = self._concat_with_starts(buckets, self.empty_table)
-        if key is not None:
-            global_bucketed_cache().put(key, table, starts)
-        return table, starts
+
+        def _assemble() -> Tuple[Table, np.ndarray]:
+            buckets = self.execute_buckets(ctx)
+            table, starts = self._concat_with_starts(buckets, self.empty_table)
+            if key is not None:
+                global_bucketed_cache().put(key, table, starts)
+            return table, starts
+
+        if key is None:
+            return _assemble()
+        # Single-flight over the bucketed-concat entry: two concurrent cold
+        # indexed joins re-assemble the per-bucket files once; the follower
+        # is served from the entry the leader put (`serve.singleflight`).
+        from ..serve import singleflight as _singleflight
+
+        def _reprobe():
+            hit = global_bucketed_cache().get(key)
+            if hit is not None:
+                # Correct the earlier 'miss' stamp: this node did no
+                # assembly — it was served by another query's flight.
+                _tracing.set_attr("bucketed_cache", "dedup_hit")
+            return hit
+
+        return _singleflight.shared(("bucketed", key), _assemble, _reprobe)
 
     def execute(self, ctx) -> Table:
         return self.execute_concat(ctx)[0]
@@ -707,24 +726,36 @@ class FilterExec(PhysicalNode):
         # full bucketed-concat cache exactly as before.
         from .scan_cache import global_bucketed_cache
 
-        pruned = None
-        if base_key is None or not global_bucketed_cache().contains(base_key):
-            pruned = child.execute_pruned_concat(ctx, self.condition)
-        if pruned is not None:
-            table, starts = pruned
-        else:
-            table, starts = child.execute_concat(ctx)
-        if table.num_rows:
-            mask = evaluate_predicate(self.condition, table)
-            keep = nonzero_indices(mask)  # ascending → in-bucket order kept
-            # Kept rows before each original bucket boundary = new boundary.
-            new_starts = np.searchsorted(keep, np.asarray(starts))
-            table = table.take(keep)
-            starts = new_starts
-        table = self._strip_internal(table)
-        if key is not None:
-            global_filtered_cache().put(key, table, starts)
-        return table, starts
+        def _assemble() -> Tuple[Table, np.ndarray]:
+            pruned = None
+            if base_key is None or not global_bucketed_cache().contains(base_key):
+                pruned = child.execute_pruned_concat(ctx, self.condition)
+            if pruned is not None:
+                table, starts = pruned
+            else:
+                table, starts = child.execute_concat(ctx)
+            if table.num_rows:
+                mask = evaluate_predicate(self.condition, table)
+                keep = nonzero_indices(mask)  # ascending → in-bucket order kept
+                # Kept rows before each original bucket boundary = new boundary.
+                new_starts = np.searchsorted(keep, np.asarray(starts))
+                table = table.take(keep)
+                starts = new_starts
+            table = self._strip_internal(table)
+            if key is not None:
+                global_filtered_cache().put(key, table, starts)
+            return table, starts
+
+        if key is None:
+            return _assemble()
+        # Single-flight beside the filtered-concat cache (the key already
+        # leads with "filtered" — the flight namespace below keeps it apart
+        # from the raw bucketed flights either way).
+        from ..serve import singleflight as _singleflight
+
+        return _singleflight.shared(
+            ("filtered_concat", key), _assemble, lambda: global_filtered_cache().get(key)
+        )
 
     def _condition_key(self, ctx) -> str:
         """Cache-key spelling of the condition. Spelling normalization is only
@@ -1717,34 +1748,63 @@ def _cached_by_table(cache: Dict[int, tuple], table: Table, subkey, compute):
                 _MEMO_HITS[tag].inc()
                 return hit
     _MEMO_MISSES[tag].inc()
-    val = compute()  # outside the lock: device work must not serialize queries
-    nbytes = _val_nbytes(val)
-    with _cache_lock:
-        ent = cache.get(key)  # re-read: another thread may have raced compute()
-        if ent is None or ent[0]() is not table:
-            if ent is not None:
-                # Stale id(table) reuse before the old weakref callback ran: the
-                # displaced entry's bytes must leave the accounting.
-                _device_cache_bytes -= _entry_nbytes(tag, ent)
 
-            def _evict(wr, tag=tag, key=key):
-                # Only drop the entry this weakref installed: a dead table's id
-                # can be reused by a NEW table before this deferred callback
-                # runs, and the replacement entry must survive it.
-                ent_now = _CACHES[tag].get(key)
-                if ent_now is not None and ent_now[0] is wr:
-                    _drop_entry(tag, key)
+    def _flight_probe():
+        with _cache_lock:
+            ent = cache.get(key)
+            if ent is not None and ent[0]() is table:
+                hit = ent[1].get(subkey, _MISS)
+                if hit is not _MISS:
+                    _touch(tag, key)
+                    return hit
+        return None
 
-            cache[key] = (weakref.ref(table, _evict), {subkey: val})
-            _device_cache_bytes += nbytes
-        elif subkey not in ent[1]:
-            ent[1][subkey] = val
-            _device_cache_bytes += nbytes
-        else:
-            val = ent[1][subkey]  # raced: keep the first insert's accounting
-        _touch(tag, key)
-        _evict_over_budget((tag, key))
-    return val
+    def _compute_and_insert():
+        # Compute AND insert inside the flight (still outside the cache lock:
+        # device work must not serialize queries): `shared`'s contract is
+        # that the leader's attempt populates the cache before followers are
+        # woken — inserting after the flight released would let a woken
+        # follower's probe miss and re-run the same pad/key64 program.
+        global _device_cache_bytes
+        val = compute()
+        nbytes = _val_nbytes(val)
+        with _cache_lock:
+            ent = cache.get(key)  # re-read: another thread may have raced
+            if ent is None or ent[0]() is not table:
+                if ent is not None:
+                    # Stale id(table) reuse before the old weakref callback
+                    # ran: the displaced entry's bytes must leave the
+                    # accounting.
+                    _device_cache_bytes -= _entry_nbytes(tag, ent)
+
+                def _evict(wr, tag=tag, key=key):
+                    # Only drop the entry this weakref installed: a dead
+                    # table's id can be reused by a NEW table before this
+                    # deferred callback runs, and the replacement entry must
+                    # survive it.
+                    ent_now = _CACHES[tag].get(key)
+                    if ent_now is not None and ent_now[0] is wr:
+                        _drop_entry(tag, key)
+
+                cache[key] = (weakref.ref(table, _evict), {subkey: val})
+                _device_cache_bytes += nbytes
+            elif subkey not in ent[1]:
+                ent[1][subkey] = val
+                _device_cache_bytes += nbytes
+            else:
+                val = ent[1][subkey]  # raced: keep the first insert's accounting
+            _touch(tag, key)
+            _evict_over_budget((tag, key))
+        return val
+
+    from ..serve import singleflight as _singleflight
+
+    # Single-flight over the compute+insert: two queries racing the same
+    # cold memo entry run ONE device program; followers are served by
+    # `_flight_probe` against the entry the leader inserted.
+    return _singleflight.shared(
+        ("memo", tag, key, subkey), _compute_and_insert, _flight_probe
+    )
 
 
 def _two_table_key(left: Table, right: Table, subkey: tuple, rows_key):
@@ -1786,25 +1846,44 @@ def _cached_two_table(
             _MEMO_HITS[tag].inc()
             return ent[2]
     _MEMO_MISSES[tag].inc()
-    val = compute()  # outside the lock: device work must not serialize queries
 
-    def _evict(wr, key=key):
-        ent_now = cache.get(key)
-        if ent_now is not None and (ent_now[0] is wr or ent_now[1] is wr):
-            _drop_entry(tag, key)
-
-    with _cache_lock:
-        ent = cache.get(key)  # re-read under the lock
-        if ent is not None:
-            if valid(ent):
+    def _flight_probe():
+        with _cache_lock:
+            ent = cache.get(key)
+            if ent is not None and valid(ent):
                 _touch(tag, key)
                 return ent[2]
-            _device_cache_bytes -= _val_nbytes(ent[2])
-        cache[key] = (weakref.ref(left, _evict), weakref.ref(right, _evict), val)
-        _device_cache_bytes += _val_nbytes(val)
-        _touch(tag, key)
-        _evict_over_budget((tag, key))
-    return val
+        return None
+
+    def _compute_and_insert():
+        # Compute AND insert inside the flight (same contract as
+        # `_cached_by_table`): followers wake to a populated entry.
+        global _device_cache_bytes
+        val = compute()
+
+        def _evict(wr, key=key):
+            ent_now = cache.get(key)
+            if ent_now is not None and (ent_now[0] is wr or ent_now[1] is wr):
+                _drop_entry(tag, key)
+
+        with _cache_lock:
+            ent = cache.get(key)  # re-read under the lock
+            if ent is not None:
+                if valid(ent):
+                    _touch(tag, key)
+                    return ent[2]
+                _device_cache_bytes -= _val_nbytes(ent[2])
+            cache[key] = (weakref.ref(left, _evict), weakref.ref(right, _evict), val)
+            _device_cache_bytes += _val_nbytes(val)
+            _touch(tag, key)
+            _evict_over_budget((tag, key))
+        return val
+
+    from ..serve import singleflight as _singleflight
+
+    # Single-flight over the compute+insert: concurrent identical joins run
+    # ONE probe/verify program per pair key instead of one per query.
+    return _singleflight.shared(("memo", tag, key), _compute_and_insert, _flight_probe)
 
 
 def _peek_two_table(

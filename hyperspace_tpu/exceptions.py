@@ -83,6 +83,21 @@ class RetryBudgetExceededError(PermanentError):
     systemic per query, so failing is better than retrying forever."""
 
 
+class AdmissionRejectedError(PermanentError):
+    """The serving layer REFUSED to run the query (`serve.scheduler`):
+    the submission queue is past ``HYPERSPACE_SERVE_QUEUE_DEPTH``, or the
+    tenant is past its ``HYPERSPACE_SERVE_TENANT_BUDGET`` of in-flight
+    queries. Classified as permanent so `resilience.retry_io` never spins on
+    an overloaded server — load shedding is the CALLER's backpressure signal
+    (retry later, with backoff of its own choosing). Carries the machine-
+    readable `reason` (``queue_depth`` / ``tenant_budget``) and `tenant`."""
+
+    def __init__(self, message: str, reason: str = "", tenant: str = ""):
+        super().__init__(message)
+        self.reason = reason
+        self.tenant = tenant
+
+
 def is_transient(exc: BaseException) -> bool:
     """Whether `exc` is retry-eligible. Hyperspace's own taxonomy decides for
     framework errors; for foreign exceptions, connection-ish/OS-level IO

@@ -82,6 +82,30 @@ class Hyperspace:
     def indexes(self) -> Table:
         return self._manager.indexes()
 
+    def server(
+        self,
+        max_concurrent: Optional[int] = None,
+        queue_depth: Optional[int] = None,
+        tenant_budget: Optional[int] = None,
+    ):
+        """A multi-tenant `serve.QueryServer` front door over this session's
+        engine process (docs/serving.md): bounded workers, priority lanes,
+        per-tenant admission control, and single-flight shared caches.
+
+            with hs.server() as srv:
+                fut = srv.submit(lambda: df.collect(), tenant="alice",
+                                 lane="interactive")
+
+        ``HYPERSPACE_SERVING=0`` makes every submission execute inline and
+        serially — the exact single-caller engine."""
+        from .serve import QueryServer
+
+        return QueryServer(
+            max_concurrent=max_concurrent,
+            queue_depth=queue_depth,
+            tenant_budget=tenant_budget,
+        )
+
     def explain(
         self,
         df: DataFrame,

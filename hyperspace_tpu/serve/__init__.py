@@ -1,0 +1,31 @@
+"""hyperspace_tpu.serve: the multi-tenant serving layer (ROADMAP item 2).
+
+One engine process, heavy parallel traffic. Three pieces compose:
+
+- `scheduler.QueryServer` — bounded worker pool, priority lanes
+  (``interactive`` before ``batch``), per-tenant admission control and
+  token budgets, classified `AdmissionRejectedError` load shedding.
+- `singleflight` — cross-query deduplication over the engine's shared
+  caches: N identical concurrent cold requests decode the lake once.
+- tenant labels end to end — every served query's root span, ledger,
+  exporter frame, and Prometheus series carries its tenant
+  (`telemetry.accounting.tenant_scope`).
+
+``HYPERSPACE_SERVING=0`` disables all of it: submissions execute inline,
+serially, byte-identical to a single caller (docs/serving.md).
+"""
+
+from .admission import (  # noqa: F401
+    ENV_QUEUE_DEPTH,
+    ENV_TENANT_BUDGET,
+    AdmissionController,
+    default_queue_depth,
+    default_tenant_budget,
+)
+from .scheduler import (  # noqa: F401
+    ENV_MAX_CONCURRENT,
+    LANES,
+    QueryServer,
+    default_max_concurrent,
+)
+from .singleflight import ENV_SERVING, serving_enabled, shared  # noqa: F401

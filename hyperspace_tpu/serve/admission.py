@@ -1,0 +1,137 @@
+"""Admission control: queue-depth rejection + per-tenant in-flight budgets.
+
+Uncoordinated peak demand is how one hot tenant starves the rest (the
+contention JSPIM addresses at the operator level, moved up to the query
+level): without admission control a burst just queues, every queued query
+holds its submitter's latency budget hostage, and the tail explodes. The
+controller enforces two cheap invariants at SUBMIT time, before any work is
+queued:
+
+- **Queue depth** (``HYPERSPACE_SERVE_QUEUE_DEPTH``, default 256): the total
+  number of admitted-but-unfinished queries the server will hold. Past it,
+  submissions fail fast with a classified `AdmissionRejectedError`
+  (``reason="queue_depth"``) — load shedding at the door beats timing out
+  inside.
+- **Tenant budget** (``HYPERSPACE_SERVE_TENANT_BUDGET``, default 0 =
+  unlimited): the in-flight (queued + running) query TOKENS one tenant may
+  hold. Each admitted query holds one token until it finishes; a tenant past
+  its budget gets `AdmissionRejectedError` (``reason="tenant_budget"``)
+  while everyone else keeps flowing — per-tenant isolation without weighing
+  queries against each other.
+
+``serve.admit`` is a named fault point (`telemetry.faults`): the chaos
+harness can make admission itself flaky, and the mixed-workload chaos leg
+asserts results stay byte-identical to serial execution anyway.
+
+Metrics: ``serve.admitted``, ``serve.rejected.queue_depth``,
+``serve.rejected.tenant_budget``, ``serve.tenants.active`` gauge.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict
+
+from ..exceptions import AdmissionRejectedError
+from ..telemetry import faults as _faults
+from ..telemetry import metrics as _metrics
+
+ENV_QUEUE_DEPTH = "HYPERSPACE_SERVE_QUEUE_DEPTH"
+ENV_TENANT_BUDGET = "HYPERSPACE_SERVE_TENANT_BUDGET"
+
+_DEFAULT_QUEUE_DEPTH = 256
+_DEFAULT_TENANT_BUDGET = 0  # unlimited
+
+_ADMITTED = _metrics.counter("serve.admitted")
+_REJECTED_DEPTH = _metrics.counter("serve.rejected.queue_depth")
+_REJECTED_TENANT = _metrics.counter("serve.rejected.tenant_budget")
+_TENANTS_ACTIVE = _metrics.gauge("serve.tenants.active")
+
+
+def default_queue_depth() -> int:
+    try:
+        return max(
+            1, int(os.environ.get(ENV_QUEUE_DEPTH, "") or _DEFAULT_QUEUE_DEPTH)
+        )
+    except ValueError:
+        return _DEFAULT_QUEUE_DEPTH
+
+
+def default_tenant_budget() -> int:
+    """0 = unlimited (the knob must be opted into — a default cap would make
+    the serving layer reject traffic the single-caller engine accepts)."""
+    try:
+        return max(
+            0, int(os.environ.get(ENV_TENANT_BUDGET, "") or _DEFAULT_TENANT_BUDGET)
+        )
+    except ValueError:
+        return _DEFAULT_TENANT_BUDGET
+
+
+class AdmissionController:
+    """In-flight token accounting for one `QueryServer`. `admit` either
+    grants a token (release it in a finally) or raises the classified
+    rejection — it never blocks: backpressure is the caller's policy."""
+
+    def __init__(self, queue_depth=None, tenant_budget=None):
+        self.queue_depth = (
+            default_queue_depth() if queue_depth is None else max(1, int(queue_depth))
+        )
+        self.tenant_budget = (
+            default_tenant_budget()
+            if tenant_budget is None
+            else max(0, int(tenant_budget))
+        )
+        self._lock = threading.Lock()
+        self._in_flight = 0
+        self._per_tenant: Dict[str, int] = {}
+
+    def admit(self, tenant: str) -> None:
+        """Grant one in-flight token to `tenant` or raise
+        `AdmissionRejectedError`. The ``serve.admit`` fault point fires first
+        (an injected fault is an admission-path failure, not a rejection)."""
+        _faults.check("serve.admit")
+        with self._lock:
+            if self._in_flight >= self.queue_depth:
+                _REJECTED_DEPTH.inc()
+                raise AdmissionRejectedError(
+                    f"server at HYPERSPACE_SERVE_QUEUE_DEPTH={self.queue_depth} "
+                    f"in-flight queries; rejecting tenant '{tenant}' (retry "
+                    "with backoff)",
+                    reason="queue_depth",
+                    tenant=tenant,
+                )
+            held = self._per_tenant.get(tenant, 0)
+            if self.tenant_budget and held >= self.tenant_budget:
+                _REJECTED_TENANT.inc()
+                raise AdmissionRejectedError(
+                    f"tenant '{tenant}' at HYPERSPACE_SERVE_TENANT_BUDGET="
+                    f"{self.tenant_budget} in-flight queries; rejecting (other "
+                    "tenants are unaffected)",
+                    reason="tenant_budget",
+                    tenant=tenant,
+                )
+            self._in_flight += 1
+            self._per_tenant[tenant] = held + 1
+            _TENANTS_ACTIVE.set(len(self._per_tenant))
+        _ADMITTED.inc()
+
+    def release(self, tenant: str) -> None:
+        with self._lock:
+            self._in_flight = max(0, self._in_flight - 1)
+            held = self._per_tenant.get(tenant, 0) - 1
+            if held <= 0:
+                self._per_tenant.pop(tenant, None)
+            else:
+                self._per_tenant[tenant] = held
+            _TENANTS_ACTIVE.set(len(self._per_tenant))
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "in_flight": self._in_flight,
+                "queue_depth": self.queue_depth,
+                "tenant_budget": self.tenant_budget,
+                "per_tenant": dict(self._per_tenant),
+            }

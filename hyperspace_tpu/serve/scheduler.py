@@ -1,0 +1,411 @@
+"""The query scheduler: bounded workers, priority lanes, tenant labels.
+
+`QueryServer` is the multi-tenant front door to one engine process. Callers
+submit thunks (typically ``lambda: df.collect()``); the server admission-
+checks them (`serve.admission`), queues them into a PRIORITY LANE, and runs
+them on a bounded worker pool. Everything below the thunk is the unmodified
+engine — scheduling composes with (never reaches into) the per-query
+machinery: each executed query opens its own `resilience.query_scope`
+(deadline + retry budget), its own root span/ledger (labeled with the
+submitting tenant via `accounting.tenant_scope`), and shares the process
+caches under single-flight deduplication (`serve.singleflight`).
+
+Design points:
+
+- **Bounded concurrency** (``HYPERSPACE_SERVE_MAX_CONCURRENT``, default 4):
+  worker THREADS, because the engine's heavy work releases the GIL (pyarrow
+  decode, XLA compile/execute) — io-bound decode-pool work and device-bound
+  XLA work from different queries genuinely interleave, while Python-level
+  bookkeeping serializes harmlessly. More workers than cores is fine for an
+  io-heavy mix; the decode pool underneath stays bounded by its own contract
+  (`engine.io.decode_pool_size`).
+- **Priority lanes**: ``interactive`` (point lookups, metadata probes) is
+  always popped before ``batch`` (cold scans, big aggregates), and with ≥2
+  workers ONE worker is RESERVED for the interactive lane — so even at full
+  batch saturation an interactive query starts immediately instead of
+  waiting out the shortest in-flight cold scan. Starvation the other way is
+  impossible because the remaining workers still pop interactive first and
+  interactive queries finish fast by definition of being routed there.
+- **Exact fallback**: ``HYPERSPACE_SERVING=0`` executes every submission
+  INLINE on the submitting thread under one server-wide lock — one query at
+  a time, in arrival order, no admission control, no flights: byte-identical
+  single-caller behavior (the same flag contract as
+  ``HYPERSPACE_QUERY_STREAMING=0``). Futures resolve before `submit`
+  returns.
+
+Metrics: ``serve.queue.depth`` / ``serve.active`` gauges,
+``serve.queue.wait_s`` histogram (admission → execution-start),
+``serve.latency.interactive|batch`` histograms (admission → completion),
+``serve.completed`` / ``serve.failed`` counters — on top of the admission
+and single-flight counters of the sibling modules.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Callable, Optional, TypeVar
+
+from .. import resilience as _resilience
+from ..exceptions import HyperspaceException
+from ..telemetry import accounting as _accounting
+from ..telemetry import metrics as _metrics
+from .admission import AdmissionController
+from .singleflight import serving_enabled
+
+ENV_MAX_CONCURRENT = "HYPERSPACE_SERVE_MAX_CONCURRENT"
+ENV_BATCH_NICE = "HYPERSPACE_SERVE_BATCH_NICE"
+ENV_GIL_SWITCH_S = "HYPERSPACE_SERVE_GIL_SWITCH_S"
+ENV_GC_TUNE = "HYPERSPACE_SERVE_GC_TUNE"
+_DEFAULT_MAX_CONCURRENT = 4
+#: `sys.setswitchinterval` applied once when the first worker spawns (0
+#: disables): a batch thread holding the GIL in Python code then offers it
+#: every millisecond instead of every five — the OS wakes the higher-
+#: priority interactive worker at each offer. Process-global by nature; a
+#: serving process stays a serving process.
+_DEFAULT_GIL_SWITCH_S = 0.001
+#: Niceness the non-reserved (batch-eligible) workers give THEMSELVES at
+#: spawn: on a saturated core the OS then schedules the reserved interactive
+#: worker (nice 0) ahead of batch whenever both are runnable. Lowering one's
+#: own priority needs no privileges; 0 disables.
+_DEFAULT_BATCH_NICE = 10
+#: Cooperative yield: how long one batch-lane boundary pause may last, in
+#: slices — bounded so interactive pressure NUDGES batch, never starves it.
+_YIELD_SLICE_S = 0.002
+_YIELD_MAX_S = 0.05
+
+#: Lane pop order IS the priority order.
+LANES = ("interactive", "batch")
+
+_QUEUE_DEPTH = _metrics.gauge("serve.queue.depth")
+_ACTIVE = _metrics.gauge("serve.active")
+_QUEUE_WAIT_S = _metrics.histogram("serve.queue.wait_s")
+_COMPLETED = _metrics.counter("serve.completed")
+_FAILED = _metrics.counter("serve.failed")
+_LANE_LATENCY = {lane: _metrics.histogram(f"serve.latency.{lane}") for lane in LANES}
+
+
+def default_max_concurrent() -> int:
+    try:
+        return max(
+            1, int(os.environ.get(ENV_MAX_CONCURRENT, "") or _DEFAULT_MAX_CONCURRENT)
+        )
+    except ValueError:
+        return _DEFAULT_MAX_CONCURRENT
+
+
+def _batch_nice() -> int:
+    try:
+        return max(
+            0, int(os.environ.get(ENV_BATCH_NICE, "") or _DEFAULT_BATCH_NICE)
+        )
+    except ValueError:
+        return _DEFAULT_BATCH_NICE
+
+
+# -- interactive pressure (the cooperative yield gate's state) --------------
+# Queued-or-running interactive queries, process-wide (all servers share the
+# engine's caches and the one CPU budget, so the gate is global too).
+_pressure_lock = threading.Lock()
+_interactive_pending = 0
+
+
+def _interactive_begin() -> None:
+    global _interactive_pending
+    with _pressure_lock:
+        _interactive_pending += 1
+
+
+def _interactive_end() -> None:
+    global _interactive_pending
+    with _pressure_lock:
+        _interactive_pending = max(0, _interactive_pending - 1)
+
+
+def interactive_pending() -> bool:
+    return _interactive_pending > 0
+
+
+def _yield_to_interactive() -> None:
+    """Batch-lane boundary pause (registered into `resilience.check_deadline`
+    when the first worker spawns): while interactive work is queued or
+    running, batch threads sleep in small slices — on a saturated core this
+    hands a point lookup the CPU mid-scan, something thread priority alone
+    cannot do against GIL-holding stretches. Bounded at `_YIELD_MAX_S` per
+    boundary so heavy interactive traffic slows batch, never stops it.
+    A batch thread LEADING a single-flight someone waits on never pauses —
+    the waiter may BE the interactive query (priority inversion otherwise)."""
+    from .singleflight import leading_with_followers
+
+    waited = 0.0
+    while _interactive_pending > 0 and waited < _YIELD_MAX_S:
+        if leading_with_followers():
+            return
+        time.sleep(_YIELD_SLICE_S)
+        waited += _YIELD_SLICE_S
+
+
+T = TypeVar("T")
+
+
+class _Item:
+    __slots__ = ("future", "fn", "tenant", "lane", "t_admitted")
+
+    def __init__(self, future, fn, tenant, lane):
+        self.future = future
+        self.fn = fn
+        self.tenant = tenant
+        self.lane = lane
+        self.t_admitted = time.monotonic()
+
+
+class QueryServer:
+    """One serving front door over the ambient engine process.
+
+    >>> with QueryServer() as srv:
+    ...     fut = srv.submit(lambda: df.collect(), tenant="alice",
+    ...                      lane="interactive")
+    ...     table = fut.result()
+
+    Constructor args override the env knobs (None = env/default). The server
+    is reusable across queries and tenants; `close()` (or the context exit)
+    drains queued work and joins the workers."""
+
+    def __init__(
+        self,
+        max_concurrent: Optional[int] = None,
+        queue_depth: Optional[int] = None,
+        tenant_budget: Optional[int] = None,
+    ):
+        self.max_concurrent = (
+            default_max_concurrent()
+            if max_concurrent is None
+            else max(1, int(max_concurrent))
+        )
+        self.admission = AdmissionController(queue_depth, tenant_budget)
+        self._cv = threading.Condition()
+        self._lanes = {lane: deque() for lane in LANES}
+        self._workers: list = []
+        self._closed = False
+        # The HYPERSPACE_SERVING=0 fallback: one query at a time, inline.
+        self._serial_lock = threading.Lock()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def __enter__(self) -> "QueryServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _ensure_workers_locked(self) -> None:
+        """Spawn workers lazily up to the bound (holding `_cv`): a server
+        that only ever ran the serial fallback never owns a thread. Worker 0
+        is the RESERVED interactive worker whenever there are ≥2 workers
+        (with exactly one, it must serve both lanes or batch would starve)."""
+        if not self._workers:
+            _resilience.register_yield_hook(_yield_to_interactive)
+            try:
+                switch = float(
+                    os.environ.get(ENV_GIL_SWITCH_S, "") or _DEFAULT_GIL_SWITCH_S
+                )
+            except ValueError:
+                switch = _DEFAULT_GIL_SWITCH_S
+            if switch > 0:
+                import sys
+
+                sys.setswitchinterval(min(sys.getswitchinterval(), switch))
+            if os.environ.get(ENV_GC_TUNE, "") != "0":
+                # Measured on this engine: CPython gen-2 collections pause
+                # EVERY thread 20-40 ms — the single biggest point-lookup
+                # tail event once scheduling is fixed. Freeze the warm
+                # startup set out of the scan and make full collections 10x
+                # rarer (gen-0/1 cadence unchanged, so short-lived query
+                # garbage still collects promptly). `=0` opts out.
+                import gc
+
+                gc.freeze()
+                t0, t1, _t2 = gc.get_threshold()
+                gc.set_threshold(t0, t1, 100)
+        while len(self._workers) < self.max_concurrent:
+            idx = len(self._workers)
+            reserved = idx == 0 and self.max_concurrent >= 2
+            t = threading.Thread(
+                target=self._worker_loop,
+                args=(reserved,),
+                name=f"hyperspace-serve-{idx}{'-interactive' if reserved else ''}",
+                daemon=True,
+            )
+            # Start BEFORE registering: a failed start (thread limit) must
+            # not leave an unstarted Thread in _workers for close() to
+            # crash joining.
+            t.start()
+            self._workers.append(t)
+
+    def close(self, wait: bool = True) -> None:
+        """Stop accepting work; queued work still runs (futures resolve)."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+            workers = list(self._workers)
+        if wait:
+            for t in workers:
+                t.join()
+
+    # -- submission ---------------------------------------------------------
+
+    def submit(
+        self,
+        fn: Callable[[], T],
+        *,
+        tenant: str = "default",
+        lane: str = "batch",
+    ) -> "Future[T]":
+        """Admission-check and enqueue one query thunk; returns its future.
+
+        Raises `AdmissionRejectedError` (queue depth / tenant budget) at the
+        door — a rejected query holds no slot and owns no future. `lane` is
+        ``interactive`` (priority: point lookups and other sub-second work)
+        or ``batch`` (default)."""
+        if lane not in LANES:
+            raise HyperspaceException(
+                f"Unknown serve lane '{lane}'; expected one of {LANES}"
+            )
+        with self._cv:
+            if self._closed:
+                raise HyperspaceException("QueryServer is closed")
+        if not serving_enabled():
+            return self._run_serial(fn, tenant)
+        self.admission.admit(tenant)
+        fut: "Future[T]" = Future()
+        item = _Item(fut, fn, tenant, lane)
+        try:
+            with self._cv:
+                if self._closed:
+                    raise HyperspaceException("QueryServer is closed")
+                self._ensure_workers_locked()
+                if lane == "interactive":
+                    _interactive_begin()  # ended in _execute's finally
+                self._lanes[lane].append(item)
+                _QUEUE_DEPTH.set(sum(len(q) for q in self._lanes.values()))
+                # notify_all, not notify: a single wake could land on the
+                # reserved interactive worker for a batch item, which would
+                # ignore it and leave the item queued with everyone else
+                # asleep.
+                self._cv.notify_all()
+        except BaseException:
+            # Enqueue failed (closed race, worker spawn at the thread
+            # limit): the admission token must not leak — a leaked token
+            # would ratchet _in_flight until the server rejects everything.
+            self.admission.release(tenant)
+            raise
+        return fut
+
+    def run(self, fn: Callable[[], T], *, tenant: str = "default", lane: str = "batch") -> T:
+        """`submit` + wait: the blocking convenience for scripted callers."""
+        return self.submit(fn, tenant=tenant, lane=lane).result()
+
+    def _run_serial(self, fn, tenant: str) -> Future:
+        """The ``HYPERSPACE_SERVING=0`` path: execute inline on the calling
+        thread, one submission at a time — indistinguishable from a single
+        caller invoking the engine directly (no admission, no lanes, no
+        flights; the tenant label still rides for telemetry parity)."""
+        fut: Future = Future()
+        fut.set_running_or_notify_cancel()
+        try:
+            with self._serial_lock, _accounting.tenant_scope(tenant):
+                out = fn()
+        except BaseException as e:
+            _FAILED.inc()
+            fut.set_exception(e)
+            return fut
+        _COMPLETED.inc()
+        fut.set_result(out)
+        return fut
+
+    # -- execution ----------------------------------------------------------
+
+    def _pop_locked(self, reserved: bool = False) -> Optional[_Item]:
+        lanes = ("interactive",) if reserved else LANES
+        for lane in lanes:  # priority = declaration order
+            if self._lanes[lane]:
+                item = self._lanes[lane].popleft()
+                _QUEUE_DEPTH.set(sum(len(q) for q in self._lanes.values()))
+                return item
+        return None
+
+    def _worker_loop(self, reserved: bool = False) -> None:
+        if not reserved and self.max_concurrent >= 2:
+            # Batch-eligible workers deprioritize THEMSELVES (allowed without
+            # privileges): on a saturated core the OS then runs the reserved
+            # interactive worker first whenever both are runnable. The numpy/
+            # eval work of a batch query runs on this thread, so the niceness
+            # covers exactly the contention that matters.
+            nice = _batch_nice()
+            if nice:
+                try:
+                    os.setpriority(os.PRIO_PROCESS, threading.get_native_id(), nice)
+                except (OSError, AttributeError):
+                    pass  # unsupported platform/container: priority is a nudge
+        while True:
+            with self._cv:
+                item = self._pop_locked(reserved)
+                while item is None and not self._closed:
+                    self._cv.wait()
+                    item = self._pop_locked(reserved)
+            if item is None:
+                return  # closed and drained
+            self._execute(item)
+
+    def _execute(self, item: _Item) -> None:
+        t_start = time.monotonic()
+        if not item.future.set_running_or_notify_cancel():
+            self.admission.release(item.tenant)
+            if item.lane == "interactive":
+                _interactive_end()
+            return  # caller cancelled while queued
+        _QUEUE_WAIT_S.observe(t_start - item.t_admitted)
+        _ACTIVE.inc()
+        try:
+            # The tenant label wraps the WHOLE query: the root span/ledger
+            # the thunk opens (collect/count/build) inherits it, and every
+            # pool worker below inherits it through the ledger. The lane
+            # label rides the query scope the same way — batch-lane threads
+            # then pause at chunk boundaries while interactive work is
+            # pending (`_yield_to_interactive`).
+            with _accounting.tenant_scope(item.tenant), _resilience.lane_scope(
+                item.lane
+            ):
+                out = item.fn()
+        except BaseException as e:
+            _FAILED.inc()
+            item.future.set_exception(e)
+        else:
+            _COMPLETED.inc()
+            item.future.set_result(out)
+        finally:
+            _ACTIVE.dec()
+            if item.lane == "interactive":
+                _interactive_end()
+            self.admission.release(item.tenant)
+            _LANE_LATENCY[item.lane].observe(time.monotonic() - item.t_admitted)
+
+    # -- introspection ------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._cv:
+            queued = {lane: len(q) for lane, q in self._lanes.items()}
+            workers = len(self._workers)
+        out = self.admission.stats()
+        out.update(
+            {
+                "queued": queued,
+                "workers": workers,
+                "max_concurrent": self.max_concurrent,
+                "serving_enabled": serving_enabled(),
+            }
+        )
+        return out

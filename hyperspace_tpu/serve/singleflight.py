@@ -1,0 +1,188 @@
+"""Single-flight deduplication over the engine's shared caches.
+
+Under concurrent traffic the dominant redundant cost is identical cold work:
+two tenants issuing the same cold scan in the same instant each miss the
+cache and each decode the lake — the caches only help AFTER someone finishes
+(the external-memory cost model of "Evaluating Learned Indexes for
+External-Memory Joins": cost = bytes moved, and here the bytes move twice).
+Single-flight collapses that: the FIRST requester of a cold cache entry
+becomes the LEADER and computes it; every concurrent requester of the same
+key becomes a FOLLOWER that blocks until the leader finishes, then re-probes
+the cache the leader populated. N identical concurrent cold requests decode
+once; N−1 are served for a wait.
+
+Keying: flights are keyed by the SAME keys the underlying caches use —
+per-file scan-cache entries (projection + row-group selection: a pruned
+decode's flight can never alias the whole-file flight, exactly like the
+cache keys it guards), footer-metadata entries, multi-file concat keys, and
+bucketed/filtered concat keys. One process-wide flight table covers them all
+(keys are namespaced tuples).
+
+Failure propagation — the poisoned-entry rules:
+
+- A leader FAILURE never poisons followers: the flight is cleared in a
+  ``finally`` and marked not-ok, the leader's exception propagates to the
+  leader's caller only, and each follower INDEPENDENTLY retries (becoming
+  the next leader) — composing with the PR-7 retry/quarantine contracts,
+  which the leader's own attempt already rode. Nothing about a failure is
+  cached (the standing only-cache-on-success contract), so a follower's
+  retry starts clean.
+- A follower's WAIT is bounded by its own query deadline
+  (`resilience.check_deadline`): a leader that hangs past the follower's
+  ``HYPERSPACE_QUERY_TIMEOUT_S`` costs the follower a classified
+  `QueryTimeoutError`, never an unbounded block. A leader that itself times
+  out clears the flight on the way out, unblocking followers immediately.
+- A successful leader whose entry was EVICTED before the follower re-probed
+  (pathologically small budget) degrades to the follower leading its own
+  flight — correct, just not deduplicated.
+
+``HYPERSPACE_SERVING=0`` disables every flight: `shared` runs the attempt
+inline, byte-and-accounting-identical to the single-caller engine (the same
+flag-contract style as STREAMING/PUSHDOWN/ENCODED_EXEC).
+
+Metrics: ``serve.singleflight.leaders`` (flights led),
+``serve.singleflight.dedup_hits`` (followers served by a leader's work —
+each one is a whole cold decode NOT paid), ``serve.singleflight.
+follower_retries`` (followers that retried after a leader failure/eviction),
+``serve.singleflight.wait_s`` histogram (follower block time).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, Optional, TypeVar
+
+from .. import resilience as _resilience
+from ..telemetry import metrics as _metrics
+
+ENV_SERVING = "HYPERSPACE_SERVING"
+
+_LEADERS = _metrics.counter("serve.singleflight.leaders")
+_DEDUP_HITS = _metrics.counter("serve.singleflight.dedup_hits")
+_FOLLOWER_RETRIES = _metrics.counter("serve.singleflight.follower_retries")
+_WAIT_S = _metrics.histogram("serve.singleflight.wait_s")
+
+#: Follower wake-up slice while waiting on a leader: long enough to cost
+#: nothing, short enough that a query deadline is honored promptly.
+_WAIT_SLICE_S = 0.05
+
+
+def serving_enabled() -> bool:
+    """Default ON; ``HYPERSPACE_SERVING=0`` is the exact single-caller
+    fallback — no flights, no scheduler concurrency, every code path
+    byte-identical to the pre-serving engine."""
+    return os.environ.get(ENV_SERVING, "") != "0"
+
+
+class _Flight:
+    """One in-progress computation other requesters can wait on."""
+
+    __slots__ = ("done", "ok", "waiters")
+
+    def __init__(self):
+        self.done = threading.Event()
+        self.ok = False  # set True by a leader that completed normally
+        self.waiters = 0  # followers currently blocked on this flight
+
+
+_lock = threading.Lock()
+_flights: Dict[tuple, _Flight] = {}
+
+# Flights THIS thread currently leads (leaders can nest: a scan-flight
+# leader leads file flights inside it). Read by `leading_with_followers` —
+# the anti-priority-inversion predicate of the scheduler's yield gate.
+_local = threading.local()
+
+
+def leading_with_followers() -> bool:
+    """True when this thread leads a flight someone is blocked on — its work
+    is on another query's critical path, so the cooperative yield gate must
+    NOT pause it (a batch leader sleeping while an interactive follower
+    waits on its flight would be priority inversion, not protection)."""
+    flights = getattr(_local, "leading", None)
+    return bool(flights) and any(fl.waiters > 0 for fl in flights)
+
+
+def in_flight_count() -> int:
+    """Live flight count (tests / stats)."""
+    with _lock:
+        return len(_flights)
+
+
+T = TypeVar("T")
+
+
+def _wait(fl: _Flight) -> None:
+    """Block until the flight completes, honoring the ambient query deadline
+    at every wake-up slice — a hung leader costs a follower its classified
+    `QueryTimeoutError`, never an unbounded wait."""
+    t0 = time.monotonic()
+    while not fl.done.wait(_WAIT_SLICE_S):
+        _resilience.check_deadline("serve.singleflight")
+    _WAIT_S.observe(time.monotonic() - t0)
+
+
+def shared(
+    key: tuple,
+    attempt: Callable[[], T],
+    reprobe: Optional[Callable[[], Optional[T]]] = None,
+) -> T:
+    """Run `attempt` with at most ONE concurrent execution per `key`.
+
+    The first caller (leader) runs `attempt` — which is expected to populate
+    the underlying cache on success. Concurrent callers (followers) wait;
+    when the leader succeeded they return `reprobe()` (the accounting-true
+    cache re-probe — a non-None value ticks ``dedup_hits``). A follower whose
+    leader failed, or whose re-probe found the entry already evicted, loops
+    and leads its own flight (independent retry, no poisoned entry).
+
+    With no `reprobe` (pure compute, nothing cached) a follower always
+    retries — dedup then only bounds concurrency, not total work; every
+    engine integration passes one. Serving disabled = `attempt()` verbatim.
+    """
+    if not serving_enabled():
+        return attempt()
+    while True:
+        with _lock:
+            fl = _flights.get(key)
+            if fl is None:
+                fl = _Flight()
+                _flights[key] = fl
+                leader = True
+            else:
+                leader = False
+        if leader:
+            _LEADERS.inc()
+            leading = getattr(_local, "leading", None)
+            if leading is None:
+                leading = _local.leading = []
+            leading.append(fl)
+            try:
+                out = attempt()
+                fl.ok = True
+                return out
+            finally:
+                leading.pop()
+                # Clear BEFORE waking: a woken follower that retries must
+                # find the slot free (or taken by another follower), never
+                # this completed flight.
+                with _lock:
+                    _flights.pop(key, None)
+                fl.done.set()
+        with _lock:
+            fl.waiters += 1
+        try:
+            _wait(fl)
+        finally:
+            with _lock:
+                fl.waiters -= 1
+        if fl.ok and reprobe is not None:
+            hit = reprobe()
+            if hit is not None:
+                _DEDUP_HITS.inc()
+                return hit
+        # Leader failed (its exception is its caller's; ours starts clean) or
+        # the entry was already evicted: retry independently.
+        _FOLLOWER_RETRIES.inc()

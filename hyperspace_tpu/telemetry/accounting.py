@@ -77,6 +77,35 @@ _current: "contextvars.ContextVar[Optional[QueryLedger]]" = contextvars.ContextV
     "hyperspace_query_ledger", default=None
 )
 
+#: Ambient tenant label (the serving layer's `QueryServer` sets it around
+#: each executed query; direct single-caller use leaves it None). Read once
+#: at ledger open — pool workers inherit it THROUGH the ledger, so no
+#: separate propagation contract is needed.
+_tenant: "contextvars.ContextVar[Optional[str]]" = contextvars.ContextVar(
+    "hyperspace_tenant", default=None
+)
+
+# Per-tenant rollup of closed ledgers: the admission/billing view of the
+# same numbers the per-query ledgers carry (exporter frames' `tenants` key
+# and the `prometheus_text` tenant series read this — one producer).
+_TENANT_FIELDS = (
+    "wall_s",
+    "bytes_decoded",
+    "decode_files",
+    "rows_produced",
+    "cache_bytes_charged",
+    "io_retries",
+)
+_tenant_totals: dict = {}
+_tenant_lock = threading.Lock()
+#: Rollup cardinality bound: tenant labels are arbitrary caller strings, and
+#: the rollup is monotonic by design — without a cap, per-request labels
+#: would grow every exporter frame and Prometheus scrape without bound.
+#: Labels past the cap aggregate into one literal "<other>" bucket (totals
+#: stay exact; only the attribution coarsens).
+TENANT_ROLLUP_MAX = 256
+TENANT_OVERFLOW = "<other>"
+
 _RECENT: "deque[QueryLedger]" = deque(maxlen=32)
 _recent_lock = threading.Lock()
 # Exporter drain queue: bounded so an idle exporter (or none at all) can
@@ -87,11 +116,12 @@ _PENDING: "deque[dict]" = deque(maxlen=256)
 class QueryLedger:
     """Thread-safe resource accumulator for one root query scope."""
 
-    __slots__ = ("query_id", "name", "start_s", "wall_s", "_lock", "_counts")
+    __slots__ = ("query_id", "name", "tenant", "start_s", "wall_s", "_lock", "_counts")
 
-    def __init__(self, query_id: str, name: str):
+    def __init__(self, query_id: str, name: str, tenant: Optional[str] = None):
         self.query_id = query_id
         self.name = name
+        self.tenant = tenant
         self.start_s = time.time()
         self.wall_s: Optional[float] = None
         self._lock = threading.Lock()
@@ -116,6 +146,8 @@ class QueryLedger:
                 "name": self.name,
                 "start_s": round(self.start_s, 6),
             }
+            if self.tenant is not None:
+                out["tenant"] = self.tenant
             if self.wall_s is not None:
                 out["wall_s"] = round(self.wall_s, 6)
             for k in sorted(self._counts):
@@ -126,11 +158,15 @@ class QueryLedger:
 
 def enabled() -> bool:
     """Whether query scopes should carry a ledger: any tracing sink is active
-    (a traced query always gets one), the continuous exporter is running, or
-    ``HYPERSPACE_ACCOUNTING=1`` forces it. One predicate on the root-scope
-    path only — per-observation `add` calls gate on the ambient ledger, not
-    on this."""
+    (a traced query always gets one), the continuous exporter is running,
+    ``HYPERSPACE_ACCOUNTING=1`` forces it — or the query carries a TENANT
+    label (a served query is always accounted: per-tenant budgets/rollups
+    are the serving layer's currency, and the label is the opt-in). One
+    predicate on the root-scope path only — per-observation `add` calls gate
+    on the ambient ledger, not on this."""
     if os.environ.get(ENV_ACCOUNTING) == "1":
+        return True
+    if _tenant.get() is not None:
         return True
     from . import tracing
 
@@ -178,6 +214,60 @@ def use_ledger(led: Optional[QueryLedger]) -> Iterator[None]:
         _current.reset(token)
 
 
+@contextlib.contextmanager
+def tenant_scope(tenant: Optional[str]) -> Iterator[None]:
+    """Label every root query opened under this scope with `tenant`: the
+    ledger carries it (`QueryLedger.tenant`, JSONL/exporter frames), the root
+    span gets a ``tenant`` attr, and closed ledgers aggregate into the
+    per-tenant rollup. The serving layer wraps each executed query; None
+    passes through unchanged (direct single-caller use stays label-free)."""
+    if tenant is None:
+        yield
+        return
+    token = _tenant.set(str(tenant))
+    try:
+        yield
+    finally:
+        _tenant.reset(token)
+
+
+def current_tenant() -> Optional[str]:
+    return _tenant.get()
+
+
+def _bank_tenant(led: "QueryLedger") -> None:
+    """Fold one closed ledger into the per-tenant rollup (only labeled
+    queries participate — unlabeled traffic stays out of tenant billing)."""
+    if led.tenant is None:
+        return
+    with _tenant_lock:
+        name = led.tenant
+        if name not in _tenant_totals and len(_tenant_totals) >= TENANT_ROLLUP_MAX:
+            name = TENANT_OVERFLOW
+        t = _tenant_totals.setdefault(name, {"queries": 0})
+        t["queries"] += 1
+        for f in _TENANT_FIELDS:
+            v = led.wall_s if f == "wall_s" else led.get(f)
+            if v:
+                t[f] = round(t.get(f, 0) + v, 6) if isinstance(v, float) else t.get(f, 0) + v
+
+
+def tenant_rollup() -> dict:
+    """Per-tenant totals over every labeled ledger closed so far:
+    ``{tenant: {queries, wall_s, bytes_decoded, decode_files, rows_produced,
+    cache_bytes_charged, io_retries}}`` — the exporter's `tenants` frame key
+    and the `prometheus_text` tenant series render exactly this."""
+    with _tenant_lock:
+        return {k: dict(v) for k, v in _tenant_totals.items()}
+
+
+def reset_tenant_rollup() -> None:
+    """Zero the rollup (tests; the exporter never resets — tenant totals are
+    monotonic like the cache stats)."""
+    with _tenant_lock:
+        _tenant_totals.clear()
+
+
 #: Device-buffer sampling rate limit: `jax.live_arrays()` walks EVERY live
 #: buffer, so a serving process with thousands of resident device arrays
 #: must not pay that walk per sub-millisecond query. Ledgers closing inside
@@ -221,7 +311,7 @@ def ledger_scope(query_id: str, name: str, root=None) -> Iterator[QueryLedger]:
     if existing is not None:
         yield existing
         return
-    led = QueryLedger(query_id, name)
+    led = QueryLedger(query_id, name, tenant=_tenant.get())
     token = _current.set(led)
     t0 = time.monotonic()
     try:
@@ -245,6 +335,7 @@ def ledger_scope(query_id: str, name: str, root=None) -> Iterator[QueryLedger]:
             v = led.get(field)
             if v:
                 _metrics.counter(f"accounting.{field}").inc(v)
+        _bank_tenant(led)
         d = led.to_dict()
         if root is not None:
             try:

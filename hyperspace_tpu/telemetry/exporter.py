@@ -115,6 +115,12 @@ class MetricsExporter:
         from .. import resilience as _resilience
 
         out["reliability"] = _resilience.reliability_rollup(out["snapshot"])
+        # Per-tenant rollup (serving traffic): cumulative totals of every
+        # labeled ledger — omitted entirely for unlabeled single-caller runs
+        # so pre-serving frame consumers see byte-identical schemas.
+        tenants = accounting.tenant_rollup()
+        if tenants:
+            out["tenants"] = tenants
         dev = _device_live_bytes()
         if dev is not None:
             out["device_live_bytes"] = dev
@@ -276,4 +282,28 @@ def prometheus_text(prefix: str = "hyperspace") -> str:
             lines.append(f'{n}_bucket{{le="{_prom_num(le)}"}} {cum}')
         lines.append(f"{n}_sum {_prom_num(round(total, 6))}")
         lines.append(f"{n}_count {count}")
+    # Per-tenant series (serving traffic): the accounting rollup rendered as
+    # labeled counters — `tenant` is the label dimension, one series per
+    # rollup field. Absent tenants emit nothing (no dead zero series).
+    from . import accounting as _accounting
+
+    tenants = _accounting.tenant_rollup()
+    if tenants:
+        fields = sorted({f for t in tenants.values() for f in t})
+        for field in fields:
+            n = f"{prefix}_tenant_{_prom_name(field)}"
+            lines.append(f"# TYPE {n} counter")
+            for tenant in sorted(tenants):
+                v = tenants[tenant].get(field)
+                if v is None:
+                    continue
+                # Label-value escaping per the exposition format: backslash,
+                # quote, AND newline (a raw \n would invalidate the whole
+                # scrape payload, not just this series).
+                esc = (
+                    tenant.replace("\\", "\\\\")
+                    .replace('"', '\\"')
+                    .replace("\n", "\\n")
+                )
+                lines.append(f'{n}{{tenant="{esc}"}} {_prom_num(v)}')
     return "\n".join(lines) + "\n"

@@ -18,6 +18,9 @@ real operation):
 - ``log.write``    — an operation-log entry write (`IndexLogManagerImpl.write_log`)
 - ``pool.worker``  — a decode/build pool worker task body (worker-crash paths)
 - ``device.compile``— an `observed_jit` program dispatch (`telemetry.compile_log`)
+- ``serve.admit``  — a serving-layer admission decision
+  (`serve.admission.AdmissionController.admit`; the chaos mixed-workload leg
+  injects here to prove scheduling faults never change query results)
 
 Configuration — ``HYPERSPACE_FAULTS`` (comma-separated specs) or the
 programmatic API (`configure` / `inject`, which take precedence over the env):
@@ -67,6 +70,7 @@ FAULT_POINTS = (
     "log.write",
     "pool.worker",
     "device.compile",
+    "serve.admit",
 )
 
 _INJECTED = _metrics.counter("faults.injected")

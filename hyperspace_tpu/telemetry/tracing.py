@@ -316,6 +316,12 @@ def query_span(name: str, **attrs) -> Iterator:
         return
     trace = QueryTrace(new_query_id())
     root = Span(trace, name, None, attrs)
+    # Tenant label end to end: the ambient tenant (the serving layer's
+    # `tenant_scope`) rides the root span like it rides the ledger, so the
+    # JSONL trace and explain(analyze) attribute the query to its tenant.
+    tenant = _accounting.current_tenant()
+    if tenant is not None:
+        root.set_attr("tenant", tenant)
     token = _current_span.set(root)
     ann = _annotation(name)
     led = _accounting.ledger_scope(trace.query_id, name, root=root)
