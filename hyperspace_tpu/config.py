@@ -194,6 +194,17 @@ class HyperspaceConf:
 
     @property
     def distributed_enabled(self) -> bool:
+        # HYPERSPACE_DISTRIBUTED is the process-level master switch, in the
+        # standing env-flag fallback-contract style (BUILD_DECODE_THREADS,
+        # QUERY_STREAMING, ...): "0" pins the exact single-device path
+        # byte-for-byte, "1" (or any other non-empty value) enables the mesh
+        # path, unset defers to the session conf. Read per call so tests can
+        # flip it without touching session state.
+        import os
+
+        env = os.environ.get("HYPERSPACE_DISTRIBUTED")
+        if env is not None and env != "":
+            return env != "0"
         return self._c.get_bool(
             IndexConstants.DISTRIBUTED_ENABLED, IndexConstants.DISTRIBUTED_ENABLED_DEFAULT
         )

@@ -2516,15 +2516,21 @@ class SortMergeJoinExec(PhysicalNode):
                 else None
             )
             if mesh is not None:
-                # Sharded probe: each device joins its own bucket range with
-                # zero collectives (non-divisible bucket counts are padded
-                # with empty virtual buckets inside).
-                from ..parallel.table_ops import probe_dist_blocks
+                from ..ops.bucket_join import mesh_probe_skew_safe
 
-                l_blocks = _dist_blocks(left, l_starts, self.left_keys, mesh)
-                r_blocks = _dist_blocks(right, r_starts, self.right_keys, mesh)
-                if l_blocks is not None and r_blocks is not None:
-                    pairs = probe_dist_blocks(mesh, l_blocks, r_blocks)
+                if mesh_probe_skew_safe(l_starts, r_starts):
+                    # Sharded probe: each device joins its own bucket range
+                    # with zero collectives (non-divisible bucket counts are
+                    # padded with empty virtual buckets inside). Outlier-
+                    # skewed bucket layouts skip this (the global-cap padding
+                    # would multiply every device's probe area) and stay on
+                    # the PR-3 size-classed executor below.
+                    from ..parallel.table_ops import probe_dist_blocks
+
+                    l_blocks = _dist_blocks(left, l_starts, self.left_keys, mesh)
+                    r_blocks = _dist_blocks(right, r_starts, self.right_keys, mesh)
+                    if l_blocks is not None and r_blocks is not None:
+                        pairs = probe_dist_blocks(mesh, l_blocks, r_blocks)
             if pairs is None:
                 from ..ops.bucket_join import (
                     classed_pairs,

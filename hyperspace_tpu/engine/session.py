@@ -434,6 +434,17 @@ def _enable_compile_cache_once() -> None:
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        try:
+            # jax initializes the persistent cache lazily on the FIRST compile
+            # and latches the decision: a process that already compiled
+            # anything before this knob ran (library user creating a session
+            # late) would silently never write entries. Dropping the latched
+            # state makes the config take effect from the next compile.
+            from jax.experimental.compilation_cache import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:
+            pass  # older/newer cache module layout: config alone suffices
     except Exception:
         pass  # an optimization, never a failure mode
 

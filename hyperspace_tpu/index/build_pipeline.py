@@ -39,6 +39,15 @@ sort implementation over identical arrays; and bucket rows gathered through
 the pre-pipeline serial code path (`tests/test_build_pipeline.py` pins the
 two to each other).
 
+The ordering this contract fixes is the engine's ONE canonical build order —
+stable (bucket, keys...) with ties broken by original row id — which the
+MESH build (`parallel/table_ops.distributed_bucketize_table`, taken instead
+of this pipeline when a multi-device mesh claims the source) also produces:
+all three build strategies (serial, pipelined, mesh) emit byte-identical
+index files, pinned by `tests/test_build_pipeline.py` and
+`tests/test_mesh_compile.py` respectively. Any change to the sort tie order
+here breaks BOTH contracts at once.
+
 Stage timings (decode/hash/h2d/sort/write, wall, overlap ratio) are recorded
 via `telemetry.profiling.record_build_stages` and surfaced in `bench.py`'s
 ``bench_detail``.

@@ -212,6 +212,14 @@ class CoveringIndexBuilder(IndexerBuilder):
         # bucket files concurrently keeps the build from serializing on host I/O
         # (SURVEY §7 — the executors of the reference's bucketed write ran
         # cluster-wide for the same reason).
+        # Per-bucket tasks for BOTH paths: the pool load-balances small
+        # parquet encodes regardless of which device owned a bucket. The mesh
+        # layout's per-shard file ownership (device d's exchange block IS its
+        # contiguous bucket range [d·B/n, (d+1)·B/n)) matters on a MULTI-HOST
+        # mesh, where each host would map only its own devices' bucket range
+        # here — on one host, coarser shard-sized tasks would only serialize
+        # a skewed shard's writes behind one worker. File names and bytes are
+        # identical across the mesh and single-device paths either way.
         with stages.timed("write"):
             with ThreadPoolExecutor(max_workers=cfg.writers) as pool:
                 list(pool.map(write_bucket, range(num_buckets)))

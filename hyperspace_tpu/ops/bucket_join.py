@@ -60,6 +60,33 @@ def _cap_pow2(n: int) -> int:
     return 1 << (max(1, n) - 1).bit_length()
 
 
+def mesh_probe_skew_safe(l_starts, r_starts) -> bool:
+    """Whether the MESH-sharded co-bucketed probe should claim this bucket
+    layout. The sharded probe pads every bucket to the GLOBAL max bucket
+    length (one [B_local, cap] matrix per device) — a single outlier bucket
+    multiplies every device's probe area, exactly the skew blowup JSPIM
+    measures and the PR-3 size-classed executor exists to avoid. Reuses the
+    classed executor's own outlier criterion (larger side > factor × median
+    of active larger sides): skewed layouts stay on the size-classed
+    single-device path; balanced layouts take the mesh. Disabled size
+    classes (=0) always answer True — with the skew machinery off there is
+    no better fallback to protect."""
+    if not size_classes_enabled():
+        return True
+    l_lens = np.diff(np.asarray(l_starts, np.int64))
+    r_lens = np.diff(np.asarray(r_starts, np.int64))
+    n = min(len(l_lens), len(r_lens))
+    l_lens, r_lens = l_lens[:n], r_lens[:n]
+    active = np.nonzero((l_lens > 0) & (r_lens > 0))[0]
+    if len(active) == 0:
+        return True
+    factor = _outlier_factor()
+    if factor <= 0:
+        return True
+    mx = np.maximum(l_lens, r_lens)[active]
+    return bool(mx.max(initial=0) <= factor * max(float(np.median(mx)), 1.0))
+
+
 @_observed_jit(label="bucket_join.pad_scatter", static_argnums=(2, 3))
 def _pad_scatter(keys, starts, num_buckets: int, cap: int):
     """Scatter per-row keys (concatenated in bucket order) into an UNSORTED

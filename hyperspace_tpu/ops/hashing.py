@@ -24,6 +24,7 @@ shuffle-free bucketed join sound — reference `JoinIndexRule.scala:144-156`).
 from __future__ import annotations
 
 import hashlib
+import os
 
 import jax
 import jax.numpy as jnp
@@ -40,6 +41,50 @@ if TYPE_CHECKING:  # annotation-only: a runtime import would cycle through
 
 _SEED1 = np.uint32(0x9747B28C)
 _SEED2 = np.uint32(0x85EBCA6B)
+
+#: Pow2-quantize the ROW dimension of every fused hash program ("1" on, "0"
+#: off, unset = auto: on exactly when the DEVICE kernel path is active). The
+#: hash is elementwise, so padding the inputs to the next power of two and
+#: slicing the output changes NOTHING for real rows — but it bounds the
+#: number of distinct shapes each program ever traces to log2(max rows)
+#: instead of one per exact table size. The r05 TPU bench died inside a
+#: 2400 s compile of `hashing.bucket_id` fed a raw table-sized shape stream;
+#: quantization at THIS boundary is the structural fix (every caller
+#: inherits it), and it is what lets the persistent XLA compilation cache
+#: stay small and hot across processes. The auto default is
+#: backend-adaptive because the trade inverts: on a TPU (relay transports
+#: included) one avoided compile pays for years of pad/slice copies, while
+#: on the XLA-CPU backend compiles are ~0.2 s and the two O(n) copies showed
+#: up as a measured 45% cold-join regression at 2M — so CPU runs exact
+#: shapes unless explicitly opted in. (The MESH path is quantized either
+#: way: `parallel/table_ops.py` pads rows onto the mesh grid before the hash
+#: regardless of this knob.)
+ENV_HASH_QUANTIZE = "HYPERSPACE_HASH_QUANTIZE"
+
+
+def _hash_quantize_enabled() -> bool:
+    env = os.environ.get(ENV_HASH_QUANTIZE)
+    if env is not None and env != "":
+        return env != "0"
+    from .backend import use_device_path
+
+    return use_device_path()
+
+
+def _pow2_len(n: int) -> int:
+    return 1 << (max(1, int(n)) - 1).bit_length()
+
+
+def _pad_pow2(arr):
+    """Pad a 1-D device array to the next pow2 length (zeros: a valid bit
+    pattern for every kind — numeric words hash fine, string CODES index slot
+    0 — and the caller slices the padded rows back off)."""
+    a = jnp.asarray(arr)
+    n = int(a.shape[0])
+    n_pad = _pow2_len(n)
+    if n_pad == n:
+        return a
+    return jnp.concatenate([a, jnp.zeros(n_pad - n, dtype=a.dtype)])
 
 
 def fmix32(h):
@@ -121,6 +166,15 @@ def host_hash_dictionary(dictionary: np.ndarray, seed: int):
     for i, s in enumerate(dictionary):
         d = hashlib.blake2b(str(s).encode("utf-8"), digest_size=4, salt=seed_bytes).digest()
         out[i] = np.frombuffer(d, dtype=np.uint32)[0]
+    if _hash_quantize_enabled():
+        # Pow2-pad the table: dictionary sizes are data-dependent, and the
+        # table is an operand SHAPE of every fused string-hash program — an
+        # unpadded table would re-trace those programs once per distinct
+        # cardinality. Gathers only ever index real codes, so padding is
+        # invisible to the hash values.
+        n_pad = _pow2_len(len(out))
+        if n_pad != len(out):
+            out = np.concatenate([out, np.zeros(n_pad - len(out), np.uint32)])
     dev = jnp.asarray(out)
 
     def _evict(wr, key=key):
@@ -216,10 +270,24 @@ def _flat_inputs(columns, device_arrays, seeds, force_float=None):
     return tuple(kinds), flat
 
 
+def _quantized_row_inputs(device_arrays):
+    """(device_arrays possibly pow2-padded, real row count or None). None =
+    already on the grid / quantization off — call the fused program as-is."""
+    if not _hash_quantize_enabled() or not device_arrays:
+        return device_arrays, None
+    n = int(jnp.asarray(device_arrays[0]).shape[0])
+    if n == 0 or _pow2_len(n) == n:
+        return device_arrays, None
+    return [_pad_pow2(a) for a in device_arrays], n
+
+
 def combined_hash_u32(columns, device_arrays, seed: np.uint32):
-    """Combine multiple key columns into one uint32 hash (one fused program)."""
+    """Combine multiple key columns into one uint32 hash (one fused program,
+    row dimension pow2-quantized — see `ENV_HASH_QUANTIZE`)."""
+    device_arrays, n = _quantized_row_inputs(device_arrays)
     kinds, flat = _flat_inputs(columns, device_arrays, (seed,))
-    return _combined_fused(kinds, seed, *flat)
+    out = _combined_fused(kinds, seed, *flat)
+    return out if n is None else out[:n]
 
 
 def key64(columns, device_arrays, force_float=None):
@@ -229,11 +297,15 @@ def key64(columns, device_arrays, force_float=None):
     collide with probability ~2^-64 and are removed by the join's exact-equality
     verification pass. `force_float[i]` hashes numeric column i in the
     cross-kind float64 space (joint decision of both join sides)."""
+    device_arrays, n = _quantized_row_inputs(device_arrays)
     kinds, flat = _flat_inputs(columns, device_arrays, (_SEED1, _SEED2), force_float)
-    return _key64_fused(kinds, *flat)
+    out = _key64_fused(kinds, *flat)
+    return out if n is None else out[:n]
 
 
 def bucket_id(columns, device_arrays, num_buckets: int):
     """Bucket assignment: h1 % num_buckets (the repartition hash)."""
+    device_arrays, n = _quantized_row_inputs(device_arrays)
     kinds, flat = _flat_inputs(columns, device_arrays, (_SEED1,))
-    return _bucket_id_fused(kinds, int(num_buckets), *flat)
+    out = _bucket_id_fused(kinds, int(num_buckets), *flat)
+    return out if n is None else out[:n]

@@ -49,13 +49,17 @@ def _composite_sort_host(
     b_host: np.ndarray, cols, num_buckets: int
 ) -> "np.ndarray | None":
     """Single-lane composite sort for the common single-key case: with one
-    non-null integer-or-dictionary key of bounded range, `bucket * range +
-    (key - min)` fits int64 and one unstable introsort orders by
-    (bucket, key) — measured 0.84 s vs lexsort's 2.58 s at 8M. Instability
-    within equal (bucket, key) is arbitrary-safe by the same argument as the
-    Pallas bitonic sort (`ops/pallas_sort.py` docstring): joins emit whole
-    equal-key ranges and verify actual values. Strings ride their sorted-
-    dictionary codes (code order IS value order). None = use the lexsort."""
+    non-null integer-or-dictionary key of bounded range, `(bucket * range +
+    (key - min)) * n + row` fits int64 and one unstable introsort orders by
+    (bucket, key, original row) — measured 0.84 s vs lexsort's 2.58 s at 8M.
+    The row-id low bits make every composite UNIQUE, so the unstable introsort
+    reproduces the engine's CANONICAL build order — stable (bucket, key) with
+    ties in original row order — exactly: the same order `np.lexsort`, the
+    stable `lax.sort` device paths, the Pallas composite sort, and the mesh
+    exchange's receive-side sort all produce. One canonical order is what
+    makes the mesh build's index files byte-identical to single-device ones
+    (`HYPERSPACE_DISTRIBUTED=0` oracle). Strings ride their sorted-dictionary
+    codes (code order IS value order). None = use the lexsort."""
     if len(cols) != 1:
         return None
     c = cols[0]
@@ -66,13 +70,16 @@ def _composite_sort_host(
         data = data.astype(np.int64)
     if not np.issubdtype(data.dtype, np.integer):
         return None
-    if data.shape[0] == 0:
+    n = data.shape[0]
+    if n == 0:
         return np.empty(0, np.int64)
     lo, hi = int(data.min()), int(data.max())
     span = hi - lo + 1
-    if span > (1 << 62) // max(num_buckets, 1):
+    if span > (1 << 62) // max(num_buckets * n, 1):
         return None
-    comp = b_host.astype(np.int64) * span + (data.astype(np.int64) - lo)
+    comp = (
+        b_host.astype(np.int64) * span + (data.astype(np.int64) - lo)
+    ) * np.int64(n) + np.arange(n, dtype=np.int64)
     return np.argsort(comp)
 
 

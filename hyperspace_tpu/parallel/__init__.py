@@ -1,4 +1,14 @@
-from .mesh import BUCKET_AXIS, force_virtual_cpu, make_mesh, replicated, row_sharding  # noqa: F401
+from .mesh import (  # noqa: F401
+    BUCKET_AXIS,
+    force_virtual_cpu,
+    make_mesh,
+    mesh_row_quantum,
+    quantize_cap,
+    quantized_rows,
+    replicated,
+    row_sharding,
+)
+from .shim import pjit, require_shard_map, shard_map  # noqa: F401
 from .distributed import (  # noqa: F401
     distributed_bucketed_join_counts,
     distributed_bucketize,

@@ -15,11 +15,22 @@ Build exchange (two-pass, static shapes — the standard way around ragged all-t
 
 Device d ends up owning buckets [d*B/n, (d+1)*B/n) fully sorted — exactly the layout
 the bucketed writer persists and the co-bucketed join consumes.
+
+Compile contract (docs/distributed.md): every device program here is declared
+through `observed_jit` with a `parallel.*` label, and every shape it traces is
+pow2-quantized — callers pad row counts to `mesh.quantized_rows` and the
+exchange capacity is floored at the mesh row quantum — so each program
+compiles EXACTLY ONCE per process per workload class, verified by the compile
+observatory (`tests/test_mesh_compile.py`, `bench_detail.mesh`). The ordering
+contract of the receive-side sort is the engine's canonical build order:
+stable (bucket, sort keys...) with ties broken by ORIGINAL global row id —
+identical to `ops.partition.host_sort_perm`/`_sort_perm`, which is what makes
+mesh-built index files byte-identical to single-device ones.
 """
 
 from __future__ import annotations
 
-from functools import lru_cache, partial
+from functools import lru_cache
 from typing import List, Sequence, Tuple
 
 import jax
@@ -27,9 +38,21 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .mesh import BUCKET_AXIS
+from ..telemetry import metrics as _metrics
+from ..telemetry.compile_log import observed_jit as _observed_jit
+from .mesh import BUCKET_AXIS, quantize_cap
+from .shim import shard_map
 
 _PAD_SLOT = -1
+
+#: All-to-all traffic accounting (ticked once per exchange on the host):
+#: payload = real row bytes moved, moved = the padded send-matrix bytes the
+#: interconnect actually carries. The gap between them is the static-shape
+#: padding tax — `bench_detail.mesh` reports both.
+_EXCHANGE_ROWS = _metrics.counter("parallel.exchange.rows")
+_EXCHANGE_BYTES_PAYLOAD = _metrics.counter("parallel.exchange.bytes_payload")
+_EXCHANGE_BYTES_MOVED = _metrics.counter("parallel.exchange.bytes_moved")
+_EXCHANGES = _metrics.counter("parallel.exchange.count")
 
 
 def _dest_of(h1, num_buckets: int, n_dev: int):
@@ -51,8 +74,9 @@ def _counts_program(mesh: Mesh, num_buckets: int):
         one_hot = jax.nn.one_hot(dest, n_dev, dtype=jnp.int32)
         return jnp.sum(one_hot, axis=0, keepdims=True)  # [1, n_dev]
 
-    return jax.jit(
-        jax.shard_map(count_fn, mesh=mesh, in_specs=P(BUCKET_AXIS), out_specs=P(BUCKET_AXIS))
+    return _observed_jit(
+        shard_map(count_fn, mesh=mesh, in_specs=P(BUCKET_AXIS), out_specs=P(BUCKET_AXIS)),
+        label="parallel.exchange_counts",
     )
 
 
@@ -68,7 +92,7 @@ def _exchange_program(mesh: Mesh, num_buckets: int, cap: int):
     def fn(h1_local, valid_local, payload_local, keys_local):
         n_local = h1_local.shape[0]
         dest, _ = _dest_of(h1_local, num_buckets, n_dev)
-        order = jnp.argsort(dest)
+        order = jnp.argsort(dest)  # stable: ties keep original (= global) order
         dest_s = dest[order]
         starts = jnp.searchsorted(dest_s, jnp.arange(n_dev))
         slot = jnp.arange(n_local) - starts[dest_s]
@@ -87,7 +111,10 @@ def _exchange_program(mesh: Mesh, num_buckets: int, cap: int):
         payload_recv = [scatter(c) for c in payload_local]
         keys_recv = [scatter(c) for c in keys_local]
 
-        # Local sort: invalid rows last, then by (bucket, sort keys...).
+        # Local sort: invalid rows last, then by (bucket, sort keys...). The
+        # final iota operand breaks ties by receive position = (sender id,
+        # sender-local order) = ORIGINAL GLOBAL ROW ORDER — the canonical
+        # stable build order every other build path produces.
         flat_valid = valid_recv.reshape(-1)
         bucket = (h1_recv.reshape(-1) % jnp.uint32(num_buckets)).astype(jnp.int32)
         sort_operands = (
@@ -103,14 +130,30 @@ def _exchange_program(mesh: Mesh, num_buckets: int, cap: int):
         out_payload = [c.reshape(-1)[perm][None] for c in payload_recv]
         return out_bucket, out_valid, out_payload
 
-    return jax.jit(
-        jax.shard_map(
+    return _observed_jit(
+        shard_map(
             fn,
             mesh=mesh,
             in_specs=(P(BUCKET_AXIS), P(BUCKET_AXIS), P(BUCKET_AXIS), P(BUCKET_AXIS)),
             out_specs=(P(BUCKET_AXIS), P(BUCKET_AXIS), P(BUCKET_AXIS)),
-        )
+        ),
+        label="parallel.exchange",
     )
+
+
+def _record_exchange(n_valid: int, n_dev: int, cap: int, lanes) -> None:
+    """Host-side traffic accounting for one exchange call (cheap: arithmetic
+    over lane dtypes, no device sync)."""
+    _EXCHANGES.inc()
+    _EXCHANGE_ROWS.inc(int(n_valid))
+    payload = 0
+    moved = 0
+    for lane in lanes:
+        item = int(jnp.asarray(lane).dtype.itemsize)
+        payload += int(n_valid) * item
+        moved += n_dev * n_dev * cap * item
+    _EXCHANGE_BYTES_PAYLOAD.inc(payload)
+    _EXCHANGE_BYTES_MOVED.inc(moved)
 
 
 def exchange_rows(
@@ -121,18 +164,28 @@ def exchange_rows(
     num_buckets: int,
     cap: int,
     in_valid=None,
+    n_valid=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, List[jnp.ndarray]]:
     """Pass 2: all-to-all exchange + local in-bucket sort.
 
     `in_valid` (optional, int32 0/1 per row, sharded like `h1`) marks padding rows
     added by the caller to make the global row count divisible by the mesh size;
     they travel through the exchange but come out with valid=0 (sorted last).
+    `n_valid` is the real (un-padded) row count for the traffic counters; the
+    table-level callers pass it, and a plain unpadded call infers it from the
+    shape — never from a device sync, so tracing/lowering this function stays
+    legal.
 
     Returns (bucket_ids [n_dev*cap], valid mask, payload arrays), each sharded over
     the mesh: device d's block holds its bucket range, valid rows sorted by
     (bucket, sort_keys...) and grouped before padding."""
+    n_dev = mesh.devices.size
     if in_valid is None:
         in_valid = jnp.ones(h1.shape, dtype=jnp.int32)
+        if n_valid is None:
+            n_valid = int(h1.shape[0])
+    if n_valid is not None:
+        _record_exchange(n_valid, n_dev, cap, [h1, in_valid, *payload, *sort_keys])
     return _exchange_program(mesh, num_buckets, cap)(
         h1, in_valid, list(payload), list(sort_keys)
     )
@@ -145,17 +198,19 @@ def distributed_bucketize(
     sort_keys: Sequence[jnp.ndarray],
     num_buckets: int,
     in_valid=None,
+    n_valid=None,
 ):
     """Full two-pass distributed bucketize. Rows arrive sharded over the mesh; the
     result is (bucket_ids, valid, payload) blocks, one bucket range per device."""
-    from ..ops.bucket_join import _cap_pow2
-
     counts = exchange_counts(mesh, h1, num_buckets)
     cap = int(counts.max()) if counts.size else 0
-    # Quantize to the next power of two so repeated builds of growing data reuse
-    # the compiled exchange instead of recompiling per exact capacity.
-    cap = _cap_pow2(cap)
-    return exchange_rows(mesh, h1, payload, sort_keys, num_buckets, cap, in_valid)
+    # Quantize to the mesh row quantum's power-of-two grid so repeated builds
+    # of growing data reuse ONE compiled exchange instead of recompiling per
+    # exact capacity (the compile-boundedness contract).
+    cap = quantize_cap(cap)
+    return exchange_rows(
+        mesh, h1, payload, sort_keys, num_buckets, cap, in_valid, n_valid=n_valid
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -163,13 +218,8 @@ def distributed_bucketize(
 # ---------------------------------------------------------------------------
 
 
-def distributed_bucketed_join_counts(
-    mesh: Mesh, l_sorted_keys, r_sorted_keys, l_len, r_len
-):
-    """Per-bucket match counts for co-located padded bucket matrices [B, cap] sharded
-    over the mesh's bucket axis. Runs entirely device-local (the proof that the
-    co-bucketed layout needs no collectives: the jitted HLO contains none)."""
-
+@lru_cache(maxsize=64)
+def _join_counts_program(mesh: Mesh):
     def fn(ls, rs, ll, rl):
         lo = jax.vmap(lambda r, l: jnp.searchsorted(r, l, side="left"))(rs, ls)
         hi = jax.vmap(lambda r, l: jnp.searchsorted(r, l, side="right"))(rs, ls)
@@ -179,9 +229,21 @@ def distributed_bucketed_join_counts(
         valid = jnp.arange(ls.shape[1])[None, :] < ll[:, None]
         return jnp.sum(jnp.where(valid, hi - lo, 0), axis=1)
 
-    return jax.shard_map(
-        fn,
-        mesh=mesh,
-        in_specs=(P(BUCKET_AXIS), P(BUCKET_AXIS), P(BUCKET_AXIS), P(BUCKET_AXIS)),
-        out_specs=P(BUCKET_AXIS),
-    )(l_sorted_keys, r_sorted_keys, l_len, r_len)
+    return _observed_jit(
+        shard_map(
+            fn,
+            mesh=mesh,
+            in_specs=(P(BUCKET_AXIS), P(BUCKET_AXIS), P(BUCKET_AXIS), P(BUCKET_AXIS)),
+            out_specs=P(BUCKET_AXIS),
+        ),
+        label="parallel.join_counts",
+    )
+
+
+def distributed_bucketed_join_counts(
+    mesh: Mesh, l_sorted_keys, r_sorted_keys, l_len, r_len
+):
+    """Per-bucket match counts for co-located padded bucket matrices [B, cap] sharded
+    over the mesh's bucket axis. Runs entirely device-local (the proof that the
+    co-bucketed layout needs no collectives: the jitted HLO contains none)."""
+    return _join_counts_program(mesh)(l_sorted_keys, r_sorted_keys, l_len, r_len)

@@ -9,6 +9,7 @@ in `jax.devices()` already reflects the platform's topology).
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 import jax
@@ -16,6 +17,42 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 BUCKET_AXIS = "buckets"
+
+#: Minimum rows a device program shape is quantized to (env-tunable). Every
+#: mesh program's row dimension — exchange shard rows, send-matrix capacity,
+#: probe block width, padded bucket capacity — is ceil'd to a power of two
+#: AND floored at this quantum, so each device program compiles exactly once
+#: per pow2 workload class and small workloads all share ONE class. 1024 rows
+#: of int64 is 8 KiB/lane — noise on any device, and it keeps the warm
+#: program set tiny for the persistent compilation cache.
+ENV_ROW_QUANTUM = "HYPERSPACE_MESH_ROW_QUANTUM"
+_DEFAULT_ROW_QUANTUM = 1024
+
+
+def mesh_row_quantum() -> int:
+    try:
+        q = int(os.environ.get(ENV_ROW_QUANTUM, _DEFAULT_ROW_QUANTUM))
+    except ValueError:
+        return _DEFAULT_ROW_QUANTUM
+    if q < 1:
+        return _DEFAULT_ROW_QUANTUM
+    # The quantum itself must be a power of two (it is a shape class bound).
+    return 1 << (q - 1).bit_length()
+
+
+def quantize_cap(n: int) -> int:
+    """Pow2-quantize a per-device capacity, floored at the mesh row quantum."""
+    return 1 << (max(int(n), mesh_row_quantum()) - 1).bit_length()
+
+
+def quantized_rows(num_rows: int, n_dev: int) -> int:
+    """The padded GLOBAL row count for `num_rows` rows on an `n_dev` mesh: each
+    device's shard is the same pow2-quantized size, so the exchange programs
+    (whose traced shapes are the shard sizes) compile once per workload class
+    instead of once per exact row count — the fix for the r05 failure mode
+    (a 2400 s compile inside an unquantized-shape device program)."""
+    per = -(-max(int(num_rows), 1) // n_dev)  # ceil division
+    return quantize_cap(per) * n_dev
 
 
 def force_virtual_cpu(n_devices: int = 8) -> None:

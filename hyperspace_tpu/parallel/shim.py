@@ -1,0 +1,74 @@
+"""Version-tolerant resolution of the modern `jax.sharding` program APIs.
+
+The mesh subsystem is written against the CURRENT jax surface — `jax.shard_map`
++ `jax.jit` with `jax.sharding.NamedSharding` — but must run on every jaxlib
+the deployment images carry. The two entry points that moved across jax's
+0.4 → 0.5/0.6 reorganization are resolved here, once, at import time:
+
+- ``shard_map``: `jax.shard_map` (0.4.34+ exposes it at top level on some
+  builds, all 0.6+ builds) → `jax.experimental.shard_map.shard_map` (the
+  0.4.x home) → `None` (a jax too old for the mesh path at all; callers see
+  a clear error instead of an AttributeError mid-build).
+- ``pjit``: `jax.jit` IS pjit on every jax this repo supports (the two were
+  unified in 0.4); `jax.experimental.pjit.pjit` remains the fallback spelling
+  for builds where `jax.jit` rejects `in_shardings`.
+
+Everything else the subsystem needs (`Mesh`, `NamedSharding`,
+`PartitionSpec`, `lax.all_to_all`) has been stable across these versions and
+is imported directly where used.
+
+This file is the ONE place version probing happens: `distributed.py` and
+`table_ops.py` import `shard_map` from here and stay clean modern-API code.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+
+__all__ = ["shard_map", "pjit", "require_shard_map"]
+
+
+def _resolve_shard_map() -> Optional[Callable[..., Any]]:
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        return fn
+    try:
+        from jax.experimental.shard_map import shard_map as fn  # type: ignore
+
+        return fn
+    except Exception:
+        return None
+
+
+_shard_map_impl = _resolve_shard_map()
+
+
+def shard_map(f, *, mesh, in_specs, out_specs):
+    """`jax.shard_map(f, mesh=..., in_specs=..., out_specs=...)` on whichever
+    module this jax spells it in. Raises a actionable error on a jax with no
+    shard_map at all (the mesh path cannot exist there)."""
+    impl = require_shard_map()
+    return impl(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
+def require_shard_map() -> Callable[..., Any]:
+    if _shard_map_impl is None:
+        raise RuntimeError(
+            "this jax build has neither jax.shard_map nor "
+            "jax.experimental.shard_map — the distributed mesh path needs "
+            "jax >= 0.4.30; set HYPERSPACE_DISTRIBUTED=0 to run single-device"
+        )
+    return _shard_map_impl
+
+
+def pjit(fun, **kwargs):
+    """Sharded jit: `jax.jit` (which IS pjit on modern jax) with
+    `jax.experimental.pjit.pjit` as the fallback spelling."""
+    try:
+        return jax.jit(fun, **kwargs)
+    except TypeError:
+        from jax.experimental.pjit import pjit as _pjit  # type: ignore
+
+        return _pjit(fun, **kwargs)

@@ -8,7 +8,8 @@ These are the entry points the REAL build and query paths call (not just tests):
   bucketed write (`CreateActionBase.scala:119-140`): rows leave the host as
   row-sharded blocks, ride a two-pass `lax.all_to_all` to their bucket's device,
   and come back grouped by bucket and sorted within bucket. Same contract as the
-  single-device `ops.partition.bucketize_table` (identical hash → identical files).
+  single-device `ops.partition.bucketize_table` (identical hash AND identical
+  stable tie order → byte-identical index files).
 - `distributed_exchange_table` — the general join's ShuffleExchange. Both sides
   exchanged with the same key hash are co-partitioned, so the merge join after it
   needs no further communication.
@@ -17,8 +18,14 @@ These are the entry points the REAL build and query paths call (not just tests):
   the covering-index layout, reference `JoinIndexRule.scala:137-162`). Same
   contract as `ops.bucket_join.bucketed_merge_join_pairs`.
 
-Capacity knobs are quantized to powers of two so growing data reuses compiled
-programs instead of recompiling per exact shape.
+Compile contract: every shape that reaches a device program here is quantized
+on the `mesh.quantize_cap`/`mesh.quantized_rows` pow2 grid — the hash inputs
+are padded BEFORE hashing (so `hashing.combined_hash`/`hashing.key64` trace
+one shape per workload class, not one per table size — the exact failure mode
+that hung the r05 TPU bench for 2400 s inside `ops/hashing.bucket_id`), and
+the exchange/probe capacities are floored at the mesh row quantum. Each
+`parallel.*` program compiles exactly once per process per class, asserted by
+`tests/test_mesh_compile.py` and reported in `bench_detail.mesh`.
 """
 
 from __future__ import annotations
@@ -32,10 +39,11 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..engine.table import Table
-from ..ops.bucket_join import _cap_pow2 as _pow2
 from ..ops.hashing import _SEED1, combined_hash_u32, key64
+from ..telemetry.compile_log import observed_jit as _observed_jit
 from .distributed import distributed_bucketize
-from .mesh import BUCKET_AXIS, row_sharding
+from .mesh import BUCKET_AXIS, quantize_cap, quantized_rows, row_sharding
+from .shim import shard_map
 
 _PAD = np.iinfo(np.int64).max
 
@@ -54,6 +62,16 @@ def _sort_key_arrays(table: Table, columns: Sequence[str], pad: int) -> List[np.
             a = a.astype(np.int32)
         out.append(_pad_rows(a, pad))
     return out
+
+
+def _padded_hash_inputs(cols, pad: int):
+    """Device inputs for the fused hash programs, padded to the quantized row
+    count BEFORE hashing: the hash is elementwise, so padding changes nothing
+    for the real rows, and the program traces ONE shape per pow2 class
+    instead of one per exact table size. String columns ride their dictionary
+    codes (pad code 0 = a valid in-range index; the pad rows are dropped by
+    the exchange's validity lane anyway)."""
+    return [jnp.asarray(_pad_rows(c.data, pad)) for c in cols]
 
 
 def _gather_valid_perm(bucket, valid, rowid) -> Tuple[np.ndarray, np.ndarray]:
@@ -77,15 +95,19 @@ def distributed_bucketize_table(
     back to the host, which materializes the reordered table for the bucketed
     parquet write (index files are host I/O regardless of where the shuffle ran).
     Bucket assignment is identical to the single-device path (h1 % num_buckets over
-    the same column hash), so the two paths produce interchangeable index files."""
+    the same column hash) AND the within-bucket order is the same canonical
+    stable (bucket, keys..., original row) order, so the two paths produce
+    BYTE-IDENTICAL index files — `HYPERSPACE_DISTRIBUTED=0` is an exact
+    fallback, pinned by the on/off oracles in tests/test_mesh_compile.py."""
     n_dev = mesh.devices.size
     n = table.num_rows
     cols = [table.column(c) for c in bucket_columns]
-    arrs = [jnp.asarray(c.data) for c in cols]
-    h1_np = np.asarray(combined_hash_u32(cols, arrs, _SEED1))
 
-    pad = (-n) % n_dev
-    h1_p = _pad_rows(h1_np, pad)
+    n_pad_total = quantized_rows(n, n_dev)
+    pad = n_pad_total - n
+    arrs_p = _padded_hash_inputs(cols, pad)
+    h1_np = np.asarray(combined_hash_u32(cols, arrs_p, _SEED1))
+
     valid_p = np.ones(n + pad, np.int32)
     valid_p[n:] = 0
     rowid_p = _pad_rows(np.arange(n, dtype=np.int64), pad)
@@ -98,11 +120,12 @@ def distributed_bucketize_table(
 
     bucket, out_valid, (rowid_out,) = distributed_bucketize(
         mesh,
-        put(h1_p),
+        put(h1_np),
         [put(rowid_p)],
         [put(k) for k in keys_p],
         num_buckets,
         in_valid=put(valid_p),
+        n_valid=n,
     )
     perm, bucket_v = _gather_valid_perm(bucket, out_valid, rowid_out)
     assert len(perm) == n, f"exchange dropped rows: {len(perm)} != {n}"
@@ -132,13 +155,13 @@ def distributed_exchange_table(
     num_partitions = n_dev * partitions_per_device
     n = table.num_rows
     cols = [table.column(c) for c in key_columns]
-    arrs = [jnp.asarray(c.data) for c in cols]
-    h1_np = np.asarray(combined_hash_u32(cols, arrs, _SEED1))
-    k64 = key64(cols, arrs)
 
-    pad = (-n) % n_dev
-    h1_p = _pad_rows(h1_np, pad)
-    k64_p = _pad_rows(np.asarray(k64), pad)
+    n_pad_total = quantized_rows(n, n_dev)
+    pad = n_pad_total - n
+    arrs_p = _padded_hash_inputs(cols, pad)
+    h1_np = np.asarray(combined_hash_u32(cols, arrs_p, _SEED1))
+    k64_p = np.asarray(key64(cols, arrs_p))
+
     valid_p = np.ones(n + pad, np.int32)
     valid_p[n:] = 0
     rowid_p = _pad_rows(np.arange(n, dtype=np.int64), pad)
@@ -150,11 +173,12 @@ def distributed_exchange_table(
 
     bucket, out_valid, (rowid_out, k64_out) = distributed_bucketize(
         mesh,
-        put(h1_p),
+        put(h1_np),
         [put(rowid_p), put(k64_p)],
         [put(k64_p)],
         num_partitions,
         in_valid=put(valid_p),
+        n_valid=n,
     )
     valid_h = np.asarray(out_valid).reshape(-1).astype(bool)
     perm = np.asarray(rowid_out).reshape(-1)[valid_h]
@@ -169,7 +193,7 @@ def distributed_exchange_table(
     )
     buckets_local = num_partitions // n_dev
     lens = np.diff(starts)
-    cap = _pow2(int(lens.max())) if lens.size and lens.max(initial=0) else 1
+    cap = quantize_cap(int(lens.max())) if lens.size and lens.max(initial=0) else 1
     _, lstarts = _local_starts(starts, n_dev, buckets_local)
     blocks = DistBlocks(
         masked,
@@ -221,13 +245,15 @@ def _probe_program(mesh: Mesh, buckets_local: int, cap_l: int, cap_r: int):
         counts = jnp.where(valid_left, hi - lo, 0)
         return lo, counts, l_order, r_order
 
-    mapped = jax.shard_map(
-        fn,
-        mesh=mesh,
-        in_specs=(P(BUCKET_AXIS), P(BUCKET_AXIS), P(BUCKET_AXIS), P(BUCKET_AXIS)),
-        out_specs=(P(BUCKET_AXIS), P(BUCKET_AXIS), P(BUCKET_AXIS), P(BUCKET_AXIS)),
+    return _observed_jit(
+        shard_map(
+            fn,
+            mesh=mesh,
+            in_specs=(P(BUCKET_AXIS), P(BUCKET_AXIS), P(BUCKET_AXIS), P(BUCKET_AXIS)),
+            out_specs=(P(BUCKET_AXIS), P(BUCKET_AXIS), P(BUCKET_AXIS), P(BUCKET_AXIS)),
+        ),
+        label="parallel.probe",
     )
-    return jax.jit(mapped)
 
 
 def _local_starts(
@@ -252,7 +278,7 @@ def _block_layout(
     per-device local bucket offsets [n_dev, B_local+1]; device d's block is its
     contiguous bucket range — host→device transfer is one sharded device_put."""
     bounds, local_starts = _local_starts(starts_np, n_dev, buckets_local)
-    max_block = _pow2(int(np.diff(bounds).max()) if n_dev else 1)
+    max_block = quantize_cap(int(np.diff(bounds).max()) if n_dev else 1)
     blocks = np.full((n_dev, max_block), _PAD, dtype=np.int64)
     for d in range(n_dev):
         lo, hi = int(bounds[d]), int(bounds[d + 1])
@@ -313,7 +339,7 @@ def build_dist_blocks(mesh: Mesh, keys, starts_np: np.ndarray) -> Optional[DistB
     lens = np.diff(starts_np)
     if lens.max(initial=0) == 0:
         return None
-    cap = _pow2(int(lens.max()))
+    cap = quantize_cap(int(lens.max()))
     keys_np = np.minimum(np.asarray(keys), _PAD - 1)
     blocks, lstarts = _block_layout(keys_np, starts_np, n_dev, buckets_local)
     sh = NamedSharding(mesh, P(BUCKET_AXIS))

@@ -29,6 +29,9 @@ from typing import List, Optional
 from .filesystem import FileStatus, FileSystem
 
 
+_occ_fallback_warned = False
+
+
 def _epoch_ms(v) -> int:
     if v is None:
         return 0
@@ -118,6 +121,43 @@ class FsspecFileSystem(FileSystem):
             return True
         except FileExistsError:
             return False
+        except ValueError:
+            # Driver doesn't implement mode "x" at all (fsspec documents it as
+            # implementation-dependent; MemoryFileSystem raises ValueError).
+            # Emulate exclusive create as check-then-put. For memory:// this
+            # is exact (state is process-local, the GIL serializes the
+            # check+put against other threads' opens); for a SHARED remote
+            # store it is weaker than a true conditional put, so racing
+            # writers could both "win" — warn loudly ONCE instead of silently
+            # downgrading the OCC contract the crash-safe commits depend on.
+            if not self._is_process_local():
+                global _occ_fallback_warned
+                if not _occ_fallback_warned:
+                    _occ_fallback_warned = True
+                    import warnings
+
+                    warnings.warn(
+                        f"fsspec driver {type(self._fs).__name__} does not "
+                        "support exclusive create (mode 'x'); the operation "
+                        "log's OCC commit falls back to NON-ATOMIC "
+                        "check-then-put. Racing writers on a shared store can "
+                        "both succeed — verify your driver before trusting "
+                        "concurrent index mutations (see storage/remote.py).",
+                        RuntimeWarning,
+                        stacklevel=3,
+                    )
+            if self._fs.exists(path):
+                return False
+            with self._fs.open(path, "wb") as f:
+                f.write(text.encode("utf-8"))
+            return True
+
+    def _is_process_local(self) -> bool:
+        """Whether this fsspec backend's state lives in THIS process (the
+        memory:// family), making check-then-put as good as exclusive create."""
+        proto = getattr(self._fs, "protocol", "")
+        protos = proto if isinstance(proto, (tuple, list)) else (proto,)
+        return "memory" in protos
 
 
 _SCHEMES = ("memory://", "s3://", "gcs://", "gs://", "abfs://", "az://", "hdfs://")

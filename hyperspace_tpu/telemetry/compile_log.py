@@ -102,7 +102,23 @@ def storm_threshold() -> int:
 
 def _current_label() -> str:
     stack = getattr(_local, "stack", None)
-    return stack[-1] if stack else _UNLABELED
+    return stack[-1][0] if stack else _UNLABELED
+
+
+def _mark_traced() -> bool:
+    """Flag the innermost in-flight observed_jit call as having traced; returns
+    whether this is the FIRST trace event of that call. One call can emit many
+    jaxpr-trace events (shard_map programs trace one inner jaxpr per collective
+    region — 16+ for one exchange), so per-label `traces` counts CALLS that
+    traced a new shape, which is the unit the storm heuristic reasons about."""
+    stack = getattr(_local, "stack", None)
+    if not stack:
+        return True  # unlabeled: keep raw event counting
+    cell = stack[-1]
+    if cell[1]:
+        return False
+    cell[1] = True
+    return True
 
 
 def _program(label: str) -> dict:
@@ -161,6 +177,8 @@ def _on_event_duration(event: str, duration: float, **_kw) -> None:
             sp.inc_attr("xla_compile_s", round(float(duration), 6))
     elif event == _EVENT_JAXPR_TRACE:
         _TRACES.inc()
+        if not _mark_traced():
+            return  # later jaxpr of the SAME call: not a new program shape
         label = _current_label()
         p = _program(label)
         with _lock:
@@ -168,11 +186,33 @@ def _on_event_duration(event: str, duration: float, **_kw) -> None:
         _check_storm(label, p)
 
 
+_cache_events: Dict[str, int] = {}
+
+
 def _on_event(event: str, **_kw) -> None:
     """Plain-event listener: persistent compile-cache traffic counters."""
     if event.startswith(_CACHE_EVENT_PREFIX):
         leaf = event[len(_CACHE_EVENT_PREFIX):].replace("/", ".")
         _metrics.counter(f"xla.compile_cache.{leaf}").inc()
+        with _lock:
+            _cache_events[leaf] = _cache_events.get(leaf, 0) + 1
+
+
+def compile_cache_summary() -> dict:
+    """Persistent-compilation-cache traffic: {"dir": configured cache dir or
+    None, "events": {event leaf: count}}. A SECOND process (or a post-
+    `jax.clear_caches()` re-dispatch) against a warm
+    ``HYPERSPACE_COMPILE_CACHE_DIR`` shows `cache_hits` > 0 here — the
+    observable proof it paid zero backend compiles. Consumed by the exporter
+    frames and `bench_detail.mesh`."""
+    import os as _os
+
+    with _lock:
+        events = dict(_cache_events)
+    return {
+        "dir": _os.environ.get("HYPERSPACE_COMPILE_CACHE_DIR") or None,
+        "events": events,
+    }
 
 
 def install() -> bool:
@@ -224,7 +264,7 @@ def observed_jit(fun=None, *, label: Optional[str] = None, **jit_kwargs):
         stack = getattr(_local, "stack", None)
         if stack is None:
             stack = _local.stack = []
-        stack.append(lbl)
+        stack.append([lbl, False])  # [label, saw-a-trace-event-this-call]
         if cache_size is not None:
             import time as _time
 
@@ -268,7 +308,7 @@ def _call_under_deadline(fn, args, kwargs, label: str, limit_s: float):
         stack = getattr(_local, "stack", None)
         if stack is None:
             stack = _local.stack = []
-        stack.append(label)
+        stack.append([label, False])
         try:
             result.append(fn(*args, **kwargs))
         except BaseException as e:  # re-raised on the calling thread

@@ -109,6 +109,11 @@ class MetricsExporter:
             "ledgers": accounting.drain_pending(),
             "compile_programs": compile_log.program_summary(),
         }
+        # Persistent-compile-cache traffic: only when the knob is live or an
+        # event fired, so pre-existing frame consumers see unchanged schemas.
+        cache = compile_log.compile_cache_summary()
+        if cache["dir"] or cache["events"]:
+            out["compile_cache"] = cache
         # Compact reliability rollup (the raw counters also ride `snapshot`):
         # what a retry-storm alert or `tools/bench_compare.py` gate reads —
         # ONE schema shared with `bench_detail.reliability`.
