@@ -910,7 +910,17 @@ def _serving_section(s, base, col, runs, hs) -> dict:
     (`_Phases.run` swallows section exceptions and keeps going)."""
     from hyperspace_tpu.hyperspace import disable_hyperspace
 
-    chunk_env = ("HYPERSPACE_JOIN_CHUNK_ROWS", "HYPERSPACE_QUERY_CHUNK_ROWS")
+    chunk_env = (
+        "HYPERSPACE_JOIN_CHUNK_ROWS",
+        "HYPERSPACE_QUERY_CHUNK_ROWS",
+        # The serving section runs under the workload-history posture
+        # (HYPERSPACE_HISTORY=1): every served query's ledger lands in the
+        # on-lake store, and bench_detail.serving reports the store + SLO
+        # summaries — the ambient default stays off for the headline
+        # sections (the zero-cost-off contract is ALSO a bench claim).
+        "HYPERSPACE_HISTORY",
+        "HYPERSPACE_HISTORY_DIR",
+    )
     saved = {k: os.environ.get(k) for k in chunk_env}
     try:
         return _serving_section_body(s, base, col, runs, hs)
@@ -964,6 +974,24 @@ def _serving_section_body(s, base, col, runs, hs) -> dict:
     chunk_rows = str(int(os.environ.get("BENCH_SERVE_CHUNK_ROWS", 65536)))
     for k in ("HYPERSPACE_JOIN_CHUNK_ROWS", "HYPERSPACE_QUERY_CHUNK_ROWS"):
         os.environ[k] = chunk_rows
+    # Workload-history posture for the serving mix (docs/observability.md):
+    # served ledgers land on the lake, keyed by plan fingerprint; the
+    # summaries ride bench_detail.serving below.
+    from hyperspace_tpu.telemetry import history as _tel_history
+    from hyperspace_tpu.telemetry import slo as _tel_slo
+
+    # BENCH_HISTORY_DIR preserves the store past the bench's temp-dir
+    # cleanup (so `tools/hsreport.py <dir>` renders the run afterwards);
+    # default keeps it inside the section's temp base.
+    hist_dir = os.environ.get("BENCH_HISTORY_DIR") or os.path.join(
+        base, "serve_history"
+    )
+    os.environ["HYPERSPACE_HISTORY"] = "1"
+    os.environ["HYPERSPACE_HISTORY_DIR"] = hist_dir
+    _tel_slo.reset()
+    from hyperspace_tpu.telemetry import metrics as _tel_metrics
+
+    anomalies0 = _tel_metrics.counter("history.anomalies").value
     # The section owns its dataset (like pushdown/encoded): the serving story
     # is scheduling + sharing, measured at a serving-shaped scale regardless
     # of the headline BENCH_LINEITEM_ROWS.
@@ -1188,6 +1216,37 @@ def _serving_section_body(s, base, col, runs, hs) -> dict:
         "decodes": decode_delta,
         "dedup_hits": dedup_delta,
         "scan_s": [round(t, 3) for t in sorted(cold_times)],
+    }
+
+    # -- workload history + SLO over the section's traffic ------------------
+    # Every served query above landed its ledger in the on-lake store; the
+    # per-lane SLO monitor watched the same submissions. Both summaries ride
+    # the bench artifact so regression gates and operators read one file.
+    out["slo"] = _tel_slo.summary()
+    hist_recs = [
+        r
+        for r in _tel_history.iter_records(hist_dir)
+        if r.get("kind") == "ledger"
+    ]
+    baselines = _tel_history.fold_baselines(iter(hist_recs))
+    top = sorted(
+        baselines.items(), key=lambda kv: -(kv[1].summary().get("wall_total_s") or 0)
+    )
+    out["history"] = {
+        "records": len(hist_recs),
+        "fingerprints": len(baselines),
+        "segments": len(
+            [f for f in os.listdir(hist_dir) if f.endswith(".jsonl")]
+        )
+        if os.path.isdir(hist_dir)
+        else 0,
+        # Section DELTA (same convention as the `counters` block above): an
+        # ambient-history run's earlier anomalies must not be attributed to
+        # the serving mix.
+        "anomalies": metrics.counter("history.anomalies").value - anomalies0,
+        "top_classes": {
+            fp: bl.summary() for fp, bl in top[:3]
+        },
     }
     return {"serving": out}
 

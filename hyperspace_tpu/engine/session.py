@@ -187,6 +187,7 @@ class DataFrame:
         while True:
             with tracing.span("plan"):
                 phys = self.physical_plan()
+            self._attach_fingerprint(phys)
             try:
                 return runner(phys)
             except CorruptIndexError as e:
@@ -200,6 +201,27 @@ class DataFrame:
                     RuntimeWarning,
                     stacklevel=3,
                 )
+
+    def _attach_fingerprint(self, phys: PhysicalNode) -> None:
+        """Stamp the optimized plan's execution-class fingerprint
+        (`plananalysis.fingerprint`) onto the ambient root span and ledger —
+        the key the workload history store lands this query under. Computed
+        only when a consumer exists (history enabled / ledger open / span
+        recording); with everything off this is one env read + one
+        contextvar read, the zero-cost-off contract."""
+        from ..plananalysis import fingerprint as _fp
+        from ..telemetry import accounting, tracing
+
+        try:
+            if not _fp.fingerprint_wanted():
+                return
+            fp = _fp.plan_fingerprint(phys)
+        except Exception:
+            return  # fingerprinting must never fail the query
+        accounting.set_value("plan_fingerprint", fp)
+        sp = tracing.current_span()
+        if sp is not None:
+            sp.set_attr("plan_fingerprint", fp)
 
     def collect(self) -> Table:
         from .. import resilience

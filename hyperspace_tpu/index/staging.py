@@ -86,17 +86,9 @@ def _owner_of(name: str) -> Tuple[Optional[str], int]:
 
 
 def _pid_alive(pid: int) -> bool:
-    if pid <= 0:
-        return False
-    try:
-        os.kill(pid, 0)
-        return True
-    except ProcessLookupError:
-        return False
-    except PermissionError:
-        return True  # exists, owned by someone else
-    except OSError:
-        return True  # unknown: never reclaim what might be live
+    from ..util.procs import pid_alive
+
+    return pid_alive(pid)
 
 
 @contextlib.contextmanager

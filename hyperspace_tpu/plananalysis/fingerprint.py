@@ -1,0 +1,200 @@
+"""Stable plan fingerprints: the identity of a query's EXECUTION CLASS.
+
+The workload history store (`telemetry.history`) keys every observed ledger
+by "what kind of plan ran", so a cost model (ROADMAP item 4) can learn
+per-class baselines from durable history instead of env-flag folklore. That
+key must be:
+
+- **Stable**: the same query against the same index generation under the
+  same flag posture hashes identically — across processes and across
+  re-created lakes (root paths are normalized to their basenames; two runs
+  of the same bench script in different temp dirs share fingerprints).
+- **Class-shaped, not instance-shaped**: literal VALUES are abstracted to
+  their types (``orderkey == 7`` and ``orderkey == 12`` are the same point-
+  lookup class — a cost model wants one baseline for both), while the
+  predicate STRUCTURE (columns, operators, conjunct shape) is kept.
+- **Version-sensitive**: an index refresh/optimize advances the relation's
+  ``log_entry_id`` and therefore the fingerprint — observed history from a
+  superseded index generation never pollutes the new generation's baseline.
+- **Posture-sensitive**: the ambient behavior flags (streaming, size
+  classes, pushdown, encoded exec, …) are part of the hash — the SAME plan
+  under a different engine posture is a different cost class (the exact
+  distinction `tools/bench_compare.py --history` gates on).
+
+`plan_fingerprint(node)` walks the PHYSICAL tree (what actually executes:
+rule rewrites, index substitutions, and pushdown attachment have already
+happened) and hashes a canonical JSON of the node signatures plus the flag
+posture. One sha256, 16 hex chars — collision-safe at any realistic
+workload-class cardinality.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Optional
+
+from ..telemetry import accounting as _accounting
+from ..telemetry import history as _history
+from ..telemetry import tracing as _tracing
+
+#: Behavior knobs folded into every fingerprint ("ambient flag posture").
+#: Telemetry sinks (trace/metrics files, accounting) are deliberately NOT
+#: here — observing a query must not change its class. Order is irrelevant
+#: (the posture dict is serialized with sorted keys); unset flags are
+#: omitted, so setting a flag to its non-default value is what changes the
+#: fingerprint.
+FLAG_KEYS = (
+    "HYPERSPACE_BUILD_DECODE_THREADS",
+    "HYPERSPACE_DISTRIBUTED",
+    "HYPERSPACE_ENCODED_DICT_MAX",
+    "HYPERSPACE_ENCODED_EXEC",
+    "HYPERSPACE_FORCE_DEVICE_OPS",
+    "HYPERSPACE_HASH_QUANTIZE",
+    "HYPERSPACE_INDEX_ROW_GROUP_ROWS",
+    "HYPERSPACE_JOIN_CHUNK_ROWS",
+    "HYPERSPACE_JOIN_OUTLIER_FACTOR",
+    "HYPERSPACE_JOIN_SIZE_CLASSES",
+    "HYPERSPACE_MESH_ROW_QUANTUM",
+    "HYPERSPACE_PALLAS_PROBE",
+    "HYPERSPACE_PALLAS_SORT",
+    "HYPERSPACE_QUERY_CHUNK_ROWS",
+    "HYPERSPACE_QUERY_PREFETCH_FILES",
+    "HYPERSPACE_QUERY_STREAMING",
+    "HYPERSPACE_SCAN_PUSHDOWN",
+    "HYPERSPACE_SERVING",
+)
+
+
+def flag_posture() -> dict:
+    """The ambient behavior-flag values that shape execution, set keys only."""
+    out = {}
+    for k in FLAG_KEYS:
+        v = os.environ.get(k)
+        if v is not None:
+            out[k] = v
+    return out
+
+
+def expr_signature(e) -> list:
+    """Canonical structure of an expression: columns and operators verbatim,
+    literal VALUES abstracted to their type names (class identity, not
+    instance identity). Unknown expression types degrade to their class name
+    plus child signatures — never an error."""
+    from ..engine import expr as _expr
+
+    if e is None:
+        return ["none"]
+    if isinstance(e, _expr.Col):
+        return ["col", e.name]
+    if isinstance(e, _expr.Lit):
+        return ["lit", type(e.value).__name__]
+    if isinstance(e, _expr.BinaryOp):
+        return [e.op, expr_signature(e.left), expr_signature(e.right)]
+    if isinstance(e, _expr.IsNull):
+        return ["isnotnull" if e.negated else "isnull", expr_signature(e.child)]
+    if isinstance(e, _expr.Not):
+        return ["not", expr_signature(e.child)]
+    if isinstance(e, _expr.IsIn):
+        kinds = sorted({type(v).__name__ for v in e.values})
+        return ["in", expr_signature(e.child), kinds]
+    out = [type(e).__name__.lower()]
+    try:
+        out.extend(expr_signature(c) for c in e.children())
+    except Exception:
+        pass
+    return out
+
+
+def _relation_signature(rel) -> list:
+    """A relation's class identity: normalized roots (basenames — stable
+    across re-created temp lakes), format, the substituting index's name AND
+    log-entry id (advances on refresh/vacuum/optimize: a new index
+    generation is a new cost class), bucket layout, and hybrid-append
+    presence (an index merging appended source files scans differently)."""
+    roots = sorted(
+        os.path.basename(p.rstrip("/\\")) or p for p in (rel.root_paths or [])
+    )
+    out = ["rel", roots, rel.file_format, rel.index_name,
+           getattr(rel, "log_entry_id", None)]
+    if rel.bucket_spec is not None:
+        out.append(["buckets", rel.bucket_spec.num_buckets,
+                    list(rel.bucket_spec.bucket_columns),
+                    list(rel.bucket_spec.sort_columns)])
+    if rel.hybrid_append is not None:
+        out.append(["hybrid", len(rel.hybrid_append.files)])
+    if rel.pruned_by:
+        out.append(["pruned_by", sorted(rel.pruned_by)])
+    return out
+
+
+def node_signature(node) -> list:
+    """Canonical recursive signature of one physical operator."""
+    from ..engine import physical as _phys
+
+    sig: list = [type(node).__name__]
+    if isinstance(node, (_phys.ScanExec, _phys.BucketedIndexScanExec)):
+        sig.append(_relation_signature(node.relation))
+        cols = getattr(node, "columns", None)
+        if cols:
+            sig.append(["cols", sorted(cols)])
+        pd = getattr(node, "pushdown", None)
+        if pd is not None:
+            sig.append(["pushdown", expr_signature(pd)])
+    elif isinstance(node, _phys.FilterExec):
+        sig.append(expr_signature(node.condition))
+    elif isinstance(node, _phys.ProjectExec):
+        sig.append(list(node.column_names))
+    elif isinstance(node, _phys.WithColumnExec):
+        sig.append([node.col_name, expr_signature(node.expr)])
+    elif isinstance(node, _phys.HashAggregateExec):
+        sig.append(["keys", list(node.group_keys)])
+        sig.append(["aggs", [list(a) for a in node.aggs]])
+    elif isinstance(node, _phys.SortMergeJoinExec):
+        sig.append([node.how, bool(node.bucketed),
+                    list(node.left_keys), list(node.right_keys)])
+    elif isinstance(node, (_phys.SortExec, _phys.ShuffleExchangeExec)):
+        sig.append(list(getattr(node, "keys", ())))
+    elif isinstance(node, _phys.OrderByExec):
+        sig.append([[k, bool(asc)] for k, asc in node.keys])
+    elif isinstance(node, _phys.LimitExec):
+        sig.append(node.n)
+    elif isinstance(node, _phys.SetOpExec):
+        sig.append(node.op)
+    children = node.children()
+    if children:
+        sig.append([node_signature(c) for c in children])
+    return sig
+
+
+def plan_fingerprint(node, posture: Optional[dict] = None) -> str:
+    """16-hex-char fingerprint of `node`'s execution class (tree signature +
+    ambient flag posture). Deterministic across processes: the signature is
+    serialized as canonical JSON (sorted keys, no whitespace) before
+    hashing. Never raises — an unexpected plan shape degrades to hashing
+    whatever signature the walk produced."""
+    if posture is None:
+        posture = flag_posture()
+    try:
+        payload = json.dumps(
+            {"plan": node_signature(node), "flags": posture},
+            sort_keys=True,
+            separators=(",", ":"),
+            default=str,
+        )
+    except Exception:
+        payload = f"{type(node).__name__}:{posture}"
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+def fingerprint_wanted() -> bool:
+    """Whether computing a fingerprint at plan time can reach any consumer:
+    the history store is enabled, a ledger is open (exporter / accounting /
+    serving tenant), or a span sink is recording. With everything off this
+    is one env read + one contextvar read — the zero-cost-off contract."""
+    if _history.enabled():
+        return True
+    if _accounting.current_ledger() is not None:
+        return True
+    return _tracing.active()

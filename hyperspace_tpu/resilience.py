@@ -168,6 +168,16 @@ def lane_scope(lane: Optional[str]) -> Iterator[None]:
         _lane.reset(token)
 
 
+def current_lane() -> Optional[str]:
+    """The ambient serving lane (None outside the serving layer) — what the
+    query ledger captures at open so history records and the SLO reporter
+    can slice by lane."""
+    sc = _scope.get()
+    if sc is not None and sc.lane is not None:
+        return sc.lane
+    return _lane.get()
+
+
 def register_yield_hook(fn: Optional[Callable[[], None]]) -> None:
     """Install (or clear) the batch-lane cooperative yield hook — called by
     `serve.scheduler` when its first worker spawns."""

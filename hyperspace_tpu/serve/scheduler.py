@@ -53,6 +53,7 @@ from .. import resilience as _resilience
 from ..exceptions import HyperspaceException
 from ..telemetry import accounting as _accounting
 from ..telemetry import metrics as _metrics
+from ..telemetry import slo as _slo
 from .admission import AdmissionController
 from .singleflight import serving_enabled
 
@@ -86,6 +87,17 @@ _QUEUE_WAIT_S = _metrics.histogram("serve.queue.wait_s")
 _COMPLETED = _metrics.counter("serve.completed")
 _FAILED = _metrics.counter("serve.failed")
 _LANE_LATENCY = {lane: _metrics.histogram(f"serve.latency.{lane}") for lane in LANES}
+# Lane visibility (Prometheus output previously only distinguished TENANTS):
+# per-lane queue depth and in-flight gauges, plus lane histograms in the
+# shared `latency.*` family the ledger's `latency.<root>` series live in —
+# one scrape now separates the interactive tail from the batch tail.
+_LANE_QUEUE_DEPTH = {
+    lane: _metrics.gauge(f"serve.queue.depth.{lane}") for lane in LANES
+}
+_LANE_INFLIGHT = {lane: _metrics.gauge(f"serve.inflight.{lane}") for lane in LANES}
+_LANE_SERVE_LATENCY = {
+    lane: _metrics.histogram(f"latency.serve.{lane}") for lane in LANES
+}
 
 
 def default_max_concurrent() -> int:
@@ -278,7 +290,7 @@ class QueryServer:
             if self._closed:
                 raise HyperspaceException("QueryServer is closed")
         if not serving_enabled():
-            return self._run_serial(fn, tenant)
+            return self._run_serial(fn, tenant, lane)
         self.admission.admit(tenant)
         fut: "Future[T]" = Future()
         item = _Item(fut, fn, tenant, lane)
@@ -291,6 +303,7 @@ class QueryServer:
                     _interactive_begin()  # ended in _execute's finally
                 self._lanes[lane].append(item)
                 _QUEUE_DEPTH.set(sum(len(q) for q in self._lanes.values()))
+                _LANE_QUEUE_DEPTH[lane].set(len(self._lanes[lane]))
                 # notify_all, not notify: a single wake could land on the
                 # reserved interactive worker for a batch item, which would
                 # ignore it and leave the item queued with everyone else
@@ -308,20 +321,30 @@ class QueryServer:
         """`submit` + wait: the blocking convenience for scripted callers."""
         return self.submit(fn, tenant=tenant, lane=lane).result()
 
-    def _run_serial(self, fn, tenant: str) -> Future:
+    def _run_serial(self, fn, tenant: str, lane: str = "batch") -> Future:
         """The ``HYPERSPACE_SERVING=0`` path: execute inline on the calling
         thread, one submission at a time — indistinguishable from a single
-        caller invoking the engine directly (no admission, no lanes, no
-        flights; the tenant label still rides for telemetry parity)."""
+        caller invoking the engine directly (no admission, no priority, no
+        flights; the tenant and lane labels still ride for telemetry/SLO
+        parity — an operator flipping the flag must not lose SLO history)."""
         fut: Future = Future()
         fut.set_running_or_notify_cancel()
+        t0 = time.monotonic()
+        failed = False
         try:
-            with self._serial_lock, _accounting.tenant_scope(tenant):
+            with self._serial_lock, _accounting.tenant_scope(
+                tenant
+            ), _resilience.lane_scope(lane):
                 out = fn()
         except BaseException as e:
+            failed = True
             _FAILED.inc()
             fut.set_exception(e)
             return fut
+        finally:
+            wall = time.monotonic() - t0
+            _LANE_SERVE_LATENCY[lane].observe(wall)
+            _slo.observe(lane, wall, tenant=tenant, failed=failed)
         _COMPLETED.inc()
         fut.set_result(out)
         return fut
@@ -334,6 +357,7 @@ class QueryServer:
             if self._lanes[lane]:
                 item = self._lanes[lane].popleft()
                 _QUEUE_DEPTH.set(sum(len(q) for q in self._lanes.values()))
+                _LANE_QUEUE_DEPTH[lane].set(len(self._lanes[lane]))
                 return item
         return None
 
@@ -369,6 +393,8 @@ class QueryServer:
             return  # caller cancelled while queued
         _QUEUE_WAIT_S.observe(t_start - item.t_admitted)
         _ACTIVE.inc()
+        _LANE_INFLIGHT[item.lane].inc()
+        failed = False
         try:
             # The tenant label wraps the WHOLE query: the root span/ledger
             # the thunk opens (collect/count/build) inherits it, and every
@@ -381,6 +407,7 @@ class QueryServer:
             ):
                 out = item.fn()
         except BaseException as e:
+            failed = True
             _FAILED.inc()
             item.future.set_exception(e)
         else:
@@ -388,10 +415,17 @@ class QueryServer:
             item.future.set_result(out)
         finally:
             _ACTIVE.dec()
+            _LANE_INFLIGHT[item.lane].dec()
             if item.lane == "interactive":
                 _interactive_end()
             self.admission.release(item.tenant)
-            _LANE_LATENCY[item.lane].observe(time.monotonic() - item.t_admitted)
+            wall = time.monotonic() - item.t_admitted
+            _LANE_LATENCY[item.lane].observe(wall)
+            _LANE_SERVE_LATENCY[item.lane].observe(wall)
+            # SLO accounting on the client-experienced latency (admission →
+            # completion, queue wait included — the only honest SLI). A
+            # failed query is a violation however fast it errored.
+            _slo.observe(item.lane, wall, tenant=item.tenant, failed=failed)
 
     # -- introspection ------------------------------------------------------
 

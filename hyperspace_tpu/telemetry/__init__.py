@@ -1,4 +1,13 @@
-from . import accounting, compile_log, exporter, faults, metrics, tracing  # noqa: F401
+from . import (  # noqa: F401
+    accounting,
+    compile_log,
+    exporter,
+    faults,
+    history,
+    metrics,
+    slo,
+    tracing,
+)
 from .event_logging import (  # noqa: F401
     EventLogger,
     EventLoggerFactory,

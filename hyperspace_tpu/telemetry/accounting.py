@@ -116,12 +116,28 @@ _PENDING: "deque[dict]" = deque(maxlen=256)
 class QueryLedger:
     """Thread-safe resource accumulator for one root query scope."""
 
-    __slots__ = ("query_id", "name", "tenant", "start_s", "wall_s", "_lock", "_counts")
+    __slots__ = (
+        "query_id",
+        "name",
+        "tenant",
+        "lane",
+        "start_s",
+        "wall_s",
+        "_lock",
+        "_counts",
+    )
 
-    def __init__(self, query_id: str, name: str, tenant: Optional[str] = None):
+    def __init__(
+        self,
+        query_id: str,
+        name: str,
+        tenant: Optional[str] = None,
+        lane: Optional[str] = None,
+    ):
         self.query_id = query_id
         self.name = name
         self.tenant = tenant
+        self.lane = lane
         self.start_s = time.time()
         self.wall_s: Optional[float] = None
         self._lock = threading.Lock()
@@ -148,6 +164,8 @@ class QueryLedger:
             }
             if self.tenant is not None:
                 out["tenant"] = self.tenant
+            if self.lane is not None:
+                out["lane"] = self.lane
             if self.wall_s is not None:
                 out["wall_s"] = round(self.wall_s, 6)
             for k in sorted(self._counts):
@@ -159,12 +177,16 @@ class QueryLedger:
 def enabled() -> bool:
     """Whether query scopes should carry a ledger: any tracing sink is active
     (a traced query always gets one), the continuous exporter is running,
-    ``HYPERSPACE_ACCOUNTING=1`` forces it — or the query carries a TENANT
-    label (a served query is always accounted: per-tenant budgets/rollups
-    are the serving layer's currency, and the label is the opt-in). One
-    predicate on the root-scope path only — per-observation `add` calls gate
-    on the ambient ledger, not on this."""
+    ``HYPERSPACE_ACCOUNTING=1`` forces it, the workload HISTORY store is on
+    (``HYPERSPACE_HISTORY=1`` — closed ledgers are what the store lands, so
+    enabling history enables the ledgers that feed it) — or the query
+    carries a TENANT label (a served query is always accounted: per-tenant
+    budgets/rollups are the serving layer's currency, and the label is the
+    opt-in). One predicate on the root-scope path only — per-observation
+    `add` calls gate on the ambient ledger, not on this."""
     if os.environ.get(ENV_ACCOUNTING) == "1":
+        return True
+    if os.environ.get("HYPERSPACE_HISTORY") == "1":
         return True
     if _tenant.get() is not None:
         return True
@@ -311,11 +333,25 @@ def ledger_scope(query_id: str, name: str, root=None) -> Iterator[QueryLedger]:
     if existing is not None:
         yield existing
         return
-    led = QueryLedger(query_id, name, tenant=_tenant.get())
+    # The serving lane rides the ledger like the tenant does (history
+    # records and the SLO reporter slice by it). Lazy import: resilience
+    # imports accounting at module load, so the reverse edge must not.
+    from .. import resilience as _resilience
+
+    led = QueryLedger(
+        query_id, name, tenant=_tenant.get(), lane=_resilience.current_lane()
+    )
     token = _current.set(led)
     t0 = time.monotonic()
     try:
         yield led
+    except BaseException:
+        # The failure lands ON the ledger (status="error"), so the durable
+        # history record carries it and the offline SLO view
+        # (`slo.compliance_over`) judges an outage the way the live monitor
+        # does — a fast failure is not compliance.
+        led.set_value("status", "error")
+        raise
     finally:
         _current.reset(token)
         wall = None
@@ -342,6 +378,12 @@ def ledger_scope(query_id: str, name: str, root=None) -> Iterator[QueryLedger]:
                 root.set_attr("ledger", d)
             except Exception:
                 pass
+        # Durable workload history (telemetry/history.py): one env read when
+        # off — and this close path itself only runs for accounted queries.
+        if os.environ.get("HYPERSPACE_HISTORY") == "1":
+            from . import history as _history
+
+            _history.land(d, root)
         with _recent_lock:
             _RECENT.append(led)
             _PENDING.append(d)

@@ -175,6 +175,12 @@ def _on_event_duration(event: str, duration: float, **_kw) -> None:
         if sp is not None:
             sp.inc_attr("xla_compiles", 1)
             sp.inc_attr("xla_compile_s", round(float(duration), 6))
+        # Per-query compile bill on the ledger too (the workload history
+        # store's compile-storm hotspot axis); no-op without an open ledger.
+        from . import accounting as _accounting
+
+        _accounting.add("xla_compiles", 1)
+        _accounting.add("xla_compile_s", round(float(duration), 6))
     elif event == _EVENT_JAXPR_TRACE:
         _TRACES.inc()
         if not _mark_traced():

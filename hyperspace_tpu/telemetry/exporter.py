@@ -51,6 +51,11 @@ ENV_METRICS_FILE = "HYPERSPACE_METRICS_FILE"
 ENV_METRICS_INTERVAL = "HYPERSPACE_METRICS_INTERVAL_S"
 _DEFAULT_INTERVAL_S = 10.0
 
+#: Exporter frame schema version (shared contract style with the history
+#: segments' per-record version): bump only on changes a tolerant reader —
+#: one that ignores unknown keys — could not absorb.
+SCHEMA_VERSION = 1
+
 # RLock: the SIGTERM/SIGINT handler runs stop() on the main thread, and a
 # signal can land while the main thread itself holds this lock (an idempotent
 # start()/stop() call) — a plain Lock would self-deadlock the handler.
@@ -102,6 +107,10 @@ class MetricsExporter:
         from . import accounting, compile_log
 
         out = {
+            # Versioned frames: consumers tolerate unknown keys and gate
+            # hard parsing changes on this (the forward-compat contract the
+            # history segments share — see docs/observability.md).
+            "schema_version": SCHEMA_VERSION,
             "ts": round(time.time(), 6),
             "seq": self._seq,
             "interval_s": self.interval_s,
@@ -126,6 +135,18 @@ class MetricsExporter:
         tenants = accounting.tenant_rollup()
         if tenants:
             out["tenants"] = tenants
+        # Serving SLO state (per-lane objectives/burn rates) and workload-
+        # history summary (records landed + drained anomalies): both omitted
+        # when idle, so pre-existing frame consumers see unchanged schemas.
+        from . import history as _history
+        from . import slo as _slo
+
+        slo_state = _slo.summary()
+        if slo_state:
+            out["slo"] = slo_state
+        hist = _history.frame_summary()
+        if hist:
+            out["history"] = hist
         dev = _device_live_bytes()
         if dev is not None:
             out["device_live_bytes"] = dev
@@ -136,12 +157,18 @@ class MetricsExporter:
 
     def _write_frame(self, final: bool = False) -> None:
         try:
+            from . import rotation as _rotation
+
             line = json.dumps(self._frame(final), default=str)
             with self._write_lock:
                 self._seq += 1
-                with open(self.path, "a") as f:
-                    f.write(line + "\n")
-                    f.flush()
+                # Size-capped rotation (HYPERSPACE_METRICS_MAX_MB; off by
+                # default). The final frame rides the same path: when it
+                # itself trips the cap it lands in the fresh live file —
+                # the stream's last line still carries "final": true.
+                _rotation.append(
+                    self.path, line + "\n", _rotation.ENV_METRICS_MAX_MB
+                )
         except Exception:
             pass  # telemetry must never fail the process it observes
 
@@ -311,4 +338,29 @@ def prometheus_text(prefix: str = "hyperspace") -> str:
                     .replace("\n", "\\n")
                 )
                 lines.append(f'{n}{{tenant="{esc}"}} {_prom_num(v)}')
+    # Serving SLO series (lane-labeled): objective/compliance/burn gauges
+    # from the live monitor — absent lanes emit nothing.
+    from . import slo as _slo
+
+    slo_state = _slo.summary()
+    if slo_state:
+        fields = (
+            ("objective_ms", "gauge"),
+            ("compliance", "gauge"),
+            ("burn_5m", "gauge"),
+            ("burn_1h", "gauge"),
+            ("total", "counter"),
+            ("violations", "counter"),
+        )
+        for field, mtype in fields:
+            n = f"{prefix}_slo_{_prom_name(field)}"
+            rendered_type = False
+            for lane in sorted(slo_state):
+                v = slo_state[lane].get(field)
+                if v is None:
+                    continue
+                if not rendered_type:
+                    lines.append(f"# TYPE {n} {mtype}")
+                    rendered_type = True
+                lines.append(f'{n}{{lane="{lane}"}} {_prom_num(v)}')
     return "\n".join(lines) + "\n"

@@ -1,0 +1,857 @@
+"""Workload history store: every closed query ledger, durable, on the lake.
+
+PR 6 made the engine measure the true cost of every query (`QueryLedger`,
+stage histograms, the compile observatory) — and then forget it at process
+exit: the ledger history is a ``deque(32)`` and the exporter stream is
+fire-and-forget. The adaptive cost model (ROADMAP item 4) needs the opposite:
+durable, per-plan-class observed history in the "cost = bytes moved" framing
+of *Evaluating Learned Indexes for External-Memory Joins*. This module is
+that substrate, following the reference's own operation-log pattern — all
+metadata lives ON THE LAKE, concurrency is optimistic, no external service.
+
+Layout (``HYPERSPACE_HISTORY_DIR``, default ``<warehouse>/.hyperspace_history``):
+
+- ``seg-<host>-<pid>-<uuid>.jsonl`` — one APPEND-ONLY segment per writer
+  process generation. Writers never share a file, so concurrent processes
+  are OCC-consistent by construction (the same ownership scheme as the
+  PR-7 staging dirs: host+pid ride the name for liveness-checked reclaim).
+  Each line is one self-describing record: ``{"schema_version", "kind":
+  "ledger", "ts", "fingerprint", "ledger": {...}}``. Lines are written
+  with a single write+flush, so a SIGKILL mid-append tears at most the
+  LAST line — readers skip torn lines (``history.torn_lines``) and keep
+  every committed record.
+- ``compact-<host>-<pid>-<uuid>.jsonl`` — compaction output: per-
+  fingerprint BASELINE CHECKPOINT records (``"kind": "baseline"``)
+  summarizing raw ledgers via serialized `metrics.Histogram` bucket state
+  (`dump_state`/`merge_state`), so baselines survive with bounded bytes.
+- segments are bounded (``HYPERSPACE_HISTORY_SEGMENT_MB``, rotate-on-cap)
+  and compacted opportunistically in the background of rotation/open: a
+  segment whose writer is provably dead (same host, dead pid) or older
+  than ``HYPERSPACE_HISTORY_TTL_S`` is CLAIMED by atomic rename (losers
+  of the race skip — the `reclaim_orphans` arbitration), folded into
+  checkpoints, committed via tmp + `os.replace`, then deleted.
+
+On top of the store, per-fingerprint **rolling baselines** (p50/p99 wall,
+bytes decoded/skipped, io retries, xla compiles) are maintained in memory —
+rebuilt from segments at open, so history survives restart — and every
+ledger landing is **anomaly-checked at close**: a query ≥ Nσ over its class
+baseline (``HYPERSPACE_HISTORY_ANOMALY_SIGMA``, default 3) ticks
+``history.anomalies``, lands a ``history_anomaly`` attr on the root span,
+rides the exporter frame's ``history`` key, and warns once per fingerprint.
+
+Cost when off (the default): `enabled()` is ONE env read, checked at ledger
+close only — a query with no telemetry sink active never reaches it at all
+(no ledger opens). Pinned by the zero-cost-off test like PR 6's.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import math
+import os
+import socket
+import threading
+import time
+import uuid
+import warnings
+from collections import deque
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from . import metrics as _metrics
+
+SCHEMA_VERSION = 1
+
+ENV_HISTORY = "HYPERSPACE_HISTORY"
+ENV_HISTORY_DIR = "HYPERSPACE_HISTORY_DIR"
+ENV_SEGMENT_MB = "HYPERSPACE_HISTORY_SEGMENT_MB"
+ENV_TTL_S = "HYPERSPACE_HISTORY_TTL_S"
+ENV_ANOMALY_SIGMA = "HYPERSPACE_HISTORY_ANOMALY_SIGMA"
+
+_DEFAULT_SEGMENT_MB = 4.0
+_DEFAULT_TTL_S = 24 * 3600.0
+_DEFAULT_SIGMA = 3.0
+
+#: A class baseline starts flagging anomalies only once it has seen this
+#: many queries (a 2-sample "baseline" would flag ordinary warmup jitter).
+ANOMALY_MIN_SAMPLES = 8
+#: Sub-5ms queries never flag: at that scale scheduler jitter exceeds any
+#: signal a cost model could act on.
+ANOMALY_MIN_WALL_S = 0.005
+
+SEGMENT_PREFIX = "seg-"
+COMPACT_PREFIX = "compact-"
+CLAIMED_PREFIX = ".claimed-"
+_TMP_PREFIX = ".tmp-"
+
+#: Ledger fields whose per-class totals/means the baseline tracks (beyond
+#: wall): exactly the cost axes the ROADMAP-4 cost model reads.
+TRACKED_FIELDS = (
+    "bytes_decoded",
+    "bytes_skipped",
+    "decode_files",
+    "io_retries",
+    "xla_compiles",
+    "rows_produced",
+)
+
+_RECORDS = _metrics.counter("history.records")
+_ANOMALIES = _metrics.counter("history.anomalies")
+_TORN = _metrics.counter("history.torn_lines")
+_ROTATED = _metrics.counter("history.segments_rotated")
+_COMPACTED = _metrics.counter("history.segments_compacted")
+
+#: Anomalies drained into exporter frames (bounded like the ledger queue).
+_PENDING_ANOMALIES: "deque[dict]" = deque(maxlen=64)
+_warned_fingerprints: set = set()
+
+_stores_lock = threading.Lock()
+_stores: Dict[str, "HistoryStore"] = {}
+
+
+def enabled() -> bool:
+    """One env read: the history hot-path gate."""
+    return os.environ.get(ENV_HISTORY) == "1"
+
+
+def history_dir() -> str:
+    """The store location: ``HYPERSPACE_HISTORY_DIR`` when set, else next to
+    the active session's index logs (``<warehouse>/.hyperspace_history`` —
+    the on-lake placement of the operation-log pattern), else the cwd."""
+    env = os.environ.get(ENV_HISTORY_DIR)
+    if env:
+        return env
+    try:
+        from ..engine.session import HyperspaceSession
+
+        sess = HyperspaceSession._active
+        if sess is not None:
+            return os.path.join(sess.warehouse, ".hyperspace_history")
+    except Exception:
+        pass
+    return os.path.join(".", ".hyperspace_history")
+
+
+def _segment_cap_bytes() -> int:
+    try:
+        mb = float(os.environ.get(ENV_SEGMENT_MB, "") or _DEFAULT_SEGMENT_MB)
+    except ValueError:
+        mb = _DEFAULT_SEGMENT_MB
+    return max(4096, int(mb * 1_000_000))
+
+
+def _ttl_s() -> float:
+    try:
+        return max(0.0, float(os.environ.get(ENV_TTL_S, "") or _DEFAULT_TTL_S))
+    except ValueError:
+        return _DEFAULT_TTL_S
+
+
+def _sigma() -> float:
+    try:
+        return max(0.5, float(os.environ.get(ENV_ANOMALY_SIGMA, "") or _DEFAULT_SIGMA))
+    except ValueError:
+        return _DEFAULT_SIGMA
+
+
+def _owner_of(name: str) -> Tuple[Optional[str], int]:
+    """(host, pid) from a ``seg-<host>-<pid>-<uuid>.jsonl`` style name —
+    hosts may contain '-', so parse from the RIGHT."""
+    stem = name[: -len(".jsonl")] if name.endswith(".jsonl") else name
+    parts = stem.split("-")
+    try:
+        return "-".join(parts[1:-2]) or None, int(parts[-2])
+    except (IndexError, ValueError):
+        return None, -1
+
+
+def _pid_alive(pid: int) -> bool:
+    from ..util.procs import pid_alive
+
+    return pid_alive(pid)
+
+
+def _claim_parts(name: str) -> Tuple[Optional[str], int, Optional[str]]:
+    """(claimant host, claimant pid, claimed original name) from a
+    ``.claimed-<host>~<pid>~<orig>`` name; (None, -1, None) if unparseable.
+    The HOST rides the name because history dirs are shared across hosts
+    (segment TTL reclaim exists for exactly that) — a pid number alone is
+    meaningless on another machine."""
+    rest = name[len(CLAIMED_PREFIX):]
+    parts = rest.split("~", 2)
+    if len(parts) != 3:
+        return None, -1, None
+    try:
+        return parts[0], int(parts[1]), parts[2]
+    except ValueError:
+        return None, -1, None
+
+
+def _claim_orphaned(name: str, path: str) -> bool:
+    """Whether a claim's compactor is provably gone: same-host claimant →
+    pid liveness; foreign/unparseable claimant → mtime age past the TTL
+    (the exact liveness rules segments use)."""
+    host, pid, _orig = _claim_parts(name)
+    if host == socket.gethostname():
+        return not _pid_alive(pid)
+    try:
+        ttl = _ttl_s()
+        return ttl > 0 and time.time() - os.stat(path).st_mtime > ttl
+    except OSError:
+        return False
+
+
+def _root_name(name: str) -> str:
+    """The underlying segment name beneath any number of claim prefixes
+    (a claim of an orphaned claim nests them)."""
+    while name.startswith(CLAIMED_PREFIX):
+        _h, _p, orig = _claim_parts(name)
+        if not orig:
+            break
+        name = orig
+    return name
+
+
+def _folded_sources(dir_path: str) -> set:
+    """Root segment names already folded into a committed checkpoint file
+    (each ``compact-*.jsonl`` leads with a ``compact_manifest`` record
+    listing its sources). A claim whose root appears here is GARBAGE from a
+    compactor that died between checkpoint commit and claim unlink — its
+    records are already counted, so readers skip it and the next compaction
+    deletes it instead of double-folding."""
+    out: set = set()
+    try:
+        names = os.listdir(dir_path)
+    except OSError:
+        return out
+    for n in names:
+        if n.startswith(COMPACT_PREFIX) and n.endswith(".jsonl"):
+            # The manifest is pinned to the file's FIRST record — stop
+            # there instead of JSON-parsing every checkpoint in the file
+            # (this runs on the rotation path of a long-lived store).
+            for rec in iter_file_records(os.path.join(dir_path, n)):
+                if rec.get("kind") == "compact_manifest":
+                    for s in rec.get("sources") or []:
+                        out.add(str(s))
+                break
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Per-fingerprint rolling baseline
+# ---------------------------------------------------------------------------
+
+
+class FingerprintBaseline:
+    """Rolling cost baseline of one plan class: wall-clock distribution (a
+    private `metrics.Histogram` for p50/p99 — its bucket state is what the
+    compaction checkpoints serialize) plus sum/sum-of-squares for the Nσ
+    anomaly bound, plus totals of the tracked cost fields."""
+
+    __slots__ = ("fingerprint", "names", "hist", "wall_sumsq", "fields")
+
+    def __init__(self, fingerprint: str):
+        self.fingerprint = fingerprint
+        self.names: set = set()
+        self.hist = _metrics.Histogram(f"history.{fingerprint}")  # unregistered
+        self.wall_sumsq = 0.0
+        self.fields: Dict[str, float] = {}
+
+    @property
+    def count(self) -> int:
+        return self.hist.count
+
+    def mean_std(self) -> Tuple[float, float]:
+        n = self.hist.count
+        if n == 0:
+            return 0.0, 0.0
+        mean = self.hist.total / n
+        var = max(0.0, self.wall_sumsq / n - mean * mean)
+        return mean, math.sqrt(var)
+
+    def check_anomaly(self, wall: float) -> Optional[dict]:
+        """Nσ test against the CURRENT baseline (call before `observe` so a
+        query is never compared against a baseline containing itself)."""
+        if self.hist.count < ANOMALY_MIN_SAMPLES or wall < ANOMALY_MIN_WALL_S:
+            return None
+        mean, std = self.mean_std()
+        # The σ bound with two floors: a near-zero-variance class (identical
+        # warm lookups) must not flag 1.3x jitter, and the absolute floor
+        # keeps microsecond classes quiet.
+        threshold = max(mean + _sigma() * std, mean * 1.25, ANOMALY_MIN_WALL_S)
+        if wall <= threshold:
+            return None
+        return {
+            "fingerprint": self.fingerprint,
+            "wall_s": round(wall, 6),
+            "baseline_mean_s": round(mean, 6),
+            "baseline_std_s": round(std, 6),
+            "threshold_s": round(threshold, 6),
+            "baseline_n": self.hist.count,
+        }
+
+    def observe(self, ledger: dict) -> None:
+        wall = ledger.get("wall_s")
+        if isinstance(wall, (int, float)):
+            self.hist.observe(float(wall))
+            self.wall_sumsq += float(wall) * float(wall)
+        name = ledger.get("name")
+        if name and len(self.names) < 8:
+            self.names.add(str(name))
+        for f in TRACKED_FIELDS:
+            v = ledger.get(f)
+            if isinstance(v, (int, float)) and v:
+                self.fields[f] = self.fields.get(f, 0) + v
+
+    def to_checkpoint(self) -> dict:
+        return {
+            "schema_version": SCHEMA_VERSION,
+            "kind": "baseline",
+            "fingerprint": self.fingerprint,
+            "names": sorted(self.names),
+            "wall": self.hist.dump_state(),
+            "wall_sumsq": round(self.wall_sumsq, 9),
+            "fields": {k: round(v, 6) if isinstance(v, float) else v
+                       for k, v in sorted(self.fields.items())},
+        }
+
+    def merge_checkpoint(self, rec: dict) -> None:
+        self.hist.merge_state(rec.get("wall") or {})
+        try:
+            self.wall_sumsq += float(rec.get("wall_sumsq") or 0.0)
+        except (TypeError, ValueError):
+            pass
+        names = rec.get("names")
+        if isinstance(names, (list, tuple)):
+            for n in names:
+                if len(self.names) < 8:
+                    self.names.add(str(n))
+        fields = rec.get("fields")
+        if isinstance(fields, dict):
+            for k, v in fields.items():
+                if isinstance(v, (int, float)):
+                    self.fields[k] = self.fields.get(k, 0) + v
+
+    def summary(self) -> dict:
+        mean, std = self.mean_std()
+        s = self.hist.summary()
+        out = {
+            "n": s["count"],
+            "names": sorted(self.names),
+            "wall_total_s": round(s["total"], 6),
+            "wall_mean_s": round(mean, 6),
+            "wall_std_s": round(std, 6),
+        }
+        if s["count"]:
+            out["wall_p50_s"] = s.get("p50")
+            out["wall_p99_s"] = s.get("p99")
+            out["wall_max_s"] = s.get("max")
+        for k, v in sorted(self.fields.items()):
+            out[k] = round(v, 6) if isinstance(v, float) else v
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Segment reader (tolerant: torn lines, unknown keys, future versions)
+# ---------------------------------------------------------------------------
+
+
+def iter_file_records(path: str, count_torn: bool = False) -> Iterator[dict]:
+    """Parsed records of one segment. Torn/garbled lines are skipped — a
+    SIGKILL mid-append tears at most the final line, and the committed
+    prefix must stay readable. `count_torn` ticks ``history.torn_lines``
+    per skipped line: only the store's OWN load pass sets it, so the
+    counter measures tears encountered once — not re-reads of the same old
+    tear by every reporting tool (which would false-alarm a monitor).
+    Records from FUTURE schema versions parse too: the forward-compat
+    contract is "tolerate unknown keys, skip unknown kinds", never
+    "reject"."""
+    try:
+        f = open(path, "r")
+    except OSError:
+        return
+    with f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                if count_torn:
+                    _TORN.inc()
+                continue
+            if isinstance(rec, dict):
+                yield rec
+
+
+def _store_files(dir_path: str, include_claimed: bool = True) -> List[str]:
+    try:
+        names = os.listdir(dir_path)
+    except OSError:
+        return []
+    out = []
+    folded: Optional[set] = None  # computed lazily, only when a claim exists
+    for n in sorted(names):
+        if n.startswith((SEGMENT_PREFIX, COMPACT_PREFIX)) and n.endswith(".jsonl"):
+            out.append(os.path.join(dir_path, n))
+        elif include_claimed and n.startswith(CLAIMED_PREFIX) and n.endswith(".jsonl"):
+            # A claim whose compactor died mid-fold: its records are intact
+            # and must stay visible (the next compaction re-claims it). A
+            # LIVE claimant's file is skipped — its content is about to be
+            # re-committed as a checkpoint and must not double-count; and a
+            # claim whose root is already in a committed manifest is garbage
+            # (counted once already), skipped for the same reason.
+            path = os.path.join(dir_path, n)
+            if not _claim_orphaned(n, path):
+                continue
+            if folded is None:
+                folded = _folded_sources(dir_path)
+            if _root_name(n) in folded:
+                continue
+            out.append(path)
+    return out
+
+
+def iter_records(dir_path: str, count_torn: bool = False) -> Iterator[dict]:
+    """Every record in a history dir (segments + compacted checkpoints +
+    orphaned claims), torn-line tolerant. The reader `tools/hsreport.py`
+    and `tools/bench_compare.py --history` share."""
+    for path in _store_files(dir_path):
+        yield from iter_file_records(path, count_torn=count_torn)
+
+
+def fold_baselines(records: Iterator[dict]) -> Dict[str, FingerprintBaseline]:
+    """Fold a record stream into per-fingerprint baselines: ledger records
+    observe, baseline checkpoints merge, unknown kinds skip (forward
+    compat). THE one folding implementation — store load, compaction, and
+    the CLI tools all call it."""
+    out: Dict[str, FingerprintBaseline] = {}
+    for rec in records:
+        kind = rec.get("kind")
+        fp = rec.get("fingerprint")
+        if not fp:
+            continue
+        if kind == "ledger":
+            led = rec.get("ledger")
+            if isinstance(led, dict):
+                bl = out.get(fp)
+                if bl is None:
+                    bl = out[fp] = FingerprintBaseline(fp)
+                bl.observe(led)
+        elif kind == "baseline":
+            bl = out.get(fp)
+            if bl is None:
+                bl = out[fp] = FingerprintBaseline(fp)
+            bl.merge_checkpoint(rec)
+        # any other kind: a future writer's record — tolerated, skipped.
+    return out
+
+
+def split_records(records) -> Tuple[Dict[str, list], Dict[str, list]]:
+    """Partition a record stream into (raw ledger records, checkpoint
+    records) keyed by fingerprint, ledgers time-ordered — the grouping both
+    reporting tools start from."""
+    raw: Dict[str, list] = {}
+    checkpoints: Dict[str, list] = {}
+    for rec in records:
+        fp = rec.get("fingerprint")
+        if not fp:
+            continue
+        if rec.get("kind") == "ledger" and isinstance(rec.get("ledger"), dict):
+            raw.setdefault(fp, []).append(rec)
+        elif rec.get("kind") == "baseline":
+            checkpoints.setdefault(fp, []).append(rec)
+    for recs in raw.values():
+        recs.sort(key=lambda r: r.get("ts") or 0.0)
+    return raw, checkpoints
+
+
+def recent_vs_baseline(
+    raw: Dict[str, list],
+    checkpoints: Dict[str, list],
+    recent_k: int,
+    min_baseline: int = 1,
+    require_full_window: bool = False,
+) -> List[dict]:
+    """Per plan class: the p50 wall of the newest `recent_k` raw ledgers vs
+    the class BASELINE p50 (every older ledger + compacted checkpoints).
+    THE one expected-vs-actual computation — `tools/hsreport.py`'s drift
+    table and `tools/bench_compare.py --history`'s CI gate both call it
+    (the gate passes ``min_baseline=ANOMALY_MIN_SAMPLES`` and
+    ``require_full_window=True`` so it only judges credible classes; the
+    report shows every class with any recent signal). Classes without a
+    computable pair are omitted."""
+    out = []
+    for fp in sorted(set(raw) | set(checkpoints)):
+        ledgers = raw.get(fp, [])
+        recent = [
+            r["ledger"]["wall_s"]
+            for r in ledgers[-recent_k:]
+            if isinstance(r["ledger"].get("wall_s"), (int, float))
+        ]
+        if not recent or (require_full_window and len(recent) < recent_k):
+            continue
+        baseline = FingerprintBaseline(fp)
+        for rec in checkpoints.get(fp, ()):
+            baseline.merge_checkpoint(rec)
+        for rec in ledgers[:-recent_k]:
+            baseline.observe(rec["ledger"])
+        if baseline.count < min_baseline:
+            continue
+        expected = baseline.hist.quantile(0.5)
+        if expected is None:
+            continue
+        actual = sorted(recent)[len(recent) // 2]
+        out.append(
+            {
+                "fingerprint": fp,
+                "names": sorted(baseline.names),
+                "baseline_n": baseline.count,
+                "recent_n": len(recent),
+                "expected_p50_s": round(expected, 6),
+                "actual_p50_s": round(actual, 6),
+                "ratio": round(actual / expected, 3) if expected else None,
+            }
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The store
+# ---------------------------------------------------------------------------
+
+
+class HistoryStore:
+    """One process's handle on a history directory: an append-only segment
+    it owns exclusively, plus the folded baselines of everything on disk."""
+
+    def __init__(self, dir_path: str, load: bool = True, compact_on_open: bool = True):
+        self.dir = dir_path
+        os.makedirs(dir_path, exist_ok=True)
+        self._lock = threading.RLock()
+        self._fh = None
+        self._seg_path: Optional[str] = None
+        self._seg_bytes = 0
+        self.records_written = 0
+        self._baselines: Dict[str, FingerprintBaseline] = (
+            fold_baselines(iter_records(dir_path, count_torn=True)) if load else {}
+        )
+        if compact_on_open:
+            try:
+                self.compact()
+            except Exception:
+                pass  # compaction is an optimization, never a failure mode
+
+    # -- segment ownership --------------------------------------------------
+
+    def _new_segment_name(self) -> str:
+        return (
+            f"{SEGMENT_PREFIX}{socket.gethostname()}-{os.getpid()}"
+            f"-{uuid.uuid4().hex[:8]}.jsonl"
+        )
+
+    def _open_segment_locked(self) -> None:
+        self._seg_path = os.path.join(self.dir, self._new_segment_name())
+        self._fh = open(self._seg_path, "a")
+        self._seg_bytes = 0
+
+    def _rotate_locked(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+        self._open_segment_locked()
+        _ROTATED.inc()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+    # -- landing ------------------------------------------------------------
+
+    def record(self, fingerprint: str, ledger: dict) -> Optional[dict]:
+        """Land one closed ledger: anomaly-check against the class baseline,
+        append the record (one write+flush — the crash-safety unit), fold
+        into the in-memory baseline. Returns the anomaly verdict, or None."""
+        rec = {
+            "schema_version": SCHEMA_VERSION,
+            "kind": "ledger",
+            "ts": round(time.time(), 6),
+            "fingerprint": fingerprint,
+            "ledger": ledger,
+        }
+        # json.dumps defaults to ensure_ascii=True, so the line is pure
+        # ASCII and len(line) == encoded bytes — the segment-cap arithmetic
+        # below is exact without paying an encode.
+        line = json.dumps(rec, default=str) + "\n"
+        rotated = False
+        with self._lock:
+            bl = self._baselines.get(fingerprint)
+            if bl is None:
+                bl = self._baselines[fingerprint] = FingerprintBaseline(fingerprint)
+            wall = ledger.get("wall_s")
+            verdict = (
+                bl.check_anomaly(float(wall))
+                if isinstance(wall, (int, float))
+                else None
+            )
+            bl.observe(ledger)
+            if self._fh is None or self._seg_bytes + len(line) > _segment_cap_bytes():
+                if self._fh is None:
+                    self._open_segment_locked()
+                else:
+                    self._rotate_locked()
+                    rotated = True
+            wrote = False
+            try:
+                self._fh.write(line)
+                self._fh.flush()
+                self._seg_bytes += len(line)
+                self.records_written += 1
+                wrote = True
+            except OSError:
+                pass  # telemetry must never fail the query it observed
+        if rotated:
+            # Background compaction rides rotation — OUTSIDE the store lock
+            # (folding dead segments does listdir + reads + fsync; other
+            # threads' ledger closes must not stall behind it).
+            try:
+                self.compact()
+            except Exception:
+                pass
+        if wrote:
+            # Only records that actually reached the segment count — the
+            # counter must reconcile with what a reader finds on disk.
+            _RECORDS.inc()
+        if verdict is not None:
+            _ANOMALIES.inc()
+            verdict["query_id"] = ledger.get("query_id")
+            verdict["name"] = ledger.get("name")
+            _PENDING_ANOMALIES.append(verdict)
+            if fingerprint not in _warned_fingerprints:
+                _warned_fingerprints.add(fingerprint)
+                warnings.warn(
+                    f"hyperspace history: query class {fingerprint} "
+                    f"({ledger.get('name')}) ran {verdict['wall_s']:.3f}s, "
+                    f"over its baseline threshold {verdict['threshold_s']:.3f}s "
+                    f"(mean {verdict['baseline_mean_s']:.3f}s over "
+                    f"{verdict['baseline_n']} queries). Further anomalies in "
+                    "this class tick history.anomalies silently.",
+                    RuntimeWarning,
+                    stacklevel=4,
+                )
+        return verdict
+
+    # -- baselines ----------------------------------------------------------
+
+    def baselines(self) -> Dict[str, dict]:
+        with self._lock:
+            return {fp: bl.summary() for fp, bl in self._baselines.items()}
+
+    def baseline_for(self, fingerprint: str) -> Optional[FingerprintBaseline]:
+        with self._lock:
+            return self._baselines.get(fingerprint)
+
+    # -- compaction ---------------------------------------------------------
+
+    def _compactable(self) -> List[str]:
+        """Segments/compacts safe to fold: not our own live segment, writer
+        provably dead on this host, or older than the TTL (the
+        `reclaim_orphans` liveness rules). Orphaned claims re-qualify."""
+        out = []
+        ttl = _ttl_s()
+        now = time.time()
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return []
+        for name in names:
+            if not name.endswith(".jsonl"):
+                continue
+            path = os.path.join(self.dir, name)
+            if path == self._seg_path:
+                continue
+            if name.startswith(CLAIMED_PREFIX):
+                if _claim_orphaned(name, path):
+                    out.append(path)
+                continue
+            if not name.startswith((SEGMENT_PREFIX, COMPACT_PREFIX)):
+                continue
+            host, pid = _owner_of(name)
+            if host == socket.gethostname() and pid > 0:
+                if not _pid_alive(pid):
+                    out.append(path)
+                # A LIVE same-host writer keeps ALL its segments — from the
+                # outside its current segment is indistinguishable from its
+                # rotated ones, and claiming the one it still appends to
+                # would silently lose every record written after the rename
+                # (the fh keeps flushing to an unlinked inode). Its history
+                # compacts when the process exits (pid rule) — the same
+                # lifecycle as the PR-7 staging dirs.
+                continue
+            try:
+                if ttl > 0 and now - os.stat(path).st_mtime > ttl:
+                    out.append(path)
+            except OSError:
+                continue
+        return out
+
+    def compact(self) -> int:
+        """Fold every compactable file into one checkpoint-only compact
+        segment. Concurrency-safe via claim-by-rename: only the process
+        whose rename wins folds a given file (the loser's rename raises and
+        it skips), so records are never double-counted across compactors.
+        The committed checkpoint file LEADS with a ``compact_manifest``
+        record naming its source segments — if this process dies between
+        checkpoint commit and claim unlink, the orphaned claims' roots are
+        in the manifest and later readers/compactors treat them as garbage
+        instead of folding their records a second time. Runs WITHOUT the
+        store lock (only the claim renames arbitrate), so a rotation-
+        triggered compaction never stalls other threads' ledger closes."""
+        candidates = self._compactable()
+        if not candidates:
+            return 0
+        already_folded = _folded_sources(self.dir)
+        claimed: List[str] = []
+        garbage: List[str] = []
+        me = f"{CLAIMED_PREFIX}{socket.gethostname()}~{os.getpid()}~"
+        for path in candidates:
+            claim = os.path.join(
+                os.path.dirname(path), me + os.path.basename(path)
+            )
+            try:
+                os.rename(path, claim)
+            except OSError:
+                continue  # another compactor won this file
+            # Restart the TTL clock on the claim: rename PRESERVES mtime, so
+            # a TTL-aged segment's fresh claim would otherwise be judged
+            # orphaned instantly by a concurrent foreign compactor, which
+            # would re-claim and double-fold the same records.
+            with contextlib.suppress(OSError):
+                os.utime(claim, None)
+            if _root_name(os.path.basename(path)) in already_folded:
+                garbage.append(claim)  # counted by a committed checkpoint
+            else:
+                claimed.append(claim)
+        for p in garbage:
+            with contextlib.suppress(OSError):
+                os.unlink(p)
+        if not claimed:
+            return len(garbage)
+        folded: Dict[str, FingerprintBaseline] = fold_baselines(
+            rec for p in claimed for rec in iter_file_records(p)
+        )
+        tmp = os.path.join(self.dir, f"{_TMP_PREFIX}{uuid.uuid4().hex[:8]}.jsonl")
+        out = os.path.join(
+            self.dir,
+            f"{COMPACT_PREFIX}{socket.gethostname()}-{os.getpid()}"
+            f"-{uuid.uuid4().hex[:8]}.jsonl",
+        )
+        manifest = {
+            "schema_version": SCHEMA_VERSION,
+            "kind": "compact_manifest",
+            "sources": sorted(_root_name(os.path.basename(p)) for p in claimed),
+        }
+        try:
+            with open(tmp, "w") as f:
+                f.write(json.dumps(manifest) + "\n")
+                for fp in sorted(folded):
+                    f.write(json.dumps(folded[fp].to_checkpoint(), default=str) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, out)
+        except OSError:
+            # Commit failed (e.g. disk full): RELEASE the claims by renaming
+            # them back to their original names — a claim held by a live pid
+            # is invisible to readers, so leaving it claimed would hide
+            # those records for this process's whole lifetime.
+            with contextlib.suppress(OSError):
+                os.unlink(tmp)
+            for p in claimed:
+                _h, _p, orig = _claim_parts(os.path.basename(p))
+                if orig:
+                    with contextlib.suppress(OSError):
+                        os.rename(p, os.path.join(os.path.dirname(p), orig))
+            return len(garbage)
+        for p in claimed:
+            with contextlib.suppress(OSError):
+                os.unlink(p)
+        _COMPACTED.inc(len(claimed))
+        return len(claimed) + len(garbage)
+
+
+# ---------------------------------------------------------------------------
+# Module-level wiring (what accounting / exporter / tools call)
+# ---------------------------------------------------------------------------
+
+
+def get_store(dir_path: Optional[str] = None) -> HistoryStore:
+    """The process's store for `dir_path` (default: the ambient history
+    dir). One store per directory; creation folds the on-disk history."""
+    d = os.path.abspath(dir_path or history_dir())
+    with _stores_lock:
+        st = _stores.get(d)
+        if st is None:
+            st = _stores[d] = HistoryStore(d)
+        return st
+
+
+def reset_stores() -> None:
+    """Drop every cached store handle (tests): segments stay on disk; the
+    next `get_store` re-folds them — which is exactly the restart-survival
+    contract the tests pin."""
+    with _stores_lock:
+        for st in _stores.values():
+            st.close()
+        _stores.clear()
+    _PENDING_ANOMALIES.clear()
+    _warned_fingerprints.clear()
+
+
+def land(ledger_dict: dict, root=None) -> Optional[dict]:
+    """Land one closed ledger in the ambient store (called by
+    `accounting.ledger_scope` at close, gated on `enabled()`). The ledger's
+    ``plan_fingerprint`` keys it; ledgers without one (index builds, counts
+    planned before fingerprinting existed) fall back to a name class."""
+    try:
+        st = get_store()
+        fp = ledger_dict.get("plan_fingerprint") or f"name:{ledger_dict.get('name')}"
+        verdict = st.record(fp, ledger_dict)
+    except Exception:
+        return None  # history must never fail the query it records
+    if verdict is not None and root is not None:
+        try:
+            root.set_attr("history_anomaly", verdict)
+        except Exception:
+            pass
+    return verdict
+
+
+def drain_anomalies() -> List[dict]:
+    out: List[dict] = []
+    while _PENDING_ANOMALIES:
+        try:
+            out.append(_PENDING_ANOMALIES.popleft())
+        except IndexError:
+            break
+    return out
+
+
+def frame_summary() -> Optional[dict]:
+    """The exporter frame's ``history`` key: present only once a store has
+    landed records in this process (schema-stable for history-less runs)."""
+    with _stores_lock:
+        stores = list(_stores.values())
+    if not stores:
+        return None
+    out = {
+        "dirs": [st.dir for st in stores],
+        "records_written": sum(st.records_written for st in stores),
+        "fingerprints": sum(len(st._baselines) for st in stores),
+        "anomalies_total": _ANOMALIES.value,
+    }
+    anomalies = drain_anomalies()
+    if anomalies:
+        out["anomalies"] = anomalies
+    return out

@@ -159,8 +159,15 @@ class Histogram:
                 )
                 est = lo + (hi - lo) * ((rank - cum) / n)
                 # Clamp to the observed range: an estimate can never claim a
-                # latency outside what was actually seen.
-                return min(max(est, self.min), self.max)
+                # latency outside what was actually seen. min/max can be
+                # absent with count>0 after merging a checkpoint that
+                # carried buckets but no extrema (forward-compat tolerates
+                # that) — clamp only on the bounds we have.
+                if self.min is not None:
+                    est = max(est, self.min)
+                if self.max is not None:
+                    est = min(est, self.max)
+                return est
             cum += n
         return self.max
 
@@ -190,6 +197,58 @@ class Histogram:
                     v = self._quantile_locked(q)
                     out[key] = None if v is None else round(v, 6)
             return out
+
+    def dump_state(self) -> dict:
+        """JSON-serializable bucket state (sparse: only non-empty slots) —
+        what the workload history store checkpoints into its segments so a
+        baseline survives process restart and segment compaction without
+        keeping every raw observation."""
+        with self._lock:
+            out = {"count": self.count, "total": round(self.total, 9)}
+            if self.min is not None:
+                out["min"] = self.min
+                out["max"] = self.max
+            buckets = {str(i): n for i, n in enumerate(self._buckets) if n}
+            if buckets:
+                out["buckets"] = buckets
+            return out
+
+    def merge_state(self, state: dict) -> None:
+        """Fold a `dump_state` payload INTO this histogram (additive: counts
+        and bucket slots sum, min/max extend). Unknown keys are ignored and
+        malformed fields are skipped — the forward-compat contract of the
+        history segment reader. Everything is PARSED before anything is
+        mutated: one corrupt checkpoint record must neither raise nor leave
+        a half-merged histogram (count without bucket mass)."""
+        if not isinstance(state, dict):
+            return
+        try:
+            count = int(state.get("count", 0))
+            total = float(state.get("total", 0.0))
+        except (TypeError, ValueError):
+            return
+        def _num(v):
+            return float(v) if isinstance(v, (int, float)) and not isinstance(v, bool) else None
+        mn, mx = _num(state.get("min")), _num(state.get("max"))
+        buckets = []
+        raw = state.get("buckets")
+        if isinstance(raw, dict):
+            for key, n in raw.items():
+                try:
+                    i, cnt = int(key), int(n)
+                except (TypeError, ValueError):
+                    continue
+                if 0 <= i < _N_BUCKETS:
+                    buckets.append((i, cnt))
+        with self._lock:
+            self.count += count
+            self.total += total
+            if mn is not None:
+                self.min = mn if self.min is None else min(self.min, mn)
+            if mx is not None:
+                self.max = mx if self.max is None else max(self.max, mx)
+            for i, cnt in buckets:
+                self._buckets[i] += cnt
 
     def export_state(self) -> Tuple[int, float, List[Tuple[float, int]]]:
         """(count, total, cumulative buckets) read under ONE lock hold — the

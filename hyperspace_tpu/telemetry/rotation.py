@@ -1,0 +1,86 @@
+"""Size-capped rotation for the JSONL telemetry sinks.
+
+``HYPERSPACE_TRACE_FILE`` and ``HYPERSPACE_METRICS_FILE`` previously grew
+without bound — a long-lived serving process under tracing would fill its
+disk with spans. This module is the shared append-with-rotation primitive:
+
+- ``HYPERSPACE_TRACE_MAX_MB`` / ``HYPERSPACE_METRICS_MAX_MB`` cap the live
+  file (0 / unset = unbounded, the pre-existing behavior — rotation is
+  strictly opt-in).
+- On cap, the live file shifts to ``<path>.1``, existing ``.1`` → ``.2`` …
+  up to ``HYPERSPACE_SINK_KEEP`` rotated files (default 3); the oldest
+  falls off. The shift happens BEFORE the new write, so one appended blob
+  (a whole trace, a whole exporter frame) is never split across files —
+  every file stays independently parseable.
+- Each rotation ticks ``telemetry.sink.rotations``.
+
+The exporter's ``final: true`` frame rides the same helper: a final frame
+that itself triggers rotation still lands (in the fresh live file) — pinned
+by tests.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from . import metrics as _metrics
+
+ENV_TRACE_MAX_MB = "HYPERSPACE_TRACE_MAX_MB"
+ENV_METRICS_MAX_MB = "HYPERSPACE_METRICS_MAX_MB"
+ENV_SINK_KEEP = "HYPERSPACE_SINK_KEEP"
+_DEFAULT_KEEP = 3
+
+_ROTATIONS = _metrics.counter("telemetry.sink.rotations")
+
+
+def _max_bytes(env_key: str) -> int:
+    try:
+        mb = float(os.environ.get(env_key, "") or 0.0)
+    except ValueError:
+        mb = 0.0
+    return int(mb * 1_000_000) if mb > 0 else 0
+
+
+def keep_files() -> int:
+    try:
+        return max(1, int(os.environ.get(ENV_SINK_KEEP, "") or _DEFAULT_KEEP))
+    except ValueError:
+        return _DEFAULT_KEEP
+
+
+def rotate(path: str) -> None:
+    """Shift `path` → `path.1` → … → `path.<keep>` (oldest dropped)."""
+    keep = keep_files()
+    try:
+        os.unlink(f"{path}.{keep}")
+    except OSError:
+        pass
+    for i in range(keep - 1, 0, -1):
+        try:
+            os.replace(f"{path}.{i}", f"{path}.{i + 1}")
+        except OSError:
+            continue  # that generation doesn't exist yet
+    try:
+        os.replace(path, f"{path}.1")
+    except OSError:
+        return  # nothing to rotate (vanished concurrently)
+    _ROTATIONS.inc()
+
+
+def append(path: str, text: str, max_mb_env: Optional[str] = None) -> None:
+    """Append `text` to `path`, rotating first when the configured cap
+    (`max_mb_env`, e.g. ``HYPERSPACE_TRACE_MAX_MB``) would be crossed.
+    A single blob larger than the whole cap still writes (into a fresh
+    file) — rotation bounds growth, it never drops telemetry."""
+    cap = _max_bytes(max_mb_env) if max_mb_env else 0
+    if cap > 0:
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            size = 0
+        if size > 0 and size + len(text) > cap:
+            rotate(path)
+    with open(path, "a") as f:
+        f.write(text)
+        f.flush()

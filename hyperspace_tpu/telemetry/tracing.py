@@ -477,8 +477,14 @@ def _finalize(trace: QueryTrace) -> None:
                 # than an unparseable null duration.
                 s.end(status="unclosed")
             lines.append(json.dumps(s.to_json(), default=str))
+        from . import rotation as _rotation
+
         with _export_lock:
-            with open(path, "a") as f:
-                f.write("\n".join(lines) + "\n")
+            # Size-capped rotation (HYPERSPACE_TRACE_MAX_MB; off by
+            # default): one whole trace per append, so rotated files each
+            # stay independently parseable.
+            _rotation.append(
+                path, "\n".join(lines) + "\n", _rotation.ENV_TRACE_MAX_MB
+            )
     except Exception:
         pass

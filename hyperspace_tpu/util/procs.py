@@ -1,0 +1,31 @@
+"""Process-liveness probe shared by every dead-writer reclaim path.
+
+`index/staging.py` (staging-dir orphans) and `telemetry/history.py`
+(history-segment compaction) both key same-host reclamation on "is the
+writer's pid alive" — one implementation, so a future refinement (EPERM
+classification on hardened kernels, pid-reuse guards) cannot diverge
+between the two. Lives in `util/` because both layers may import it
+(`index` already imports `telemetry`; the reverse edge must not exist).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def pid_alive(pid: int) -> bool:
+    """Whether `pid` refers to a live process ON THIS HOST. Errs on the
+    side of "alive": anything other than a definitive ProcessLookupError
+    means the owner might still be running, and a reclaim path must never
+    delete what might be live."""
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True  # exists, owned by someone else
+    except OSError:
+        return True  # unknown: never reclaim what might be live

@@ -1,0 +1,921 @@
+"""Workload history observatory: plan fingerprints, the on-lake history
+store, SLO monitoring, sink rotation, and the reporting tools.
+
+Pins the PR-11 contracts:
+- plan fingerprints are CLASS identities: stable across identical queries
+  and literal values, different across predicate structure, ambient flag
+  posture, and index generations (log_entry_id);
+- the store is OCC-consistent under concurrent writers (per-process
+  segments), survives restart (baselines re-fold from disk), bounds
+  segments (rotation), compacts dead writers' segments into checkpoint
+  records (claim-by-rename), and tolerates torn lines after SIGKILL;
+- anomalies are flagged at ledger close (Nσ over the class baseline) into
+  the counter, the root span, and the exporter stream — warn-once;
+- ``HYPERSPACE_HISTORY`` unset = zero cost: no fingerprinting, no files;
+- the trace/metrics JSONL sinks rotate at their size caps and the final
+  exporter frame still lands;
+- the serving SLO monitor computes compliance and burn rates per lane,
+  and `tools/hsreport.py` / `tools/bench_compare.py --history` read the
+  same store the engine writes.
+"""
+
+import glob
+import importlib.util
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from hyperspace_tpu.engine import HyperspaceSession, col
+from hyperspace_tpu.plananalysis import fingerprint as fngr
+from hyperspace_tpu.telemetry import (
+    accounting,
+    exporter,
+    history,
+    metrics,
+    slo,
+    tracing,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def session(tmp_path):
+    return HyperspaceSession(warehouse=str(tmp_path))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_history_state():
+    yield
+    history.reset_stores()
+    slo.reset()
+
+
+def _write_table(session, path, n=200):
+    session.write_parquet(
+        {
+            "k": list(range(n)),
+            "grp": [i % 5 for i in range(n)],
+            "v": [float(i) for i in range(n)],
+        },
+        path,
+    )
+
+
+def _ledger(name="query:collect", wall=0.02, qid=None, lane=None, **fields):
+    d = {
+        "query_id": qid or os.urandom(4).hex(),
+        "name": name,
+        "wall_s": wall,
+        "rows_produced": 10,
+    }
+    if lane is not None:
+        d["lane"] = lane
+    d.update(fields)
+    return d
+
+
+def _tool(name):
+    path = os.path.join(REPO, "tools", f"{name}.py")
+    if not os.path.exists(path):
+        pytest.skip(f"tools/{name}.py not present (installed-wheel run)")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# Plan fingerprints
+# ---------------------------------------------------------------------------
+
+
+class TestFingerprint:
+    def test_same_query_and_literal_rotation_share_a_class(self, session, tmp_path):
+        t = os.path.join(str(tmp_path), "t")
+        _write_table(session, t)
+        df = session.read.parquet(t)
+        fp1 = fngr.plan_fingerprint(df.filter(col("k") == 3).physical_plan())
+        fp2 = fngr.plan_fingerprint(df.filter(col("k") == 3).physical_plan())
+        fp3 = fngr.plan_fingerprint(df.filter(col("k") == 77).physical_plan())
+        assert fp1 == fp2 == fp3  # literal VALUES are abstracted: one class
+        # ... but a different literal TYPE or column is a different class.
+        fp4 = fngr.plan_fingerprint(df.filter(col("v") == 3.0).physical_plan())
+        assert fp4 != fp1
+        fp5 = fngr.plan_fingerprint(
+            df.group_by("grp").agg(n=("v", "count")).physical_plan()
+        )
+        assert fp5 != fp1
+
+    def test_flag_posture_changes_fingerprint(self, session, tmp_path, monkeypatch):
+        t = os.path.join(str(tmp_path), "t")
+        _write_table(session, t)
+        q = session.read.parquet(t).filter(col("k") == 3)
+        fp_default = fngr.plan_fingerprint(q.physical_plan())
+        monkeypatch.setenv("HYPERSPACE_QUERY_STREAMING", "0")
+        assert fngr.plan_fingerprint(q.physical_plan()) != fp_default
+
+    def test_index_generation_changes_fingerprint(self, session, tmp_path):
+        from hyperspace_tpu import IndexConfig, IndexConstants
+        from hyperspace_tpu.hyperspace import Hyperspace, enable_hyperspace
+
+        session.conf.set(
+            IndexConstants.INDEX_SYSTEM_PATH, os.path.join(str(tmp_path), "idx")
+        )
+        session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
+        t = os.path.join(str(tmp_path), "t")
+        _write_table(session, t)
+        hs = Hyperspace(session)
+        hs.create_index(
+            session.read.parquet(t), IndexConfig("fpIdx", ["k"], ["v"])
+        )
+        enable_hyperspace(session)
+        q = session.read.parquet(t).filter(col("k") == 3).select("v")
+        fp1 = fngr.plan_fingerprint(q.physical_plan())
+        assert "fpIdx" in q.explain_string()  # the rewrite actually applied
+        hs.refresh_index("fpIdx")
+        fp2 = fngr.plan_fingerprint(q.physical_plan())
+        assert fp1 != fp2  # log_entry_id advanced: a new cost class
+
+    def test_fingerprint_rides_ledger_and_root_span(
+        self, session, tmp_path, monkeypatch
+    ):
+        monkeypatch.setenv(accounting.ENV_ACCOUNTING, "1")
+        t = os.path.join(str(tmp_path), "t")
+        _write_table(session, t)
+        df = session.read.parquet(t).filter(col("k") == 3)
+        with tracing.capture() as cap:
+            df.collect()
+        led = accounting.recent_ledgers()[-1].to_dict()
+        expected = fngr.plan_fingerprint(df.physical_plan())
+        assert led["plan_fingerprint"] == expected
+        assert cap.trace.root.attrs["plan_fingerprint"] == expected
+
+
+# ---------------------------------------------------------------------------
+# The store
+# ---------------------------------------------------------------------------
+
+
+class TestHistoryStore:
+    def test_query_ledgers_land_in_segments(self, session, tmp_path, monkeypatch):
+        hd = os.path.join(str(tmp_path), "hist")
+        monkeypatch.setenv(history.ENV_HISTORY, "1")
+        monkeypatch.setenv(history.ENV_HISTORY_DIR, hd)
+        t = os.path.join(str(tmp_path), "t")
+        _write_table(session, t)
+        df = session.read.parquet(t)
+        for _ in range(3):
+            df.filter(col("k") == 3).collect()
+        segs = glob.glob(os.path.join(hd, "seg-*.jsonl"))
+        assert len(segs) == 1  # one writer process = one owned segment
+        recs = [json.loads(line) for line in open(segs[0])]
+        assert len(recs) == 3
+        fps = {r["fingerprint"] for r in recs}
+        assert len(fps) == 1  # one plan class
+        for r in recs:
+            assert r["schema_version"] == history.SCHEMA_VERSION
+            assert r["kind"] == "ledger"
+            assert r["ledger"]["wall_s"] > 0
+            assert r["ledger"]["plan_fingerprint"] == r["fingerprint"]
+
+    def test_baselines_survive_restart(self, tmp_path, monkeypatch):
+        hd = os.path.join(str(tmp_path), "hist")
+        st = history.HistoryStore(hd)
+        for i in range(10):
+            st.record("fp-a", _ledger(wall=0.01 + i * 0.001))
+        st.close()
+        history.reset_stores()
+        st2 = history.HistoryStore(hd)  # a fresh process would do exactly this
+        bl = st2.baseline_for("fp-a")
+        assert bl is not None and bl.count == 10
+        assert st2.baselines()["fp-a"]["n"] == 10
+
+    def test_segment_rotation_bounds_size(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(history.ENV_SEGMENT_MB, "0.000001")  # floor: 4096 B
+        hd = os.path.join(str(tmp_path), "hist")
+        st = history.HistoryStore(hd)
+        for i in range(60):  # ~150 B/record: must cross the 4 KiB floor
+            st.record("fp-a", _ledger(wall=0.01, qid=f"q{i}"))
+        segs = glob.glob(os.path.join(hd, "seg-*.jsonl"))
+        assert len(segs) >= 2
+        assert all(os.path.getsize(p) < 3 * 4096 for p in segs)
+        recs = list(history.iter_records(hd))
+        assert len(recs) == 60  # rotation loses nothing
+        assert metrics.counter("history.segments_rotated").value >= 1
+
+    def test_compaction_folds_dead_writer_segments(self, tmp_path):
+        hd = os.path.join(str(tmp_path), "hist")
+        os.makedirs(hd)
+        # A segment from a PROVABLY DEAD same-host writer (pid 2^22+9999 is
+        # beyond pid_max on any default Linux) — the reclaim_orphans rule.
+        dead = os.path.join(hd, "seg-localhost-4199303-deadbeef.jsonl")
+        with open(dead, "w") as f:
+            for i in range(12):
+                rec = {
+                    "schema_version": 1,
+                    "kind": "ledger",
+                    "ts": time.time(),
+                    "fingerprint": "fp-dead",
+                    "ledger": _ledger(wall=0.02, qid=f"d{i}"),
+                }
+                f.write(json.dumps(rec) + "\n")
+        before = history.fold_baselines(history.iter_records(hd))
+        # Same-host liveness keys on THIS host's name, not "localhost":
+        # rename the owner to the real hostname so the pid rule applies.
+        import socket
+
+        owned = os.path.join(hd, f"seg-{socket.gethostname()}-4199303-deadbeef.jsonl")
+        os.rename(dead, owned)
+        st = history.HistoryStore(hd)
+        folded = st.compact()
+        assert folded >= 0  # may already have compacted at open
+        assert not glob.glob(os.path.join(hd, "seg-*.jsonl"))
+        compacts = glob.glob(os.path.join(hd, "compact-*.jsonl"))
+        assert compacts
+        after = history.fold_baselines(history.iter_records(hd))
+        assert after["fp-dead"].count == before["fp-dead"].count == 12
+        a, b = after["fp-dead"].summary(), before["fp-dead"].summary()
+        assert a["wall_p50_s"] == b["wall_p50_s"]
+        assert a["wall_total_s"] == pytest.approx(b["wall_total_s"], rel=1e-6)
+
+    def test_live_writer_segments_never_claimed_even_past_ttl(
+        self, tmp_path, monkeypatch
+    ):
+        import socket
+
+        monkeypatch.setenv(history.ENV_TTL_S, "1")  # aggressive TTL
+        hd = os.path.join(str(tmp_path), "hist")
+        os.makedirs(hd)
+        # A segment owned by a LIVE same-host writer (this very process),
+        # aged far past the TTL: liveness must win — claiming it would lose
+        # every record the writer flushes after the rename.
+        live = os.path.join(
+            hd, f"seg-{socket.gethostname()}-{os.getpid()}-aaaaaaaa.jsonl"
+        )
+        with open(live, "w") as f:
+            f.write(
+                json.dumps(
+                    {
+                        "schema_version": 1,
+                        "kind": "ledger",
+                        "fingerprint": "fp-live",
+                        "ledger": _ledger(),
+                    }
+                )
+                + "\n"
+            )
+        old = time.time() - 3600
+        os.utime(live, (old, old))
+        st = history.HistoryStore(hd)
+        assert st.compact() == 0
+        assert os.path.exists(live)
+
+    def test_failed_compact_commit_releases_claims(self, tmp_path, monkeypatch):
+        import socket
+
+        hd = os.path.join(str(tmp_path), "hist")
+        os.makedirs(hd)
+        dead = os.path.join(
+            hd, f"seg-{socket.gethostname()}-4199303-deadbeef.jsonl"
+        )
+        with open(dead, "w") as f:
+            f.write(
+                json.dumps(
+                    {
+                        "schema_version": 1,
+                        "kind": "ledger",
+                        "fingerprint": "fp-d",
+                        "ledger": _ledger(),
+                    }
+                )
+                + "\n"
+            )
+        st = history.HistoryStore(hd, load=False, compact_on_open=False)
+
+        def boom(src, dst):
+            raise OSError("disk full")
+
+        monkeypatch.setattr(history.os, "replace", boom)
+        assert st.compact() == 0
+        monkeypatch.undo()
+        # The claim was RELEASED back to its original name: still visible to
+        # readers and still compactable (a live-pid claim would hide it).
+        assert os.path.exists(dead)
+        assert len(list(history.iter_records(hd))) == 1
+        assert st.compact() == 1  # and the retry succeeds
+
+    def test_compactor_crash_after_commit_never_double_counts(self, tmp_path):
+        import shutil
+        import socket
+
+        hd = os.path.join(str(tmp_path), "hist")
+        os.makedirs(hd)
+        seg_name = f"seg-{socket.gethostname()}-4199303-deadbeef.jsonl"
+        seg = os.path.join(hd, seg_name)
+        with open(seg, "w") as f:
+            for i in range(10):
+                f.write(
+                    json.dumps(
+                        {
+                            "schema_version": 1,
+                            "kind": "ledger",
+                            "fingerprint": "fp-m",
+                            "ledger": _ledger(wall=0.02, qid=f"m{i}"),
+                        }
+                    )
+                    + "\n"
+                )
+        backup = os.path.join(str(tmp_path), "backup.jsonl")
+        shutil.copy(seg, backup)
+        st = history.HistoryStore(hd, load=False, compact_on_open=False)
+        assert st.compact() == 1
+        assert history.fold_baselines(history.iter_records(hd))["fp-m"].count == 10
+        # Simulate the compactor dying AFTER the checkpoint commit but
+        # BEFORE the claim unlink: the orphaned claim reappears with a dead
+        # claimant pid — its root is in the committed manifest, so readers
+        # must skip it (no double count) and compaction must GC it.
+        stale_claim = os.path.join(
+            hd, f"{history.CLAIMED_PREFIX}{socket.gethostname()}~4199303~{seg_name}"
+        )
+        shutil.copy(backup, stale_claim)
+        assert history.fold_baselines(history.iter_records(hd))["fp-m"].count == 10
+        assert st.compact() >= 1  # the garbage claim is collected
+        assert not os.path.exists(stale_claim)
+        assert history.fold_baselines(history.iter_records(hd))["fp-m"].count == 10
+
+    def test_foreign_host_claims_use_ttl_not_local_pids(self, tmp_path, monkeypatch):
+        hd = os.path.join(str(tmp_path), "hist")
+        os.makedirs(hd)
+        # Claimant pid 1 is ALIVE on this host — but the claim came from
+        # another host, where that pid number means nothing. Fresh: treated
+        # as live (invisible, not compactable). TTL-aged: orphaned.
+        claim = os.path.join(
+            hd, f"{history.CLAIMED_PREFIX}otherhost~1~seg-otherhost-1-x.jsonl"
+        )
+        with open(claim, "w") as f:
+            f.write(
+                json.dumps(
+                    {
+                        "schema_version": 1,
+                        "kind": "ledger",
+                        "fingerprint": "fp-f",
+                        "ledger": _ledger(),
+                    }
+                )
+                + "\n"
+            )
+        assert history.fold_baselines(history.iter_records(hd)) == {}
+        monkeypatch.setenv(history.ENV_TTL_S, "1")
+        old = time.time() - 3600
+        os.utime(claim, (old, old))
+        assert history.fold_baselines(history.iter_records(hd))["fp-f"].count == 1
+        st = history.HistoryStore(hd, load=False, compact_on_open=False)
+        assert st.compact() == 1
+
+    def test_merge_state_malformed_fields_no_raise_no_partial_merge(self):
+        h = metrics.Histogram("fc")
+        h.observe(0.01)
+        snap = h.summary()
+        h.merge_state({"count": 1, "total": 0.1, "min": "oops", "max": 2})
+        # min/max garbage is dropped, numerics still fold:
+        assert h.count == 2 and h.total == pytest.approx(0.11)
+        h2 = metrics.Histogram("fc2")
+        h2.observe(0.01)
+        h2.merge_state({"count": 5, "total": "bad", "buckets": {"3": 5}})
+        assert h2.summary() == snap  # nothing half-merged
+        h2.merge_state("not-a-dict")
+        assert h2.summary() == snap
+        # A checkpoint with buckets but NO extrema (forward-compat allows
+        # it) must still quantile/summarize without raising.
+        h3 = metrics.Histogram("fc3")
+        h3.merge_state({"count": 5, "total": 1.0, "buckets": {"10": 5}})
+        assert h3.quantile(0.5) is not None
+        assert h3.summary()["p99"] is not None
+
+    def test_torn_trailing_line_is_skipped(self, tmp_path):
+        hd = os.path.join(str(tmp_path), "hist")
+        os.makedirs(hd)
+        seg = os.path.join(hd, "seg-h-1-x.jsonl")
+        good = {
+            "schema_version": 1,
+            "kind": "ledger",
+            "fingerprint": "fp",
+            "ledger": _ledger(),
+        }
+        with open(seg, "w") as f:
+            f.write(json.dumps(good) + "\n")
+            f.write(json.dumps(good) + "\n")
+            f.write('{"schema_version": 1, "kind": "led')  # SIGKILL mid-append
+        torn0 = metrics.counter("history.torn_lines").value
+        recs = list(history.iter_file_records(seg))
+        assert len(recs) == 2
+        # Plain reader passes never tick the counter (re-reads of one old
+        # tear must not look like fresh corruption to a monitor)...
+        assert metrics.counter("history.torn_lines").value == torn0
+        # ... the store's own load pass counts it exactly once.
+        history.HistoryStore(hd, compact_on_open=False).close()
+        assert metrics.counter("history.torn_lines").value == torn0 + 1
+
+    def test_forward_compat_unknown_keys_and_kinds(self, tmp_path):
+        hd = os.path.join(str(tmp_path), "hist")
+        os.makedirs(hd)
+        seg = os.path.join(hd, "seg-h-1-x.jsonl")
+        with open(seg, "w") as f:
+            # A record from a FUTURE writer: newer version, unknown kind,
+            # extra keys everywhere — must parse, fold what's known, skip
+            # the rest (never raise).
+            f.write(
+                json.dumps(
+                    {
+                        "schema_version": 99,
+                        "kind": "hologram",
+                        "fingerprint": "fp-x",
+                        "novel_key": {"deep": [1, 2]},
+                    }
+                )
+                + "\n"
+            )
+            f.write(
+                json.dumps(
+                    {
+                        "schema_version": 99,
+                        "kind": "ledger",
+                        "fingerprint": "fp-x",
+                        "future_field": True,
+                        "ledger": dict(_ledger(wall=0.05), exotic=123),
+                    }
+                )
+                + "\n"
+            )
+        folded = history.fold_baselines(history.iter_records(hd))
+        assert folded["fp-x"].count == 1  # the ledger folded, the hologram didn't
+        assert folded["fp-x"].summary()["wall_total_s"] == pytest.approx(0.05)
+
+
+# ---------------------------------------------------------------------------
+# Anomaly flagging
+# ---------------------------------------------------------------------------
+
+
+class TestAnomalies:
+    def test_nsigma_flagging_counter_and_warn_once(self, tmp_path):
+        hd = os.path.join(str(tmp_path), "hist")
+        st = history.HistoryStore(hd)
+        for i in range(10):
+            assert st.record("fp-a", _ledger(wall=0.02 + 0.001 * (i % 3))) is None
+        n0 = metrics.counter("history.anomalies").value
+        with pytest.warns(RuntimeWarning, match="over its baseline"):
+            verdict = st.record("fp-a", _ledger(wall=1.0))
+        assert verdict is not None
+        assert verdict["wall_s"] == 1.0
+        assert verdict["baseline_n"] == 10
+        assert metrics.counter("history.anomalies").value == n0 + 1
+        # Second anomaly in the same class: counted, but silent.
+        import warnings as _warnings
+
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("error")
+            assert st.record("fp-a", _ledger(wall=1.2)) is not None
+        assert metrics.counter("history.anomalies").value == n0 + 2
+
+    def test_young_or_fast_classes_never_flag(self, tmp_path):
+        st = history.HistoryStore(os.path.join(str(tmp_path), "hist"))
+        for i in range(history.ANOMALY_MIN_SAMPLES - 1):
+            st.record("fp-y", _ledger(wall=0.02))
+        # Baseline still too young:
+        assert st.record("fp-y", _ledger(wall=5.0)) is None
+        # Sub-floor walls never flag however extreme the ratio:
+        for i in range(20):
+            st.record("fp-z", _ledger(wall=0.0001))
+        assert st.record("fp-z", _ledger(wall=0.004)) is None
+
+    def test_anomaly_rides_root_attr_and_exporter_frame(
+        self, tmp_path, monkeypatch
+    ):
+        hd = os.path.join(str(tmp_path), "hist")
+        monkeypatch.setenv(history.ENV_HISTORY, "1")
+        monkeypatch.setenv(history.ENV_HISTORY_DIR, hd)
+
+        class FakeRoot:
+            attrs: dict = {}
+
+            def set_attr(self, k, v):
+                self.attrs[k] = v
+
+        root = FakeRoot()
+        for i in range(10):
+            history.land(_ledger(wall=0.02, plan_fingerprint="fp-e"), root)
+        assert "history_anomaly" not in root.attrs
+        with pytest.warns(RuntimeWarning):
+            history.land(_ledger(wall=2.0, plan_fingerprint="fp-e"), root)
+        assert root.attrs["history_anomaly"]["fingerprint"] == "fp-e"
+        frame = history.frame_summary()
+        assert frame["records_written"] == 11
+        assert frame["anomalies_total"] >= 1
+        assert any(a["fingerprint"] == "fp-e" for a in frame["anomalies"])
+        # Drained: the next frame carries no stale anomalies.
+        assert "anomalies" not in (history.frame_summary() or {})
+
+
+# ---------------------------------------------------------------------------
+# Concurrency + crash safety
+# ---------------------------------------------------------------------------
+
+
+_WRITER_CHILD = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+os.environ["HYPERSPACE_HISTORY"] = "1"
+os.environ["HYPERSPACE_HISTORY_DIR"] = {hd!r}
+from hyperspace_tpu.telemetry import history
+st = history.get_store()
+n = int(sys.argv[1]) if len(sys.argv) > 1 else 50
+i = 0
+while n < 0 or i < n:   # n<0 = run until killed (the SIGKILL harness)
+    st.record("fp-proc", {{"query_id": f"{{os.getpid()}}-{{i}}",
+                           "name": "query:collect", "wall_s": 0.02,
+                           "rows_produced": 1}})
+    i += 1
+print("WROTE", i, flush=True)
+"""
+
+
+class TestConcurrentAppends:
+    def test_threads_lose_nothing(self, tmp_path):
+        st = history.HistoryStore(os.path.join(str(tmp_path), "hist"))
+        n_threads, per = 8, 50
+
+        def worker(ti):
+            for i in range(per):
+                st.record("fp-t", _ledger(qid=f"t{ti}-{i}"))
+
+        threads = [threading.Thread(target=worker, args=(ti,)) for ti in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert st.records_written == n_threads * per
+        recs = [r for r in history.iter_records(st.dir) if r.get("kind") == "ledger"]
+        qids = [r["ledger"]["query_id"] for r in recs]
+        assert len(qids) == n_threads * per
+        assert len(set(qids)) == n_threads * per  # no lost, no duplicated
+
+    def test_two_processes_one_dir_occ_consistent(self, tmp_path):
+        hd = os.path.join(str(tmp_path), "hist")
+        script = _WRITER_CHILD.format(repo=REPO, hd=hd)
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c", script, "40"],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+            )
+            for _ in range(2)
+        ]
+        st = history.get_store(hd)  # this process writes concurrently too
+        for i in range(40):
+            st.record("fp-proc", _ledger(qid=f"parent-{i}"))
+        for p in procs:
+            out, err = p.communicate(timeout=120)
+            assert p.returncode == 0, err.decode()
+            assert b"WROTE 40" in out
+        recs = [r for r in history.iter_records(hd) if r.get("kind") == "ledger"]
+        qids = [r["ledger"]["query_id"] for r in recs]
+        assert len(qids) == 120 and len(set(qids)) == 120
+        # Three writers → three distinct owned segments (plus rotations).
+        owners = {
+            os.path.basename(p).rsplit("-", 2)[1]
+            for p in glob.glob(os.path.join(hd, "seg-*.jsonl"))
+        }
+        assert len(owners) == 3
+
+    def test_sigkill_mid_append_keeps_segments_parseable(self, tmp_path):
+        hd = os.path.join(str(tmp_path), "hist")
+        script = _WRITER_CHILD.format(repo=REPO, hd=hd)
+        proc = subprocess.Popen(
+            [sys.executable, "-c", script, "-1"],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+        )
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            segs = glob.glob(os.path.join(hd, "seg-*.jsonl"))
+            if segs and sum(os.path.getsize(p) for p in segs) > 20000:
+                break
+            time.sleep(0.05)
+        os.kill(proc.pid, signal.SIGKILL)  # mid-append, no cleanup
+        proc.wait()
+        recs = [r for r in history.iter_records(hd) if r.get("kind") == "ledger"]
+        assert len(recs) > 50  # the committed prefix survived
+        # The dead writer's segment is compactable by the next store.
+        st = history.HistoryStore(hd)
+        assert not glob.glob(os.path.join(hd, "seg-*.jsonl"))
+        after = history.fold_baselines(history.iter_records(hd))
+        assert after["fp-proc"].count == len(recs)
+        st.close()
+
+
+# ---------------------------------------------------------------------------
+# Zero cost when off
+# ---------------------------------------------------------------------------
+
+
+class TestZeroCostOff:
+    def test_no_fingerprint_no_files_when_everything_off(
+        self, session, tmp_path, monkeypatch
+    ):
+        for k in (
+            history.ENV_HISTORY,
+            history.ENV_HISTORY_DIR,
+            accounting.ENV_ACCOUNTING,
+            tracing.ENV_TRACE_FILE,
+            tracing.ENV_TRACING,
+        ):
+            monkeypatch.delenv(k, raising=False)
+
+        def boom(*a, **kw):  # the zero-cost contract: never even computed
+            raise AssertionError("plan_fingerprint computed with sinks off")
+
+        monkeypatch.setattr(fngr, "plan_fingerprint", boom)
+        t = os.path.join(str(tmp_path), "t")
+        _write_table(session, t)
+        before = len(accounting.recent_ledgers())
+        out = session.read.parquet(t).filter(col("k") == 3).collect()
+        assert out.num_rows == 1
+        assert len(accounting.recent_ledgers()) == before
+        assert not os.path.exists(os.path.join(str(tmp_path), ".hyperspace_history"))
+
+    def test_history_flag_alone_enables_ledgers(self, session, tmp_path, monkeypatch):
+        hd = os.path.join(str(tmp_path), "hist")
+        monkeypatch.setenv(history.ENV_HISTORY, "1")
+        monkeypatch.setenv(history.ENV_HISTORY_DIR, hd)
+        t = os.path.join(str(tmp_path), "t")
+        _write_table(session, t)
+        # The history deque is bounded (32): compare identities, not length.
+        before_ids = {led.query_id for led in accounting.recent_ledgers()}
+        session.read.parquet(t).filter(col("k") == 3).collect()
+        newest = accounting.recent_ledgers()[-1]
+        assert newest.query_id not in before_ids  # a fresh ledger opened
+        assert glob.glob(os.path.join(hd, "seg-*.jsonl"))
+
+
+# ---------------------------------------------------------------------------
+# Sink rotation (trace + metrics JSONL)
+# ---------------------------------------------------------------------------
+
+
+class TestSinkRotation:
+    def test_trace_file_rotates_and_every_file_parses(
+        self, session, tmp_path, monkeypatch
+    ):
+        path = os.path.join(str(tmp_path), "trace.jsonl")
+        monkeypatch.setenv(tracing.ENV_TRACE_FILE, path)
+        monkeypatch.setenv("HYPERSPACE_TRACE_MAX_MB", "0.002")  # 2 kB
+        t = os.path.join(str(tmp_path), "t")
+        _write_table(session, t)
+        r0 = metrics.counter("telemetry.sink.rotations").value
+        df = session.read.parquet(t)
+        for _ in range(6):
+            df.filter(col("k") == 3).collect()
+        assert metrics.counter("telemetry.sink.rotations").value > r0
+        rotated = sorted(glob.glob(path + ".*"))
+        assert rotated  # at least one rotated generation
+        for p in [path] + rotated:
+            spans = [json.loads(line) for line in open(p)]
+            assert spans and all("query_id" in s for s in spans)
+
+    def test_metrics_rotation_keeps_final_frame(self, tmp_path, monkeypatch):
+        path = os.path.join(str(tmp_path), "m.jsonl")
+        monkeypatch.setenv("HYPERSPACE_METRICS_MAX_MB", "0.01")  # 10 kB
+        monkeypatch.setenv("HYPERSPACE_SINK_KEEP", "2")
+        ex = exporter.MetricsExporter(path, interval_s=0.01).start()
+        try:
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline and not glob.glob(path + ".*"):
+                time.sleep(0.02)
+        finally:
+            ex.stop()
+        assert glob.glob(path + ".*"), "no rotation happened"
+        frames = [json.loads(line) for line in open(path)]
+        assert frames[-1]["final"] is True  # the last line survived rotation
+        assert frames[-1]["schema_version"] == exporter.SCHEMA_VERSION
+        # keep-N honored: generations beyond .2 never exist.
+        assert not os.path.exists(path + ".3")
+
+
+# ---------------------------------------------------------------------------
+# SLO monitor + lane visibility
+# ---------------------------------------------------------------------------
+
+
+class TestSLO:
+    def test_compliance_and_burn(self, monkeypatch):
+        monkeypatch.setenv(slo.ENV_INTERACTIVE_P99_MS, "100")
+        slo.reset()
+        for _ in range(8):
+            slo.observe("interactive", 0.05, tenant="a")
+        for _ in range(2):
+            slo.observe("interactive", 0.5, tenant="a")
+        s = slo.summary()["interactive"]
+        assert s["total"] == 10 and s["violations"] == 2
+        assert s["compliance"] == pytest.approx(0.8)
+        # 20% error rate against a 1% budget = burn 20x over the window.
+        assert s["burn_5m"] == pytest.approx(20.0, rel=0.01)
+        assert s["tenants"]["a"]["violations"] == 2
+        text = exporter.prometheus_text()
+        assert 'hyperspace_slo_compliance{lane="interactive"}' in text
+        assert 'hyperspace_slo_burn_5m{lane="interactive"}' in text
+
+    def test_fast_burn_warns_once(self, monkeypatch):
+        monkeypatch.setenv(slo.ENV_BATCH_P99_MS, "1")
+        slo.reset()
+        n0 = metrics.counter("slo.fast_burn_alerts").value
+        with pytest.warns(RuntimeWarning, match="fast-burning"):
+            for _ in range(slo.FAST_BURN_MIN_EVENTS + 5):
+                slo.observe("batch", 0.5)
+        assert metrics.counter("slo.fast_burn_alerts").value > n0
+        import warnings as _warnings
+
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("error")
+            slo.observe("batch", 0.5)  # warned once; further alerts silent
+
+    def test_served_queries_feed_lane_metrics_and_ledger_lane(
+        self, session, tmp_path, monkeypatch
+    ):
+        from hyperspace_tpu.serve import QueryServer
+
+        monkeypatch.setenv(accounting.ENV_ACCOUNTING, "1")
+        slo.reset()
+        t = os.path.join(str(tmp_path), "t")
+        _write_table(session, t)
+        df = session.read.parquet(t)
+        h0 = metrics.histogram("latency.serve.interactive").count
+        b0 = metrics.histogram("latency.serve.batch").count
+        with QueryServer(max_concurrent=2) as srv:
+            srv.run(lambda: df.filter(col("k") == 3).collect(), lane="interactive")
+            srv.run(lambda: df.group_by("grp").agg(n=("v", "count")).collect())
+        assert metrics.histogram("latency.serve.interactive").count == h0 + 1
+        assert metrics.histogram("latency.serve.batch").count == b0 + 1
+        assert metrics.gauge("serve.inflight.interactive").value == 0
+        assert metrics.gauge("serve.inflight.batch").value == 0
+        lanes = {led.lane for led in accounting.recent_ledgers()[-2:]}
+        assert lanes == {"interactive", "batch"}
+        assert set(slo.summary()) >= {"interactive", "batch"}
+
+    def test_failed_queries_burn_the_error_budget(self, session, monkeypatch):
+        from hyperspace_tpu.serve import QueryServer
+
+        slo.reset()
+        with QueryServer(max_concurrent=2) as srv:
+            fut = srv.submit(
+                lambda: (_ for _ in ()).throw(RuntimeError("outage")),
+                lane="interactive",
+            )
+            with pytest.raises(RuntimeError):
+                fut.result(30)
+        s = slo.summary()["interactive"]
+        # A 1 ms failure is NOT compliance — the budget burns on errors too.
+        assert s["total"] == 1 and s["violations"] == 1
+
+    def test_failure_status_lands_on_ledger_and_offline_compliance(self):
+        with pytest.raises(RuntimeError):
+            with accounting.ledger_scope("q-fail", "query:collect"):
+                raise RuntimeError("boom")
+        led = accounting.recent_ledgers()[-1]
+        assert led.get("status") == "error"
+        # The offline view judges recorded failures like the live monitor:
+        # a 1 ms errored query violates regardless of the objective.
+        c = slo.compliance_over(
+            [
+                {"lane": "interactive", "wall_s": 0.001, "status": "error"},
+                {"lane": "interactive", "wall_s": 0.001},
+            ]
+        )
+        assert c["interactive"]["total"] == 2
+        assert c["interactive"]["violations"] == 1
+
+    def test_serial_fallback_observes_slo_too(self, session, tmp_path, monkeypatch):
+        from hyperspace_tpu.serve import QueryServer
+
+        monkeypatch.setenv("HYPERSPACE_SERVING", "0")
+        slo.reset()
+        t = os.path.join(str(tmp_path), "t")
+        _write_table(session, t)
+        df = session.read.parquet(t)
+        with QueryServer() as srv:
+            srv.run(lambda: df.filter(col("k") == 1).collect(), lane="interactive")
+        s = slo.summary()
+        assert s["interactive"]["total"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Tools: hsreport + bench_compare --history
+# ---------------------------------------------------------------------------
+
+
+def _seed_store(hd, fast_n=10, slow_n=0, lane=None, fp="fp-tool", wall=0.02):
+    st = history.HistoryStore(hd, compact_on_open=False)
+    for i in range(fast_n):
+        st.record(
+            fp,
+            _ledger(wall=wall, qid=f"f{i}", lane=lane, bytes_decoded=1000,
+                    xla_compiles=2, io_retries=1),
+        )
+    for i in range(slow_n):
+        st.record(fp, _ledger(wall=wall * 10, qid=f"s{i}", lane=lane))
+    st.close()
+    history.reset_stores()
+    return hd
+
+
+class TestTools:
+    def test_hsreport_renders_all_sections(self, tmp_path, capsys):
+        hs = _tool("hsreport")
+        hd = os.path.join(str(tmp_path), "hist")
+        _seed_store(hd, fast_n=12, lane="interactive")
+        assert hs.main([hd]) == 0
+        out = capsys.readouterr().out
+        assert "top plan classes by total cost" in out
+        assert "SLO compliance" in out
+        assert "compile-storm hotspots" in out
+        assert "io-retry hotspots" in out
+        assert "fp-tool" in out
+
+    def test_hsreport_json_and_compare_gate(self, tmp_path, capsys):
+        hs = _tool("hsreport")
+        a = _seed_store(os.path.join(str(tmp_path), "a"), fast_n=12, wall=0.05)
+        b = _seed_store(os.path.join(str(tmp_path), "b"), fast_n=12, wall=0.25)
+        assert hs.main([a, "--json"]) == 0
+        report = json.loads(capsys.readouterr().out)
+        assert report["fingerprints"] == 1
+        assert report["top_classes"][0]["n"] == 12
+        # b is 5x slower on the same class: the compare gate must fail...
+        assert hs.main([a, "--compare", b]) == 1
+        capsys.readouterr()
+        # ... and the improving direction passes.
+        assert hs.main([b, "--compare", a]) == 0
+        capsys.readouterr()
+        # A store that merely recorded MORE traffic at the same latency is
+        # not a regression (cumulative wall_total_s must not gate).
+        c = _seed_store(os.path.join(str(tmp_path), "c"), fast_n=40, wall=0.05)
+        assert hs.main([a, "--compare", c]) == 0
+
+    def test_bench_compare_history_gate(self, tmp_path, capsys):
+        with pytest.warns(RuntimeWarning):
+            # The slow recent window itself trips the anomaly warn-once.
+            regressed = _seed_store(
+                os.path.join(str(tmp_path), "reg"), fast_n=12, slow_n=5, wall=0.05
+            )
+        healthy = _seed_store(
+            os.path.join(str(tmp_path), "ok"), fast_n=17, wall=0.05
+        )
+        bc = _tool("bench_compare")
+        assert bc.main(["--history", regressed]) == 1
+        assert "REGRESSION" in capsys.readouterr().out
+        assert bc.main(["--history", healthy]) == 0
+        # Static pair + history compose (the "in addition to" contract).
+        a = os.path.join(str(tmp_path), "a.json")
+        b = os.path.join(str(tmp_path), "b.json")
+        json.dump({"q_p50_s": 1.0}, open(a, "w"))
+        json.dump({"q_p50_s": 1.0}, open(b, "w"))
+        assert bc.main([a, b, "--history", regressed]) == 1
+        assert bc.main([a, b, "--history", healthy]) == 0
+        # A wrong/missing dir must FAIL the gate loudly, never pass green.
+        capsys.readouterr()
+        assert bc.main(["--history", os.path.join(str(tmp_path), "nope")]) == 2
+        # One positional alone (candidate forgotten) is a usage error, not a
+        # silent skip of the static gate.
+        with pytest.raises(SystemExit) as e:
+            bc.main([a, "--history", healthy])
+        assert e.value.code == 2
+
+
+# ---------------------------------------------------------------------------
+# Histogram state roundtrip (the baseline serialization primitive)
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_state_roundtrip_preserves_summary():
+    h = metrics.Histogram("rt")
+    for i in range(200):
+        h.observe(0.001 * (i + 1))
+    clone = metrics.Histogram("rt2")
+    clone.merge_state(json.loads(json.dumps(h.dump_state())))
+    assert clone.summary() == h.summary()
+    # Merging is additive (two halves == the whole).
+    a, b = metrics.Histogram("a"), metrics.Histogram("b")
+    for i in range(100):
+        a.observe(0.001 * (i + 1))
+    for i in range(100, 200):
+        b.observe(0.001 * (i + 1))
+    merged = metrics.Histogram("m")
+    merged.merge_state(a.dump_state())
+    merged.merge_state(b.dump_state())
+    assert merged.summary() == h.summary()

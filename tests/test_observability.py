@@ -315,6 +315,11 @@ def test_compile_delta_lands_on_ambient_span():
 
 def test_exporter_frames_schema_and_clean_shutdown(session, tmp_path, monkeypatch):
     path = os.path.join(str(tmp_path), "metrics.jsonl")
+    # Ledgers pending from EARLIER tests would ride this exporter's frames
+    # (the queue is process-wide) and could alias the rows_produced==1
+    # assertion below — start from a drained queue so the frames carry
+    # exactly this test's query.
+    accounting.drain_pending()
     ex = exporter.MetricsExporter(path, interval_s=0.05).start()
     try:
         monkeypatch.setenv(accounting.ENV_ACCOUNTING, "1")

@@ -1,0 +1,272 @@
+#!/usr/bin/env python
+"""hsreport: render a workload-history store as an operator report.
+
+The engine lands every closed query ledger in on-lake JSONL segments
+(`hyperspace_tpu.telemetry.history`, ``HYPERSPACE_HISTORY=1``), keyed by a
+stable plan-class fingerprint. This tool is the read side: what an operator
+(or the ROADMAP-4 cost model's author) asks of a workload's history.
+
+Usage:
+    python tools/hsreport.py HISTORY_DIR [--top 10] [--recent 5]
+        [--compare OTHER_DIR] [--json]
+
+Sections:
+- **Top plan classes by total cost** — per fingerprint: query count, names,
+  total/p50/p99 wall, bytes decoded, decode files, retries, compiles.
+- **Expected-vs-actual drift** — per class: the baseline p50 (everything but
+  the newest ``--recent`` queries, compacted checkpoints included) vs the
+  recent-window p50 — the "is this class getting slower" view
+  (`tools/bench_compare.py --history` gates on exactly this).
+- **SLO compliance** — lane-labeled ledgers (served queries) judged against
+  the ambient ``HYPERSPACE_SLO_*`` objectives via `telemetry.slo.
+  compliance_over` — the offline twin of the live monitor.
+- **Hotspots** — compile-storm classes (most XLA compiles) and retry
+  hotspots (most io retries): where warm-path latency is going to compile
+  or fault churn.
+- ``--compare OTHER_DIR`` — two stores' per-class baselines flattened and
+  diffed with `tools.bench_compare`'s machinery (shared `flatten`/`compare`
+  — one comparison semantics across both tools); regressed classes exit 1.
+
+``--json`` emits the whole report as one JSON object for scripting.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from hyperspace_tpu.telemetry import history as _history  # noqa: E402
+from hyperspace_tpu.telemetry import slo as _slo  # noqa: E402
+
+
+def _load_bench_compare():
+    """The sibling module, loaded by path (tools/ is not a package)."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_compare.py")
+    spec = importlib.util.spec_from_file_location("hs_bench_compare", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def load_dir(dir_path: str) -> Tuple[Dict[str, list], Dict[str, list]]:
+    """(raw ledger records by fingerprint, checkpoint records by
+    fingerprint), ledgers time-ordered — the store's own grouping."""
+    return _history.split_records(_history.iter_records(dir_path))
+
+
+def fold_dir(dir_path: str) -> Dict[str, dict]:
+    """Per-fingerprint baseline summaries of everything in the store."""
+    return {
+        fp: bl.summary()
+        for fp, bl in _history.fold_baselines(_history.iter_records(dir_path)).items()
+    }
+
+
+def drift(
+    raw: Dict[str, list], checkpoints: Dict[str, list], recent_k: int
+) -> List[dict]:
+    """Expected-vs-actual per class: baseline p50 (all but the newest
+    `recent_k` ledgers + checkpoints) vs the recent-window p50 — the shared
+    `history.recent_vs_baseline` computation (what `bench_compare
+    --history` gates), shown for EVERY class with any recent signal."""
+    out = _history.recent_vs_baseline(raw, checkpoints, recent_k)
+    out.sort(key=lambda d: -(d["ratio"] or 0.0))
+    return out
+
+
+def build_report(dir_path: str, top: int, recent_k: int) -> dict:
+    raw, checkpoints = load_dir(dir_path)
+    baselines = {
+        fp: bl.summary()
+        for fp, bl in _history.fold_baselines(
+            rec
+            for recs in (raw, checkpoints)
+            for fp_recs in recs.values()
+            for rec in fp_recs
+        ).items()
+    }
+    classes = sorted(
+        baselines.items(), key=lambda kv: -(kv[1].get("wall_total_s") or 0.0)
+    )
+    all_ledgers = [r["ledger"] for recs in raw.values() for r in recs]
+    report = {
+        "dir": os.path.abspath(dir_path),
+        "fingerprints": len(baselines),
+        "ledger_records": sum(len(v) for v in raw.values()),
+        "checkpoint_records": sum(len(v) for v in checkpoints.values()),
+        "total_wall_s": round(
+            sum(s.get("wall_total_s") or 0.0 for s in baselines.values()), 3
+        ),
+        "top_classes": [
+            dict(fingerprint=fp, **summary) for fp, summary in classes[:top]
+        ],
+        "drift": drift(raw, checkpoints, recent_k)[:top],
+        "slo": _slo.compliance_over(all_ledgers),
+        "compile_hotspots": [
+            {
+                "fingerprint": fp,
+                "names": s.get("names"),
+                "xla_compiles": s.get("xla_compiles", 0),
+                "n": s.get("n"),
+            }
+            for fp, s in sorted(
+                baselines.items(), key=lambda kv: -kv[1].get("xla_compiles", 0)
+            )[:top]
+            if s.get("xla_compiles")
+        ],
+        "retry_hotspots": [
+            {
+                "fingerprint": fp,
+                "names": s.get("names"),
+                "io_retries": s.get("io_retries", 0),
+                "n": s.get("n"),
+            }
+            for fp, s in sorted(
+                baselines.items(), key=lambda kv: -kv[1].get("io_retries", 0)
+            )[:top]
+            if s.get("io_retries")
+        ],
+    }
+    return report
+
+
+def _fmt_s(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    return f"{v * 1000:.1f}ms" if v < 1 else f"{v:.3f}s"
+
+
+def render(report: dict) -> str:
+    lines = [
+        f"workload history: {report['dir']}",
+        f"  {report['ledger_records']} ledgers + "
+        f"{report['checkpoint_records']} checkpoints across "
+        f"{report['fingerprints']} plan classes; "
+        f"total attributed wall {report['total_wall_s']:.3f}s",
+        "",
+        "top plan classes by total cost:",
+    ]
+    for c in report["top_classes"]:
+        names = ",".join(c.get("names") or []) or "?"
+        lines.append(
+            f"  {c['fingerprint']}  n={c['n']:<5} total={_fmt_s(c['wall_total_s'])}"
+            f"  p50={_fmt_s(c.get('wall_p50_s'))} p99={_fmt_s(c.get('wall_p99_s'))}"
+            f"  decoded={c.get('bytes_decoded', 0)}B"
+            f"  [{names}]"
+        )
+    if report["drift"]:
+        lines += ["", "expected vs actual (recent window vs class baseline):"]
+        for d in report["drift"]:
+            names = ",".join(d.get("names") or []) or "?"
+            lines.append(
+                f"  {d['fingerprint']}  expected={_fmt_s(d['expected_p50_s'])}"
+                f" actual={_fmt_s(d['actual_p50_s'])} (x{d['ratio']})"
+                f"  baseline_n={d['baseline_n']}  [{names}]"
+            )
+    if report["slo"]:
+        lines += ["", "SLO compliance (recorded serving traffic):"]
+        for lane, s in report["slo"].items():
+            verdict = "MET" if s["met"] else ("MISSED" if s["met"] is not None else "-")
+            lines.append(
+                f"  {lane}: {s['total']} queries, {s['violations']} over "
+                f"{s['objective_ms']:g}ms, compliance="
+                f"{s['compliance'] if s['compliance'] is not None else '-'}"
+                f" (target {s['target']:.2%}) {verdict}"
+            )
+    if report["compile_hotspots"]:
+        lines += ["", "compile-storm hotspots (XLA compiles per class):"]
+        for h in report["compile_hotspots"]:
+            lines.append(
+                f"  {h['fingerprint']}  compiles={h['xla_compiles']} over "
+                f"{h['n']} queries  [{','.join(h.get('names') or [])}]"
+            )
+    if report["retry_hotspots"]:
+        lines += ["", "io-retry hotspots (transient-fault churn per class):"]
+        for h in report["retry_hotspots"]:
+            lines.append(
+                f"  {h['fingerprint']}  retries={h['io_retries']} over "
+                f"{h['n']} queries  [{','.join(h.get('names') or [])}]"
+            )
+    return "\n".join(lines)
+
+
+#: Per-class leaves the --compare gate judges: PER-QUERY latency stats only.
+#: Cumulative/statistical leaves also end in ``_s`` but must never gate —
+#: ``wall_total_s`` grows with recorded traffic (a store that simply saw 5x
+#: the queries is not 5x slower), ``wall_max_s`` is one outlier, and
+#: ``wall_std_s`` is not a latency at all.
+GATED_LEAVES = ("wall_p50_s", "wall_p99_s", "wall_mean_s")
+
+
+def compare_dirs(dir_a: str, dir_b: str, threshold: float) -> int:
+    """Diff two stores' per-class baselines via `bench_compare`'s shared
+    flatten/compare machinery. Returns 1 when a shared class's PER-QUERY
+    timing (`GATED_LEAVES`) regressed past `threshold`, else 0."""
+    bc = _load_bench_compare()
+
+    def _gateable(flat):
+        return {
+            k: v
+            for k, v in flat.items()
+            if not bc.is_timing(k) or k.rsplit(".", 1)[-1] in GATED_LEAVES
+        }
+
+    flat_a = _gateable(bc.flatten(fold_dir(dir_a)))
+    flat_b = _gateable(bc.flatten(fold_dir(dir_b)))
+    rows, regressions = bc.compare(flat_a, flat_b, threshold, 0.01, [])
+    print(f"hsreport compare: {dir_a} -> {dir_b} ({len(rows)} shared leaves)")
+    for key, b, c, delta, ratio, gated in rows:
+        if not bc.is_timing(key):
+            continue
+        mark = "  REGRESSION" if gated else ""
+        print(f"  {key}: {b:.6g} -> {c:.6g}  ({delta:+.6g}, x{ratio:.3f}){mark}")
+    if regressions:
+        print(f"FAIL: {len(regressions)} class timing(s) regressed", file=sys.stderr)
+        return 1
+    print("OK: no class timing regressions")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("history_dir", help="workload history directory")
+    ap.add_argument("--top", type=int, default=10, help="rows per section")
+    ap.add_argument(
+        "--recent", type=int, default=5, help="recent-window size for drift"
+    )
+    ap.add_argument(
+        "--compare",
+        default=None,
+        metavar="DIR",
+        help="second history dir: diff per-class baselines (exit 1 on regression)",
+    )
+    ap.add_argument(
+        "--threshold",
+        type=float,
+        default=0.25,
+        help="allowed fractional regression for --compare (default 0.25)",
+    )
+    ap.add_argument("--json", action="store_true", help="emit the report as JSON")
+    args = ap.parse_args(argv)
+
+    if not os.path.isdir(args.history_dir):
+        print(f"hsreport: not a directory: {args.history_dir}", file=sys.stderr)
+        return 2
+    report = build_report(args.history_dir, args.top, args.recent)
+    if args.json:
+        print(json.dumps(report, indent=2, default=str))
+    else:
+        print(render(report))
+    if args.compare:
+        return compare_dirs(args.history_dir, args.compare, args.threshold)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
