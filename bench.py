@@ -497,6 +497,11 @@ def run_bench(deadline: float = None) -> dict:
         #    through the QueryServer (throughput, per-class p50/p99, dedup
         #    counters, cold-scan single-flight probe)
         ph.run("serving", lambda: d.update(_serving_section(s, base, col, runs, hs)))
+        # -- live tables: streaming ingest (append batches + incremental
+        #    refresh + background compaction) landing WHILE the interactive
+        #    mix runs — staleness, refresh latency, and interactive p50/p99
+        #    before/during/after refresh and compaction
+        ph.run("live_tables", lambda: d.update(_live_tables_section(s, base, col, runs, hs)))
         # Cache stats AFTER the variants: the hybrid-scan queries are the
         # per-file scan cache's real workload (query-time re-reads the higher
         # cache levels cannot hold).
@@ -1249,6 +1254,206 @@ def _serving_section_body(s, base, col, runs, hs) -> dict:
         },
     }
     return {"serving": out}
+
+
+def _live_tables_section(s, base, col, runs, hs) -> dict:
+    """Live tables under the serving mix (docs/reliability.md "Live tables"):
+    append batches LAND while interactive point lookups run; each batch is
+    folded in by an incremental refresh and the accumulated delta files are
+    coalesced by a background compaction — both as BATCH-lane citizens on the
+    serving scheduler, so the headline is the interactive tail DURING
+    refresh/compaction vs idle.
+
+    Reported: per-batch staleness at landing + freshness lag (append →
+    refresh committed), refresh/compact latency, interactive p50/p99 idle /
+    during-refresh / during-compaction / after, and the delta-file counts
+    that prove the layout churn. ``point_p99_during_refresh_x_idle`` is the
+    acceptance ratio (target ≤ 3)."""
+    import threading
+
+    from hyperspace_tpu import IndexConfig, IndexConstants
+    from hyperspace_tpu.actions.optimize import needs_compaction
+    from hyperspace_tpu.engine import io as _eio
+    from hyperspace_tpu.engine.table import Table
+    from hyperspace_tpu.hyperspace import disable_hyperspace, enable_hyperspace
+    from hyperspace_tpu.serve import QueryServer
+    from hyperspace_tpu.telemetry import metrics
+
+    if os.environ.get("BENCH_SKIP_LIVE") == "1":
+        return {}
+    n = int(os.environ.get("BENCH_LIVE_ROWS", 200_000))
+    batches = int(os.environ.get("BENCH_LIVE_BATCHES", 3))
+    batch_rows = int(os.environ.get("BENCH_LIVE_BATCH_ROWS", max(n // 10, 1000)))
+    workers = int(os.environ.get("BENCH_SERVE_MAX_CONCURRENT", 3))
+    chunk_env = ("HYPERSPACE_JOIN_CHUNK_ROWS", "HYPERSPACE_QUERY_CHUNK_ROWS")
+    saved_env = {k: os.environ.get(k) for k in chunk_env}
+    saved_conf = {
+        k: s.conf.get(k)
+        for k in (
+            IndexConstants.INDEX_LINEAGE_ENABLED,
+            IndexConstants.INDEX_HYBRID_SCAN_ENABLED,
+            IndexConstants.INDEX_NUM_BUCKETS,
+        )
+    }
+    out = {"rows": n, "batches": batches, "batch_rows": batch_rows}
+    try:
+        # Serving SLO posture (same as the serving section): short batch work
+        # quanta = frequent cooperative yield boundaries for the batch-lane
+        # refresh/compaction to pause at.
+        chunk_rows = str(int(os.environ.get("BENCH_SERVE_CHUNK_ROWS", 65536)))
+        for k in chunk_env:
+            os.environ[k] = chunk_rows
+        # Lineage ON: the delete-folding path is part of the live contract.
+        # Hybrid scan ON: between an append landing and its refresh
+        # committing, queries serve FRESH rows by merging the appended files
+        # at scan time — the graceful-degradation half of the story.
+        s.conf.set(IndexConstants.INDEX_LINEAGE_ENABLED, "true")
+        s.conf.set(IndexConstants.INDEX_HYBRID_SCAN_ENABLED, "true")
+        # The live index owns its bucket count: per-refresh delta cost scales
+        # with it (one delta file per non-empty bucket per refresh).
+        s.conf.set(
+            IndexConstants.INDEX_NUM_BUCKETS,
+            int(os.environ.get("BENCH_LIVE_BUCKETS", 16)),
+        )
+
+        rng = np.random.RandomState(11)
+        lv_dir = os.path.join(base, "live")
+        tbl = os.path.join(lv_dir, "events")
+        n_keys = max(n // 8, 1000)
+        _write_chunked(
+            {
+                "ek": rng.randint(0, n_keys, n).astype(np.int64),
+                "qty": rng.randint(1, 51, n).astype(np.int64),
+                "price": (rng.rand(n) * 1000).astype(np.float64),
+            },
+            tbl,
+            8,
+        )
+        ev = lambda: s.read.parquet(tbl)  # noqa: E731
+        t0 = _now()
+        hs.create_index(ev(), IndexConfig("liveEvIdx", ["ek"], ["qty", "price"]))
+        out["build_s"] = round(_now() - t0, 3)
+        enable_hyperspace(s)
+
+        point_keys = [n_keys // 2 + 7 * i for i in range(16)]
+
+        def q_point(key):
+            return ev().filter(col("ek") == key).select("qty", "price").collect()
+
+        def measure_points(srv, n_samples):
+            vals = []
+            for i in range(n_samples):
+                key = point_keys[i % len(point_keys)]
+                t0 = _now()
+                srv.run(lambda key=key: q_point(key), lane="interactive")
+                vals.append(_now() - t0)
+            return vals
+
+        def pstats(vals):
+            arr = np.sort(np.asarray(vals))
+            return {
+                "n": len(vals),
+                "p50_s": round(float(np.percentile(arr, 50)), 4),
+                "p99_s": round(float(np.percentile(arr, 99)), 4),
+            }
+
+        refresh_walls = []  # SECTION-local samples (the lifetime histogram
+        # may carry foreign refreshes — the PR-11 section-DELTA convention)
+
+        def timed_refresh():
+            t0 = _now()
+            hs.refresh_index("liveEvIdx", mode="incremental")
+            refresh_walls.append(_now() - t0)
+
+        srv = QueryServer(max_concurrent=workers)
+        try:
+            for key in point_keys:
+                q_point(key)  # warm each rotating literal
+            out["point_idle"] = pstats(measure_points(srv, max(4 * runs, 24)))
+
+            # -- streaming ingest: batches land, refreshes fold them in while
+            #    the interactive mix keeps running ---------------------------
+            staleness, freshness_lag, during_refresh = [], [], []
+            next_key = n
+            for b in range(batches):
+                _eio.write_parquet(
+                    Table.from_pydict(
+                        {
+                            "ek": rng.randint(0, n_keys, batch_rows).astype(np.int64),
+                            "qty": rng.randint(1, 51, batch_rows).astype(np.int64),
+                            "price": (rng.rand(batch_rows) * 1000).astype(np.float64),
+                        }
+                    ),
+                    os.path.join(tbl, f"append-{b:05d}.parquet"),
+                )
+                landed = _now()
+                # One query between landing and refresh: hybrid scan serves
+                # the fresh rows and the candidate diff publishes staleness.
+                q_point(point_keys[b % len(point_keys)])
+                staleness.append(
+                    metrics.gauge("index.staleness_s.liveEvIdx").value
+                )
+                fut = srv.submit(timed_refresh, lane="batch")
+                # The interactive mix DURING the refresh.
+                while not fut.done():
+                    during_refresh.extend(measure_points(srv, 4))
+                fut.result(600)
+                freshness_lag.append(round(_now() - landed, 3))
+            out["staleness_at_landing_s"] = staleness
+            out["freshness_lag_s"] = freshness_lag
+            if not during_refresh:
+                # Inline-serial serving (HYPERSPACE_SERVING=0) or a refresh
+                # faster than one probe round: measure right after instead of
+                # reporting an empty window.
+                during_refresh = measure_points(srv, 8)
+            out["point_during_refresh"] = pstats(during_refresh)
+            out["refresh_count"] = len(refresh_walls)
+            out["refresh_latency_p50_s"] = round(
+                float(np.percentile(np.asarray(refresh_walls), 50)), 3
+            )
+
+            # -- background compaction under the same mix -------------------
+            entry = [e for e in hs._manager.get_indexes() if e.name == "liveEvIdx"][0]
+            out["delta_files_before_compact"] = len(entry.content.files())
+            out["needs_compaction"] = needs_compaction(entry)
+            during_compact = []
+            t0 = _now()
+            fut = srv.submit(lambda: hs.optimize_index("liveEvIdx"), lane="batch")
+            while not fut.done():
+                during_compact.extend(measure_points(srv, 4))
+            fut.result(600)
+            out["compact_s"] = round(_now() - t0, 3)
+            if during_compact:
+                out["point_during_compact"] = pstats(during_compact)
+            entry = [e for e in hs._manager.get_indexes() if e.name == "liveEvIdx"][0]
+            out["files_after_compact"] = len(entry.content.files())
+
+            for key in point_keys:
+                q_point(key)  # re-warm: compaction is a new generation
+            out["point_after"] = pstats(measure_points(srv, max(4 * runs, 24)))
+        finally:
+            srv.close()
+        idle_p99 = max(out["point_idle"]["p99_s"], 1e-9)
+        out["point_p99_during_refresh_x_idle"] = round(
+            out["point_during_refresh"]["p99_s"] / idle_p99, 2
+        )
+        if "point_during_compact" in out:
+            out["point_p99_during_compact_x_idle"] = round(
+                out["point_during_compact"]["p99_s"] / idle_p99, 2
+            )
+        return {"live_tables": out}
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        for k, v in saved_conf.items():
+            if v is None:
+                s.conf.unset(k)
+            else:
+                s.conf.set(k, v)
+        disable_hyperspace(s)
 
 
 def _cache_section() -> dict:

@@ -26,7 +26,55 @@ OPTIMIZE_FILE_SIZE_THRESHOLD = "hyperspace.index.optimize.fileSizeThreshold"
 OPTIMIZE_FILE_SIZE_THRESHOLD_DEFAULT = 256 * 1024 * 1024
 OPTIMIZE_MODES = ("quick", "full")
 
+#: Background-compaction trigger: an index "needs compaction" once any bucket
+#: is spread over this many delta files (or it carries a folded delete set).
+ENV_COMPACT_TRIGGER_FILES = "HYPERSPACE_COMPACT_TRIGGER_FILES"
+_DEFAULT_COMPACT_TRIGGER_FILES = 2
+
 _BUCKET_RE = re.compile(r"part-(\d+)")
+_VERSION_RE = re.compile(r"v__=(\d+)")
+
+
+def _compact_trigger_files() -> int:
+    try:
+        return max(
+            2,
+            int(
+                os.environ.get(ENV_COMPACT_TRIGGER_FILES, "")
+                or _DEFAULT_COMPACT_TRIGGER_FILES
+            ),
+        )
+    except ValueError:
+        return _DEFAULT_COMPACT_TRIGGER_FILES
+
+
+def _version_rank(path: str) -> int:
+    """Numeric index-version rank of an index data file path (`v__=N` path
+    component). STRING order would misplace v__=10 before v__=2, so delta
+    files must merge in numeric version order for the compacted row order to
+    reproduce a from-scratch rebuild's."""
+    m = _VERSION_RE.search(path)
+    return int(m.group(1)) if m else -1
+
+
+def needs_compaction(entry: IndexLogEntry) -> bool:
+    """Whether background compaction should run on `entry`: it carries a
+    folded delete set (rows awaiting physical removal), or incremental
+    refreshes have spread some bucket over ≥ ``HYPERSPACE_COMPACT_TRIGGER_FILES``
+    delta files. The serving loop's batch lane polls this after refreshes
+    (docs/reliability.md "Live tables")."""
+    if entry.kind != "CoveringIndex":
+        return False
+    if entry.deleted_source_files():
+        return True
+    from collections import Counter as _Counter
+
+    per_bucket = _Counter()
+    for f in entry.content.file_infos():
+        m = _BUCKET_RE.search(os.path.basename(f.name))
+        if m is not None:
+            per_bucket[int(m.group(1))] += 1
+    return bool(per_bucket) and max(per_bucket.values()) >= _compact_trigger_files()
 
 
 class OptimizeAction(Action):
@@ -73,8 +121,14 @@ class OptimizeAction(Action):
         return self._prev
 
     def _partition_files(self):
-        """Split content files into (to_merge per bucket, untouched)."""
+        """Split content files into (to_merge per bucket, untouched).
+
+        With a folded delete set on the entry every `part-<bucket>` file is
+        rewritten regardless of mode/threshold (singletons included): clearing
+        ``deletedSourceFiles`` from the log is only sound once no data file
+        can still hold a deleted file's rows."""
         prev = self._previous_entry()
+        folding = bool(prev.deleted_source_files())
         threshold = int(
             self._session.conf.get(
                 OPTIMIZE_FILE_SIZE_THRESHOLD, str(OPTIMIZE_FILE_SIZE_THRESHOLD_DEFAULT)
@@ -87,13 +141,14 @@ class OptimizeAction(Action):
             if m is None:
                 untouched.append(f)
                 continue
-            if self._mode == "full" or f.size < threshold:
+            if folding or self._mode == "full" or f.size < threshold:
                 per_bucket[int(m.group(1))].append(f)
             else:
                 untouched.append(f)
-        # A bucket with a single (small) file gains nothing from merging.
-        for b in [b for b, fs in per_bucket.items() if len(fs) < 2]:
-            untouched.extend(per_bucket.pop(b))
+        if not folding:
+            # A bucket with a single (small) file gains nothing from merging.
+            for b in [b for b, fs in per_bucket.items() if len(fs) < 2]:
+                untouched.extend(per_bucket.pop(b))
         return per_bucket, untouched
 
     def validate(self) -> None:
@@ -117,23 +172,95 @@ class OptimizeAction(Action):
             )
 
     def op(self) -> None:
+        import numpy as np
+
+        from ..config import IndexConstants
         from ..engine import io as engine_io
         from ..engine.table import Table
         from ..index.staging import stage_commit
-        from ..ops.partition import bucketize_table
-        import numpy as np
+        from ..ops.partition import host_sort_perm
+        from ..telemetry import faults as _faults
+        from .. import resilience
+
+        from ..engine.schema import Schema
 
         prev = self._previous_entry()
         per_bucket, _ = self._partition_files()
+        folded = set(prev.deleted_source_files())
+        # Explicit column list = the index schema. A bare read of a file under
+        # a `v__=N` version dir sprouts a hive-inferred `v__` partition column
+        # that would be WRITTEN into the compacted file (diverging from a
+        # from-scratch rebuild's bytes and breaking later dataset-API reads).
+        index_cols = list(Schema.from_json_string(prev.schema_json).names)
+        lineage_col = None
+        if prev.has_lineage():
+            target = IndexConstants.DATA_FILE_NAME_COLUMN.lower()
+            lineage_col = next(n for n in index_cols if n.lower() == target)
+        # Canonical tie order (the PR-10 stable (bucket, keys…, source row id)
+        # contract): a from-scratch rebuild reads source files in path-sorted
+        # order, so equal-key rows land in (source file rank, intra-file row)
+        # order. Each version dir's rows already carry key-sorted,
+        # source-order-tied rows for ITS file subset; with lineage the merged
+        # rows re-rank by the CURRENT inventory's path order before the stable
+        # key sort, reproducing the rebuild's byte order exactly. Without
+        # lineage the merge falls back to numeric version order — identical
+        # whenever appended files sort after earlier ones (the append-only
+        # naming pattern).
+        src_rank = {
+            f.name: i for i, f in enumerate(prev.relations[0].data.file_infos())
+        }
+
+        def canonical_rows(files) -> Table:
+            parts = [
+                engine_io.read_files([f.name], "parquet", index_cols)
+                for f in sorted(
+                    files,
+                    key=lambda f: (_version_rank(f.name), os.path.basename(f.name)),
+                )
+            ]
+            merged = parts[0] if len(parts) == 1 else Table.concat(parts)
+            if lineage_col is None:
+                return merged
+            col = merged.column(lineage_col)
+            keep = np.arange(merged.num_rows)
+            if folded:
+                # Delete folding's physical half: rows of vanished source
+                # files leave the data here, and `log_entry` clears the set.
+                dropped_dict = np.isin(col.dictionary, sorted(folded))
+                keep = keep[~dropped_dict[col.data]]
+            dict_ranks = np.array(
+                [src_rank.get(v, len(src_rank)) for v in col.dictionary],
+                dtype=np.int64,
+            )
+            order = np.argsort(dict_ranks[col.data[keep]], kind="stable")
+            return merged.take(keep[order])
+
         # Staged commit (crash-safe, same contract as create/refresh): the
         # compacted files land in `index_data_path` via one atomic rename.
         with stage_commit(self._index_data_path) as stage:
             os.makedirs(stage, exist_ok=True)
             for b, files in sorted(per_bucket.items()):
-                merged = engine_io.read_files([f.name for f in files], "parquet")
-                # Re-sort within the bucket by the indexed columns (same contract as the
-                # original bucketed write).
-                sorted_t, _ = bucketize_table(merged, prev.indexed_columns, prev.num_buckets)
+                # Batch-lane citizenship: a deadline/yield boundary per bucket
+                # (the serving scheduler's cooperative gate pauses here while
+                # interactive queries are pending).
+                resilience.check_deadline("optimize.bucket")
+                merged = canonical_rows(files)
+                if merged.num_rows == 0:
+                    continue  # every row deleted: no file, like the builder
+                # Re-sort within the bucket by the indexed columns (same
+                # contract as the original bucketed write; stable, so the
+                # canonical tie order holds). Every row already belongs to
+                # bucket `b`, so this is a pure key sort — `host_sort_perm`
+                # with a constant bucket lane, the exact composite the build
+                # paths share. Re-hashing through `bucketize_table` here would
+                # dispatch one differently-shaped device program PER BUCKET
+                # (a compile storm that made compaction ~100x slower).
+                perm = host_sort_perm(
+                    np.zeros(merged.num_rows, dtype=np.int64),
+                    [merged.column(c) for c in prev.indexed_columns],
+                    prev.num_buckets,
+                )
+                sorted_t = merged.take(perm)
                 # Same bounded row-group layout as the original bucketed write, so
                 # compacted files stay prunable by the scan pushdown's zone maps.
                 engine_io.write_parquet(
@@ -141,9 +268,21 @@ class OptimizeAction(Action):
                     os.path.join(stage, f"part-{b:05d}.parquet"),
                     row_group_rows=engine_io.index_row_group_rows(),
                 )
+            # The compaction commit window: every compacted bucket is staged,
+            # the atomic rename has not happened. A transient fault aborts
+            # cleanly (staging dir deleted, log untouched); a `hang` is the
+            # SIGKILL-mid-compaction window of the crash matrix.
+            _faults.check("compact.commit")
+        # Warm handoff (same contract as refresh): the compacted generation
+        # is decoded into the scan cache before the log commit flips readers.
+        from .refresh import _warm_handoff
+
+        _warm_handoff(self._index_data_path, prev.schema_json)
 
     def log_entry(self) -> LogEntry:
         import copy
+
+        from ..index.log_entry import DELETED_SOURCE_FILES_KEY
 
         prev = self._previous_entry()
         entry = copy.deepcopy(prev)
@@ -152,6 +291,10 @@ class OptimizeAction(Action):
         entry.content = Content.merge(
             [Content.from_file_infos(untouched), merged_content]
         )
+        if prev.deleted_source_files():
+            # Folding mode rewrote EVERY part file (`_partition_files`), so no
+            # data file can still hold a deleted file's rows.
+            entry.extra.pop(DELETED_SOURCE_FILES_KEY, None)
         return entry
 
     def event(self, message: str) -> HyperspaceEvent:

@@ -16,10 +16,64 @@ from typing import Optional
 
 from ..exceptions import HyperspaceException
 from ..index.log_entry import IndexLogEntry, LogEntry
+
+
+class NothingToRefreshError(HyperspaceException):
+    """Incremental refresh found no appended or deleted source files: the
+    index already covers the current source. A TYPED signal so mode="auto"
+    can no-op on it without matching message wording."""
 from ..telemetry.events import HyperspaceEvent, RefreshActionEvent
 from . import states
 from .action import Action, _recover_stable
 from .create import IndexerBuilder
+
+
+#: Warm handoff (docs/reliability.md "Live tables"): after an action commits
+#: its data directory and before its log commit flips readers onto the new
+#: generation, the writer decodes the fresh files into the shared scan cache —
+#: the first interactive query on the new generation pays a cache hit, not a
+#: cold parquet decode. "0" opts out (the deltas re-decode lazily as before).
+ENV_REFRESH_WARM_HANDOFF = "HYPERSPACE_REFRESH_WARM_HANDOFF"
+
+
+def _warm_handoff(index_data_path: str, schema_json: str) -> None:
+    """Best-effort: decode a freshly committed version dir's files into the
+    per-column scan cache (explicit index-schema columns — a bare read under
+    `v__=N` would sprout the hive partition column). A failure here must
+    never fail the action: the data and log commits are already correct."""
+    import os
+
+    if os.environ.get(ENV_REFRESH_WARM_HANDOFF, "1") == "0":
+        return
+    try:
+        from ..engine import io as engine_io
+        from ..engine.schema import Schema
+
+        if not os.path.isdir(index_data_path):
+            return
+        files = sorted(
+            os.path.join(index_data_path, n)
+            for n in os.listdir(index_data_path)
+            if n.endswith(".parquet")
+        )
+        if not files:
+            return
+        cols = list(Schema.from_json_string(schema_json).names)
+        engine_io.warm_file_cache(files, "parquet", cols)
+        # The pool no-ops for a single job (and entirely when the decode pool
+        # is sized 1): sweep only the files still cache-COLD, so nothing the
+        # pool just decoded is re-assembled.
+        from ..engine.scan_cache import global_scan_cache
+
+        cache = global_scan_cache()
+        for f in files:
+            if cache.missing_columns(f, cols) != []:
+                engine_io.read_files([f], "parquet", cols)
+        from ..telemetry import metrics
+
+        metrics.counter("index.warm_handoff.files").inc(len(files))
+    except Exception:
+        pass
 
 
 class RefreshAction(Action):
@@ -78,6 +132,7 @@ class RefreshAction(Action):
     def op(self) -> None:
         config = self._builder.config_from_entry(self._previous_entry())
         self._builder.write(self._source_df(), config, self._index_data_path)
+        _warm_handoff(self._index_data_path, self._previous_entry().schema_json)
 
     def log_entry(self) -> LogEntry:
         # Derived fresh per phase (see CreateAction.log_entry): the end() entry must
@@ -99,7 +154,16 @@ class RefreshIncrementalAction(RefreshAction):
 
     North-star extension (BASELINE.md config 5) — absent from the v0 reference
     snapshot, whose refresh is full-rebuild only (`RefreshAction.scala:76-81`).
-    Deleted source files require lineage-based repair and are rejected here."""
+
+    Deleted source files FOLD through lineage when the index carries the
+    per-row `_data_file_name` column: their paths land in the new entry's
+    ``deletedSourceFiles`` set (merged with any set the previous entry already
+    carried), and readers prune those rows at scan time via
+    `rules.rule_utils.lineage_prune_condition` — no data rewrite at refresh
+    time. The rows are physically removed (and the set cleared) by the next
+    `optimize_index` compaction or full rewrite. Without lineage, deletes
+    still reject (the rows are inseparable). Files modified IN PLACE always
+    reject: their old rows are not addressable even by lineage (same path)."""
 
     def _diff_files(self):
         prev = self._previous_entry()
@@ -109,9 +173,10 @@ class RefreshIncrementalAction(RefreshAction):
         }
         current_files = self._source_df().plan.relation.files
         current_paths = {f.path for f in current_files}
-        # A recorded path that vanished OR was modified in place (same path, changed
-        # size/mtime) invalidates the already-indexed rows — both require full
-        # rebuild. Only genuinely NEW paths are incrementally indexable.
+        # A recorded path modified in place (same path, changed size/mtime)
+        # invalidates the already-indexed rows — full rebuild required. A
+        # vanished path is recoverable via lineage (delete folding); genuinely
+        # NEW paths are incrementally indexable.
         recorded_paths = {p for (p, _, _) in recorded}
         deleted = sorted(recorded_paths - current_paths)
         modified = sorted(
@@ -125,30 +190,76 @@ class RefreshIncrementalAction(RefreshAction):
 
     def validate(self) -> None:
         super().validate()
-        appended, deleted, modified = self._diff_files()
-        if deleted or modified:
+        prev = self._previous_entry()
+        if not prev.relations[0].data.file_infos():
+            # Without the recorded per-file inventory there is nothing to diff
+            # against — surfacing this beats silently treating every current
+            # file as appended (which would duplicate already-indexed rows).
             raise HyperspaceException(
-                "Incremental refresh does not support deleted or modified source "
-                f"files (deleted: {deleted[:3]}, modified: {modified[:3]}); "
+                "Incremental refresh requires per-file source signatures in "
+                "the previous log entry, but it records no file inventory; "
+                "use mode='full' to rebuild."
+            )
+        appended, deleted, modified = self._diff_files()
+        # A previously-folded-deleted path that RE-APPEARED is modified-in-
+        # place in disguise: the index still physically holds the OLD rows
+        # under that path, and the path-keyed lineage prune cannot separate
+        # them from the new file's rows — folding it out would resurrect the
+        # old rows, folding it in would drop the new ones.
+        reappeared = sorted(
+            {f.path for f in appended} & set(prev.deleted_source_files())
+        )
+        if modified or reappeared:
+            raise HyperspaceException(
+                "Incremental refresh does not support source files modified "
+                f"in place (modified: {(modified + reappeared)[:3]}); "
                 "use mode='full'."
             )
-        if not appended:
+        if deleted and not prev.has_lineage():
             raise HyperspaceException(
+                "Incremental refresh found deleted source files "
+                f"(deleted: {deleted[:3]}) but the index records no lineage "
+                "column to fold them through; enable "
+                "hyperspace.index.lineage.enabled at build time or use "
+                "mode='full'."
+            )
+        if not appended and not deleted:
+            raise NothingToRefreshError(
                 "Refresh incremental aborted as no appended source data files found."
             )
 
     def op(self) -> None:
-        config = self._builder.config_from_entry(self._previous_entry())
         appended, _, _ = self._diff_files()
-        sub_df = self._builder.restrict_df_to_files(
-            self._source_df(), [f.path for f in appended]
-        )
-        self._builder.write(sub_df, config, self._index_data_path)
+        if appended:
+            config = self._builder.config_from_entry(self._previous_entry())
+            sub_df = self._builder.restrict_df_to_files(
+                self._source_df(), [f.path for f in appended]
+            )
+            self._builder.write(sub_df, config, self._index_data_path)
+            # Warm handoff: readers flip onto the merged generation at the
+            # log commit below; the delta files are already decoded then.
+            _warm_handoff(self._index_data_path, self._previous_entry().schema_json)
+        # The merge window: delta data (if any) is committed, the merged log
+        # entry has not landed. A transient fault here fails the refresh
+        # cleanly (transient log entry + an unreferenced version dir the next
+        # action recovers past); a `hang` is the SIGKILL window between data
+        # commit and log commit the crash matrix aims at.
+        from ..telemetry import faults as _faults
+
+        _faults.check("refresh.merge")
 
     def log_entry(self) -> LogEntry:
         entry = super().log_entry()  # content = new version dir only; fresh signature
         prev = self._previous_entry()
-        from ..index.log_entry import Content
+        from ..index.log_entry import DELETED_SOURCE_FILES_KEY, Content
 
         entry.content = Content.merge([prev.content, entry.content])
+        _, deleted, _ = self._diff_files()
+        # Re-appeared previously-deleted paths cannot reach here: validate()
+        # rejects them as modified-in-place (the path-keyed lineage prune
+        # could not separate the old rows still in the data from the new
+        # file's), so the carried set only ever grows until a rewrite.
+        carried = sorted(set(prev.deleted_source_files()) | set(deleted))
+        if carried:
+            entry.extra[DELETED_SOURCE_FILES_KEY] = carried
         return entry

@@ -478,15 +478,70 @@ def _build_compiled_fn(expr: Expr, facade: _PredTableFacade, spellings: list, mo
     return _observed_jit(fn, label="evaluate.compiled_expr")
 
 
+#: Adaptive fusion guard for live/interactive workloads. The fused program's
+#: compile cache keys on repr(expr) — the LITERAL VALUE included — and on the
+#: table's exact row count, so an interactive point-lookup mix (rotating
+#: literals, index generations flipping under live refresh) minted one ~15 ms
+#: XLA compile per (literal, shape). Eager ops cache per SHAPE only (scalars
+#: ride as weak-typed arguments) but cost one dispatch per operator, which a
+#: warm streamed-aggregate loop over stable shapes measurably feels (~1 ms per
+#: chunk at bench scale). So the policy is adaptive, per literal-abstracted
+#: expression STRUCTURE, on CPU-backend tables below the size bound: FUSE by
+#: default (stable workloads fuse once and stay fused, zero change), and once
+#: one structure has minted `HYPERSPACE_PRED_FUSE_MAX_CLASSES` distinct fused
+#: programs (= literals rotating or shapes churning — compiles, not reuse),
+#: stop fusing it and evaluate eagerly over pow2-padded inputs instead. On the
+#: device path every dispatch is a round-trip, so fusion always wins there.
+#: MIN_ROWS=0 = always fuse (the pre-existing behavior, the fallback
+#: contract); MAX_CLASSES=0 = never fuse below the size bound.
+ENV_PRED_FUSE_MIN_ROWS = "HYPERSPACE_PRED_FUSE_MIN_ROWS"
+_DEFAULT_PRED_FUSE_MIN_ROWS = 1 << 16
+ENV_PRED_FUSE_MAX_CLASSES = "HYPERSPACE_PRED_FUSE_MAX_CLASSES"
+_DEFAULT_PRED_FUSE_MAX_CLASSES = 3
+
+_STRUCT_MINTS: Dict[str, int] = {}  # literal-abstracted structure → fused mints
+_STRUCT_MINTS_MAX = 4096
+
+
+def _env_int(key: str, default: int) -> int:
+    import os
+
+    try:
+        v = os.environ.get(key, "")
+        return int(v) if v != "" else default
+    except ValueError:
+        return default
+
+
+def _pred_fuse_min_rows() -> int:
+    return _env_int(ENV_PRED_FUSE_MIN_ROWS, _DEFAULT_PRED_FUSE_MIN_ROWS)
+
+
+def _expr_structure(expr: Expr, mode: str) -> str:
+    """Literal-abstracted identity of an expression (values → type names) —
+    the same canonicalization plan fingerprints use, so `k == 7` and
+    `k == 42` are ONE structure."""
+    import json as _json
+
+    from ..plananalysis.fingerprint import expr_signature
+
+    return mode + ":" + _json.dumps(expr_signature(expr))
+
+
 def _compiled_eval(expr: Expr, table: Table, mode: str):
     """Run `expr` over `table` as ONE compiled program per (mode, expression,
     table signature); None when this expression shape must stay eager (e.g.
     host access during trace: cross-column string compares, string/literal
-    value results)."""
+    value results, or a small CPU-backend structure whose fused programs have
+    stopped being reused — rotating literals / churning generations; see
+    ENV_PRED_FUSE_MAX_CLASSES)."""
     import weakref
 
     if _contains_udf(expr):
         return None  # UDFs are host-evaluated by contract: never traced
+    from ..ops.backend import use_device_path
+
+    small_cpu = not use_device_path() and table.num_rows < _pred_fuse_min_rows()
     r = (mode, repr(expr))
     with _pred_lock:
         if r in _PRED_UNCACHEABLE:
@@ -526,6 +581,19 @@ def _compiled_eval(expr: Expr, table: Table, mode: str):
                 _PRED_CACHE.pop(key, None)
                 ent = None
         if ent is None:
+            if small_cpu:
+                # Minting yet another fused program for this structure means
+                # its literals/shapes are churning, not being reused: go
+                # eager (pow2-padded for predicates) from here on.
+                struct = _expr_structure(expr, mode)
+                mints = _STRUCT_MINTS.get(struct, 0)
+                if mints >= _env_int(
+                    ENV_PRED_FUSE_MAX_CLASSES, _DEFAULT_PRED_FUSE_MAX_CLASSES
+                ):
+                    return None
+                if len(_STRUCT_MINTS) >= _STRUCT_MINTS_MAX:
+                    _STRUCT_MINTS.clear()  # bounded; counts are a heuristic
+                _STRUCT_MINTS[struct] = mints + 1
             facade = _PredTableFacade(table.num_rows, metas)
             sp_flags = [(sp, metas[sp].validity is not None) for sp in spellings]
             fn = _build_compiled_fn(expr, facade, sp_flags, mode)
@@ -574,14 +642,59 @@ def _compiled_eval(expr: Expr, table: Table, mode: str):
         return None
 
 
+def _pow2_padded_eager_mask(expr: Expr, table: Table):
+    """CPU-backend eager predicate over POW2-PADDED column copies, sliced back
+    to the true row count on the host. Eager ops compile per input SHAPE, so a
+    live table whose row counts drift (every refresh/compaction generation,
+    every hybrid-append merge) minted one ~20 ms XLA compile per new shape on
+    the interactive path; padding onto the pow2 grid pins each (expression,
+    dtype) pair to at most log2(N) compile classes — the PR-10 mesh compile
+    contract applied to predicate evaluation. Padded slots carry zeros (and
+    validity False where a mask exists); their mask bits are sliced off before
+    anyone sees them. None = not applicable (already pow2, UDF, or a column
+    that failed to resolve — the caller falls through to the plain path)."""
+    n = table.num_rows
+    if n == 0 or _contains_udf(expr):
+        return None
+    m = 1 << (n - 1).bit_length()
+    if m == n:
+        return None
+    try:
+        spellings = _collect_col_spellings(expr)
+        cols = {}
+        for sp in spellings:
+            c = table.column(sp)
+            data = np.asarray(c.data)
+            data = np.concatenate([data, np.zeros(m - n, dtype=data.dtype)])
+            valid = None
+            if c.validity is not None:
+                valid = np.concatenate([c.validity, np.zeros(m - n, dtype=bool)])
+            cols[sp] = Column(c.dtype, data, c.dictionary, valid)
+    except Exception:
+        return None
+    mask = _evaluate_predicate_eager(expr, Table(cols))
+    return np.asarray(mask)[:n]
+
+
 def evaluate_predicate(expr: Expr, table: Table) -> jnp.ndarray:
     """Evaluate a boolean expression over a table → device mask. A row survives
     only when the predicate is TRUE and KNOWN (SQL WHERE drops unknowns).
 
-    Runs as ONE compiled program per (expression, table signature): eager
-    evaluation issues one dispatch per operator, and on a remote PJRT
-    transport each dispatch is a round-trip."""
+    Device path: ONE compiled program per (expression, table signature) —
+    eager evaluation issues one dispatch per operator, and on a remote PJRT
+    transport each dispatch is a round-trip. CPU path below the fusion
+    threshold: eager over pow2-padded inputs (shape-stable compile classes,
+    literal values never in the compile key)."""
     out = _compiled_eval(expr, table, "pred")
     if out is not None:
         return out
+    from ..ops.backend import use_device_path
+
+    if not use_device_path() and table.num_rows < _pred_fuse_min_rows():
+        # Size-gated like the fusion guard itself: a LARGE unfusable shape
+        # (e.g. a cross-column string compare) must not pay a padded copy of
+        # every referenced column per query.
+        padded = _pow2_padded_eager_mask(expr, table)
+        if padded is not None:
+            return padded
     return _evaluate_predicate_eager(expr, table)

@@ -433,6 +433,14 @@ class BucketedIndexScanExec(PhysicalNode):
             partitions = (ha.partition_spec, ha.root_paths)
         # Appended source files re-read per query (their bucketization depends
         # on query-time state): decode the cold ones on the shared pool.
+        # These are LAKE decodes, so they ride the PR-7 resilience contract
+        # like every other lake-touching site: the per-file reads retry
+        # transient faults inside `engine_io` (`retry_io("io.decode", …)` at
+        # the decode funnels), and the per-file loop is a deadline boundary —
+        # a deadlined query stops between appended files instead of decoding
+        # the whole delta first.
+        from .. import resilience as _resilience
+
         engine_io.warm_file_cache(
             [f.path for f in ha.files],
             ha.file_format,
@@ -440,6 +448,7 @@ class BucketedIndexScanExec(PhysicalNode):
         )
         parts = []
         for f in ha.files:
+            _resilience.check_deadline("hybrid.merge_appended")
             t = engine_io.read_files(
                 [f.path], ha.file_format, source_cols, partitions=partitions
             )

@@ -60,21 +60,44 @@ class Hyperspace:
     def vacuum_index(self, index_name: str) -> None:
         self._manager.vacuum(index_name)
 
-    def refresh_index(self, index_name: str, mode: str = "full") -> None:
+    def refresh_index(self, index_name: str, mode: Optional[str] = None) -> None:
         """mode="full": rebuild from scratch (reference behavior).
-        mode="incremental": index only appended source files (extension)."""
+        mode="incremental": index only appended source files, fold deletes
+        through lineage (extension; docs/reliability.md "Live tables").
+        mode="auto": incremental when its preconditions hold, full otherwise,
+        no-op when already fresh.
+        mode=None defers to ``HYPERSPACE_REFRESH_MODE`` (default "full").
+
+        Runs as a BATCH-lane citizen: under a live `serve.QueryServer` the
+        cooperative yield gate deprioritizes the refresh whenever interactive
+        queries are pending, so refreshes never dent interactive p99."""
+        import os
+
         from . import resilience
         from .telemetry import tracing
 
+        mode = mode or os.environ.get("HYPERSPACE_REFRESH_MODE") or "full"
         with resilience.query_scope("build:refresh_index"):
-            with tracing.query_span(
-                "build:refresh_index", index_name=index_name, mode=mode
-            ):
-                self._manager.refresh(index_name, mode)
+            with resilience.lane_scope("batch"):
+                with tracing.query_span(
+                    "build:refresh_index", index_name=index_name, mode=mode
+                ):
+                    self._manager.refresh(index_name, mode)
 
     def optimize_index(self, index_name: str, mode: str = "quick") -> None:
-        """Compact small per-bucket index files (extension; quick/full modes)."""
-        self._manager.optimize(index_name, mode)
+        """Compact small per-bucket index files (extension; quick/full modes).
+        Physically removes rows folded as deleted by incremental refreshes and
+        clears the entry's delete set. Like refresh, runs as a BATCH-lane
+        citizen under the serving scheduler's yield gate."""
+        from . import resilience
+        from .telemetry import tracing
+
+        with resilience.query_scope("build:optimize_index"):
+            with resilience.lane_scope("batch"):
+                with tracing.query_span(
+                    "build:optimize_index", index_name=index_name, mode=mode
+                ):
+                    self._manager.optimize(index_name, mode)
 
     def cancel(self, index_name: str) -> None:
         self._manager.cancel(index_name)

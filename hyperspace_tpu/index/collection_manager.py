@@ -136,34 +136,84 @@ class IndexCollectionManager(IndexManager):
         quarantine.clear(index_config.index_name)
 
     def refresh(self, index_name: str, mode: str = "full") -> None:
+        import time as _time
+
         from ..actions.refresh import RefreshIncrementalAction
+        from ..telemetry import metrics as _metrics
 
         log_mgr, data_mgr, index_path = self._existing_log_manager(index_name)
         latest = data_mgr.get_latest_version_id()
         next_version = 0 if latest is None else latest + 1
         builder = self._builder_for_entry(log_mgr.get_latest_log())
+
+        def make_action(cls):
+            return cls(
+                builder,
+                log_mgr,
+                index_path,
+                data_mgr.get_path(next_version),
+                self._event_logger(),
+            )
+
         if mode == "incremental":
-            action_cls = RefreshIncrementalAction
+            action = make_action(RefreshIncrementalAction)
         elif mode == "full":
-            action_cls = RefreshAction
+            action = make_action(RefreshAction)
+        elif mode == "auto":
+            # Serving-loop mode (docs/reliability.md "Live tables"): take the
+            # cheap incremental path whenever its preconditions hold, fall
+            # back to a full rebuild when they don't (modified-in-place files,
+            # deletes without lineage, no per-file signatures), and NO-OP when
+            # the index already covers the current source. The fallback is
+            # decided by validate() alone — a failure past begin() propagates,
+            # never silently re-runs as full.
+            from ..actions.refresh import NothingToRefreshError
+            from . import quarantine as _quarantine
+
+            action = make_action(RefreshIncrementalAction)
+            try:
+                action.validate()
+            except NothingToRefreshError:
+                if not _quarantine.is_quarantined(index_name):
+                    return  # already fresh: refresh is a no-op
+                # Fresh but QUARANTINED (corrupt data file): the serving
+                # loop's auto refresh is the documented remediation path, so
+                # rebuild full instead of no-opping forever.
+                action = make_action(RefreshAction)
+            except HyperspaceException:
+                # Not incrementally refreshable (modified-in-place, deletes
+                # without lineage, missing per-file inventory): full rebuild.
+                action = make_action(RefreshAction)
         else:
             raise HyperspaceException(
-                f"Unsupported refresh mode '{mode}'; supported: full, incremental."
+                f"Unsupported refresh mode '{mode}'; supported: full, "
+                "incremental, auto."
             )
-        action_cls(
-            builder, log_mgr, index_path, data_mgr.get_path(next_version), self._event_logger()
-        ).run()
+        t0 = _time.monotonic()
+        action.run()
+        dt = _time.monotonic() - t0
+        resolved = (
+            "incremental" if isinstance(action, RefreshIncrementalAction) else "full"
+        )
+        _metrics.histogram("refresh.latency").observe(dt)
+        _metrics.histogram(f"refresh.latency.{resolved}").observe(dt)
+        # The refresh covered the current source state by construction.
+        _metrics.gauge(f"index.staleness_s.{index_name}").set(0.0)
         from . import quarantine
 
         quarantine.clear(index_name)
 
     def optimize(self, index_name: str, mode: str = "quick") -> None:
+        import time as _time
+
         from ..actions.optimize import OptimizeAction
+        from ..telemetry import metrics as _metrics
 
         log_mgr, data_mgr, index_path = self._existing_log_manager(index_name)
         latest = data_mgr.get_latest_version_id()
         next_version = 0 if latest is None else latest + 1
         builder = CoveringIndexBuilder(self._session)
+        t0 = _time.monotonic()
         OptimizeAction(
             builder,
             self._session,
@@ -173,6 +223,7 @@ class IndexCollectionManager(IndexManager):
             mode,
             self._event_logger(),
         ).run()
+        _metrics.histogram("compact.latency").observe(_time.monotonic() - t0)
         from . import quarantine
 
         quarantine.clear(index_name)
@@ -214,6 +265,19 @@ class IndexCollectionManager(IndexManager):
             entry = log_mgr.get_latest_log()
             if entry is None:
                 continue
+            if entry.state in states.TRANSIENT_STATES:
+                # A writer's in-flight (or died-in-flight) window: readers
+                # ride the last COMMITTED generation instead of losing the
+                # index for the duration of every refresh/compaction — the
+                # live-table contract (docs/reliability.md "Live tables").
+                # The stable entry's content refers only to committed data
+                # dirs, so this can never see torn files; if no stable entry
+                # exists (a first create in flight), the index sits out
+                # exactly as before.
+                stable = log_mgr.get_latest_stable_log()
+                if stable is None:
+                    continue
+                entry = stable
             if states_filter is None or entry.state in states_filter:
                 out.append(entry)
         return out
@@ -317,7 +381,14 @@ IndexCacheFactory.register(
 
 class CachingIndexCollectionManager(IndexCollectionManager):
     """Read-path cache; every mutating API clears it (reference :77-100). The
-    cache policy comes from `hyperspace.index.cache.type` via the factory."""
+    cache policy comes from `hyperspace.index.cache.type` via the factory.
+
+    Mutations clear the cache BEFORE and AFTER the action: an action takes
+    seconds, and a concurrent reader (the live-table serving mix) repopulates
+    the cache with the pre-commit generation DURING that window — with only
+    the pre-clear, the committed entry stayed invisible for up to the cache
+    TTL after the action returned. The after-clear runs in a `finally` so a
+    failed action's transient orphan is also re-read, not trusted from cache."""
 
     def __init__(self, session: HyperspaceSession, **kwargs):
         super().__init__(session, **kwargs)
@@ -335,30 +406,30 @@ class CachingIndexCollectionManager(IndexCollectionManager):
     def clear_cache(self) -> None:
         self._cache.clear()
 
-    def create(self, df, index_config) -> None:
+    def _mutate(self, fn) -> None:
         self.clear_cache()
-        super().create(df, index_config)
+        try:
+            fn()
+        finally:
+            self.clear_cache()
+
+    def create(self, df, index_config) -> None:
+        self._mutate(lambda: super(CachingIndexCollectionManager, self).create(df, index_config))
 
     def delete(self, index_name: str) -> None:
-        self.clear_cache()
-        super().delete(index_name)
+        self._mutate(lambda: super(CachingIndexCollectionManager, self).delete(index_name))
 
     def restore(self, index_name: str) -> None:
-        self.clear_cache()
-        super().restore(index_name)
+        self._mutate(lambda: super(CachingIndexCollectionManager, self).restore(index_name))
 
     def vacuum(self, index_name: str) -> None:
-        self.clear_cache()
-        super().vacuum(index_name)
+        self._mutate(lambda: super(CachingIndexCollectionManager, self).vacuum(index_name))
 
     def refresh(self, index_name: str, mode: str = "full") -> None:
-        self.clear_cache()
-        super().refresh(index_name, mode)
+        self._mutate(lambda: super(CachingIndexCollectionManager, self).refresh(index_name, mode))
 
     def optimize(self, index_name: str, mode: str = "quick") -> None:
-        self.clear_cache()
-        super().optimize(index_name, mode)
+        self._mutate(lambda: super(CachingIndexCollectionManager, self).optimize(index_name, mode))
 
     def cancel(self, index_name: str) -> None:
-        self.clear_cache()
-        super().cancel(index_name)
+        self._mutate(lambda: super(CachingIndexCollectionManager, self).cancel(index_name))

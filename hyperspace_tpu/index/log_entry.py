@@ -347,6 +347,15 @@ class LogEntry:
         return decoder(d)
 
 
+#: `extra` key carrying source-file paths whose rows are still PRESENT in the
+#: index data but logically deleted — folded in by an incremental refresh that
+#: observed the files vanish (`actions/refresh.RefreshIncrementalAction`).
+#: Readers prune these rows at scan time via the lineage column
+#: (`rules.rule_utils.lineage_prune_condition`); the set is physically
+#: compacted away (and this key cleared) by the next optimize or full rewrite.
+DELETED_SOURCE_FILES_KEY = "deletedSourceFiles"
+
+
 class IndexLogEntry(LogEntry):
     """The full index metadata record (reference `IndexLogEntry.scala:285-334`)."""
 
@@ -398,6 +407,25 @@ class IndexLogEntry(LogEntry):
         if len(sigs) != 1:
             raise ValueError(f"expected exactly one signature, got {len(sigs)}")
         return sigs[0]
+
+    def has_lineage(self) -> bool:
+        """Whether the index data carries the per-row source-file lineage
+        column (`_data_file_name`) — the precondition for delete folding."""
+        from ..config import IndexConstants
+        from ..engine.schema import Schema
+
+        target = IndexConstants.DATA_FILE_NAME_COLUMN.lower()
+        return any(
+            n.lower() == target
+            for n in Schema.from_json_string(self.schema_json).names
+        )
+
+    def deleted_source_files(self) -> List[str]:
+        """Source-file paths whose rows remain in the index data but were
+        folded as deleted by an incremental refresh (pruned at scan time via
+        lineage; cleared by compaction / full rewrite)."""
+        v = self.extra.get(DELETED_SOURCE_FILES_KEY)
+        return list(v) if v else []
 
     def index_location(self) -> str:
         """Root directory of the index data (common prefix of content files — may

@@ -107,7 +107,17 @@ def host_merge_pairs(lk: np.ndarray, rk: np.ndarray) -> Tuple[np.ndarray, np.nda
 
 
 def nonzero_indices(mask) -> np.ndarray:
-    """Compact a device boolean mask into host row indices (one scalar sync)."""
+    """Compact a device boolean mask into host row indices (one scalar sync).
+
+    CPU backend: plain numpy. `jnp.nonzero(mask, size=n)` compiles per
+    distinct (shape, n) — and n is the SURVIVOR COUNT, so every new filter
+    literal (or index generation's new file shape) minted ~16 eager-op
+    compiles ≈ 300 ms on the interactive point-lookup path (the PR-2
+    varying-survivor-count lesson, applied to the general filter path)."""
+    from .backend import use_device_path
+
+    if not use_device_path():
+        return np.nonzero(np.asarray(mask))[0].astype(np.int64, copy=False)
     mask = jnp.asarray(mask)
     n = int(mask.sum())
     if n == 0:

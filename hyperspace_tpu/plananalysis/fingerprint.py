@@ -59,6 +59,8 @@ FLAG_KEYS = (
     "HYPERSPACE_MESH_ROW_QUANTUM",
     "HYPERSPACE_PALLAS_PROBE",
     "HYPERSPACE_PALLAS_SORT",
+    "HYPERSPACE_PRED_FUSE_MAX_CLASSES",
+    "HYPERSPACE_PRED_FUSE_MIN_ROWS",
     "HYPERSPACE_QUERY_CHUNK_ROWS",
     "HYPERSPACE_QUERY_PREFETCH_FILES",
     "HYPERSPACE_QUERY_STREAMING",

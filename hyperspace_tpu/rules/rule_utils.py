@@ -33,7 +33,14 @@ def get_candidate_indexes(
     is recoverable: appended files are carried to merge at execution time, and
     files that vanished are tolerated iff the index records lineage — their
     rows are pruned at scan time (`hybrid_delta`). A file changed IN PLACE
-    always disqualifies."""
+    always disqualifies.
+
+    Deletes an incremental refresh already FOLDED into the log entry
+    (`entry.deleted_source_files()`, docs/reliability.md "Live tables") ride
+    every candidate — exact matches included: the refreshed signature covers
+    the post-delete source, but the index DATA still holds those rows until
+    compaction physically removes them, so the scan-time lineage prune is
+    mandatory on every path."""
     signature_map: Dict[str, Optional[str]] = {}
 
     def signature_valid(entry: IndexLogEntry) -> bool:
@@ -104,13 +111,38 @@ def get_candidate_indexes(
             # co-location (and bloom probing) with the CURRENT scheme would
             # be silently wrong — the index must sit out until refreshed.
             continue
+        folded = e.deleted_source_files()
         if signature_valid(e):
-            out.append(CandidateIndex(e, []))
+            out.append(CandidateIndex(e, [], folded))
+            _update_staleness(e, [])
         elif hybrid_scan:
             delta = hybrid_delta(e)
             if delta is not None:
-                out.append(CandidateIndex(e, delta[0], delta[1]))
+                out.append(
+                    CandidateIndex(
+                        e, delta[0], sorted(set(delta[1]) | set(folded))
+                    )
+                )
+                _update_staleness(e, delta[0])
     return out
+
+
+def _update_staleness(entry: IndexLogEntry, appended) -> None:
+    """Refresh the per-index `index.staleness_s.<name>` gauge: now − the
+    newest UNINDEXED source file's mtime (0 when the index covers the current
+    source). Updated wherever the engine actually diffs an index against the
+    live source — candidate selection here, and the refresh path
+    (`index.collection_manager`)."""
+    import time
+
+    from ..telemetry import metrics
+
+    if not appended:
+        staleness = 0.0
+    else:
+        newest_ms = max(f.modified_time for f in appended)
+        staleness = max(0.0, time.time() - newest_ms / 1000.0)
+    metrics.gauge(f"index.staleness_s.{entry.name}").set(round(staleness, 3))
 
 
 def _hash_scheme_compatible(entry: IndexLogEntry) -> bool:
@@ -126,12 +158,7 @@ def _hash_scheme_compatible(entry: IndexLogEntry) -> bool:
 
 def _has_lineage(entry: IndexLogEntry) -> bool:
     """Whether the index data carries the per-row source-file lineage column."""
-    from ..config import IndexConstants
-    from ..engine.schema import Schema
-
-    target = IndexConstants.DATA_FILE_NAME_COLUMN.lower()
-    schema = Schema.from_json_string(entry.schema_json)
-    return any(n.lower() == target for n in schema.names)
+    return entry.has_lineage()
 
 
 def lineage_prune_condition(deleted: List[str]):

@@ -21,6 +21,14 @@ real operation):
 - ``serve.admit``  — a serving-layer admission decision
   (`serve.admission.AdmissionController.admit`; the chaos mixed-workload leg
   injects here to prove scheduling faults never change query results)
+- ``refresh.merge``— the incremental-refresh merge window: after the delta
+  version dir committed, before the merged log entry lands
+  (`actions.refresh.RefreshIncrementalAction.op`; a ``hang`` here is the
+  SIGKILL window between data commit and log commit)
+- ``compact.commit``— the compaction commit window: after every compacted
+  bucket file is staged, before the atomic rename publishes the version dir
+  (`actions.optimize.OptimizeAction.op`; a ``hang`` here is the
+  SIGKILL-mid-compaction window)
 
 Configuration — ``HYPERSPACE_FAULTS`` (comma-separated specs) or the
 programmatic API (`configure` / `inject`, which take precedence over the env):
@@ -71,6 +79,8 @@ FAULT_POINTS = (
     "pool.worker",
     "device.compile",
     "serve.admit",
+    "refresh.merge",
+    "compact.commit",
 )
 
 _INJECTED = _metrics.counter("faults.injected")

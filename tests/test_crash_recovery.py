@@ -196,6 +196,168 @@ def test_sigkill_mid_build_is_recoverable(
     _recover_and_compare(tmp_path, src, syspath, clean, monkeypatch)
 
 
+# ---------------------------------------------------------------------------
+# Live-table crash matrix (ISSUE 12): SIGKILL at every commit window of the
+# incremental-refresh and compaction paths — mid-delta-write, between the
+# delta data commit and the log commit, and mid-compaction. The next reader
+# stays on the old generation, the next refresher/compactor recovers, and the
+# fully-recovered end state is byte-identical to a clean build.
+# ---------------------------------------------------------------------------
+
+_LIVE_CHILD = """
+import os, sys
+sys.path.insert(0, {repo!r})
+from hyperspace_tpu import Hyperspace, IndexConfig
+from hyperspace_tpu.engine.session import HyperspaceSession
+
+s = HyperspaceSession(warehouse={warehouse!r})
+s.conf.set("hyperspace.system.path", {syspath!r})
+s.conf.set("hyperspace.index.num.buckets", "2")
+Hyperspace(s).{action}
+print("ACTION DONE", flush=True)
+"""
+
+
+def _live_session(tmp_path, syspath, monkeypatch):
+    from hyperspace_tpu.engine.session import HyperspaceSession
+
+    monkeypatch.setenv("HYPERSPACE_BUILD_DECODE_THREADS", "1")
+    s = HyperspaceSession(warehouse=str(tmp_path))
+    s.conf.set("hyperspace.system.path", syspath)
+    s.conf.set("hyperspace.index.num.buckets", "2")
+    return s
+
+
+def _spawn_live_action(tmp_path, syspath, action, fault_spec):
+    env = dict(os.environ)
+    env.update(
+        {
+            "JAX_PLATFORMS": "cpu",
+            "HYPERSPACE_BUILD_DECODE_THREADS": "1",
+            "HYPERSPACE_FAULTS": fault_spec,
+            "PYTHONPATH": REPO,
+        }
+    )
+    script = _LIVE_CHILD.format(
+        repo=REPO, warehouse=str(tmp_path), syspath=syspath, action=action
+    )
+    return subprocess.Popen(
+        [sys.executable, "-c", script],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+    )
+
+
+def _append_batch(src):
+    from hyperspace_tpu.engine import io as eio
+    from hyperspace_tpu.engine.table import Table
+
+    eio.write_parquet(
+        Table.from_pydict({"k": [9001, 9002, 9003], "v": [1, 2, 3]}),
+        os.path.join(src, "part-00009.parquet"),
+    )
+
+
+@pytest.mark.parametrize(
+    "action,fault_spec,wait_marker",
+    [
+        # Window A: SIGKILL INSIDE a delta bucket-file write — the delta only
+        # ever existed in the invisible staging dir.
+        ('refresh_index("idx", mode="incremental")', "storage.write:1.0:hang600", "staging"),
+        # Window B: SIGKILL between the delta DATA commit (v__=1 renamed into
+        # place) and the merged LOG commit (`refresh.merge` fault point).
+        ('refresh_index("idx", mode="incremental")', "refresh.merge:1.0:hang600", "vdir1"),
+        # Window C: SIGKILL mid-compaction — every compacted bucket staged,
+        # the atomic rename not reached (`compact.commit` fault point).
+        ('optimize_index("idx")', "compact.commit:1.0:hang600", "staging"),
+    ],
+)
+def test_sigkill_live_table_windows_recover(
+    tmp_path, monkeypatch, action, fault_spec, wait_marker
+):
+    import hashlib
+
+    from hyperspace_tpu import Hyperspace, IndexConfig
+    from hyperspace_tpu.engine.expr import col
+    from hyperspace_tpu.engine.scan_cache import global_concat_cache, global_scan_cache
+    from hyperspace_tpu.hyperspace import enable_hyperspace
+    from hyperspace_tpu.index.log_manager import IndexLogManagerImpl
+    from hyperspace_tpu.index.staging import STAGING_PREFIX
+
+    src = _write_source(tmp_path)
+    syspath = str(tmp_path / "indexes_live")
+    idx_path = os.path.join(syspath, "idx")
+    s = _live_session(tmp_path, syspath, monkeypatch)
+    hs = Hyperspace(s)
+    hs.create_index(s.read.parquet(src), IndexConfig("idx", ["k"], ["v"]))
+    _append_batch(src)
+    if action.startswith("optimize"):
+        # The compaction windows need accumulated delta files first.
+        hs.refresh_index("idx", mode="incremental")
+
+    proc = _spawn_live_action(tmp_path, syspath, action, fault_spec)
+    try:
+        if wait_marker == "staging":
+            _wait_for(
+                lambda: any(n.startswith(STAGING_PREFIX) for n in os.listdir(idx_path)),
+                what="staging dir to appear",
+            )
+        else:
+            _wait_for(
+                lambda: os.path.isdir(os.path.join(idx_path, "v__=1")),
+                what="committed delta version dir to appear",
+            )
+        time.sleep(0.3)  # let the child reach (and block inside) the hang
+        assert proc.poll() is None, (
+            "child finished before the kill window: "
+            + proc.stdout.read().decode()
+            + proc.stderr.read().decode()
+        )
+    finally:
+        proc.kill()  # SIGKILL — no handlers, no cleanup
+        proc.wait(timeout=30)
+
+    # 1) The NEXT READER recovers to a consistent generation: rows correct
+    #    (old index generation or source scan — never torn index data).
+    global_scan_cache().clear()
+    global_concat_cache().clear()
+    hs._manager.clear_cache()
+    enable_hyperspace(s)
+    rows = s.read.parquet(src).filter(col("k") == 7).select("k", "v").collect().rows()
+    assert rows == [(7, 2)]
+
+    # 2) The NEXT REFRESHER/COMPACTOR recovers: the same action the child
+    #    died in now completes, then compaction converges the layout.
+    hs._manager.clear_cache()
+    if action.startswith("refresh"):
+        hs.refresh_index("idx", mode="incremental")
+    hs.optimize_index("idx")
+
+    leftovers = [n for n in os.listdir(idx_path) if n.startswith(STAGING_PREFIX)]
+    assert leftovers == [], leftovers
+    stable = IndexLogManagerImpl(idx_path).get_latest_stable_log()
+    assert stable is not None and stable.state == "ACTIVE"
+
+    # 3) End state byte-identical to a clean from-scratch build of the same
+    #    (post-append) source.
+    s2 = _live_session(tmp_path, str(tmp_path / "indexes_clean"), monkeypatch)
+    hs2 = Hyperspace(s2)
+    hs2.create_index(s2.read.parquet(src), IndexConfig("idx", ["k"], ["v"]))
+    clean_entry = [e for e in hs2._manager.get_indexes() if e.name == "idx"][0]
+    recovered = [e for e in hs._manager.get_indexes() if e.name == "idx"][0]
+    sha = lambda p: hashlib.sha256(open(p, "rb").read()).hexdigest()  # noqa: E731
+    assert {os.path.basename(p): sha(p) for p in recovered.content.files()} == {
+        os.path.basename(p): sha(p) for p in clean_entry.content.files()
+    }
+
+    # 4) And the recovered index serves queries.
+    global_scan_cache().clear()
+    global_concat_cache().clear()
+    rows = s.read.parquet(src).filter(col("k") == 9002).select("v").collect().rows()
+    assert rows == [(2,)]
+
+
 _EXPORTER_CHILD = """
 import os, sys, time
 sys.path.insert(0, {repo!r})

@@ -365,14 +365,20 @@ def test_device_array_memo_budget_and_identity():
         dc._cache.update(saved_cache)
 
 
-def test_compiled_predicate_cache_hits_and_str_fallback(tmp_path):
+def test_compiled_predicate_cache_hits_and_str_fallback(tmp_path, monkeypatch):
     """evaluate_predicate compiles one program per expression shape, hits the
     cache on repeats, and permanently falls back for trace-unsafe shapes
-    (cross-column string compares) without breaking correctness."""
+    (cross-column string compares) without breaking correctness.
+
+    Pinned under HYPERSPACE_PRED_FUSE_MIN_ROWS=0 (always fuse): on the CPU
+    backend, small tables route to the eager pow2-padded path by default and
+    never touch the fused-program cache this test is about."""
     import numpy as np
 
     import hyperspace_tpu.engine.evaluate as ev
     from hyperspace_tpu.engine import HyperspaceSession, col
+
+    monkeypatch.setenv("HYPERSPACE_PRED_FUSE_MIN_ROWS", "0")
 
     s = HyperspaceSession(warehouse=str(tmp_path))
     s.write_parquet(
