@@ -468,6 +468,12 @@ def run_bench(deadline: float = None) -> dict:
 
         # -- measured device kernels + cache pressure ----------------------
         ph.run("device", lambda: d.update(_device_section(s, base, col, runs, backend)))
+        # -- device cost observatory: the same engine under probes — per-label
+        #    device time, transfer + padding ledgers, effective GB/s vs a
+        #    measured memcpy peak
+        ph.run("device_observatory", lambda: d.__setitem__(
+            "device_observatory", _device_observatory_section(s, base, col, runs)
+        ))
         ph.run(
             "eviction_stress",
             lambda: d.update(_eviction_stress(s, q3_join_only, d)),
@@ -1964,6 +1970,86 @@ def _device_section(s, base, col, runs, backend) -> dict:
         out["achieved_gbps"] = round(achieved / 1e9, 2)
         out["peak_gbps"] = round(peak / 1e9, 1)
         out["utilization"] = round(achieved / peak, 4)
+    return out
+
+
+def _device_observatory_section(s, base, col, runs) -> dict:
+    """`bench_detail.device_observatory`: run a representative join+agg mix
+    with ``HYPERSPACE_DEVICE_TIMING=all`` and report what the observatory
+    attributed — per-label device time, H2D/D2H bytes (+seconds where
+    timed), per-site pow2 pad ratios, and the effective H2D GB/s next to a
+    MEASURED host memcpy peak (numpy copy of a 64 MiB buffer — the honest
+    ceiling for a CPU 'transfer', which is a memcpy)."""
+    if os.environ.get("BENCH_SKIP_OBSERVATORY") == "1":
+        return {"skipped": True}
+    import numpy as np
+
+    from hyperspace_tpu.telemetry import device_observatory as _devobs
+
+    l = s.read.parquet(os.path.join(base, "lineitem"))
+    o = s.read.parquet(os.path.join(base, "orders"))
+
+    def mix():
+        l.join(o, col("orderkey") == col("o_orderkey")).select(
+            "qty", "o_custkey"
+        ).collect()
+        l.filter(col("qty") > 25).group_by("orderkey").agg(
+            p=("price", "sum")
+        ).collect()
+
+    saved = {
+        k: os.environ.get(k)
+        for k in ("HYPERSPACE_DEVICE_TIMING", "HYPERSPACE_DEVICE_TIMING_INTERVAL_S")
+    }
+    os.environ["HYPERSPACE_DEVICE_TIMING"] = "all"
+    os.environ["HYPERSPACE_DEVICE_TIMING_INTERVAL_S"] = "0"
+    _devobs.reset()
+    try:
+        from hyperspace_tpu.engine import physical as phys
+
+        mix()  # warm/compile pass
+        # Measured pass: compiles are warm but the device memos are cleared,
+        # so the staging/pad/transfer work actually happens and is attributed
+        # (a fully-memoized pass would honestly report all-zeros).
+        phys.clear_device_memos()
+        _devobs.reset()
+        t0 = _now()
+        mix()
+        wall = _now() - t0
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    programs = _devobs.device_summary()
+    transfers = _devobs.transfer_summary()
+    pads = _devobs.pad_summary()
+    dev_total = round(sum(p["device_s"] for p in programs.values()), 6)
+    top = sorted(programs.items(), key=lambda kv: -kv[1]["device_s"])[:12]
+
+    # Measured memcpy peak: what "H2D" can possibly sustain on this host.
+    buf = np.ones(64 * 1024 * 1024 // 8, dtype=np.float64)
+    dst = np.empty_like(buf)
+    t0 = _now()
+    np.copyto(dst, buf)
+    memcpy_s = max(_now() - t0, 1e-9)
+    memcpy_gbps = round(buf.nbytes / memcpy_s / 1e9, 2)
+
+    out = {
+        "wall_s": round(wall, 4),
+        "device_time_s": dev_total,
+        "device_share": round(dev_total / wall, 4) if wall else None,
+        "programs_top": {lbl: p for lbl, p in top},
+        "programs_total": len(programs),
+        "transfers": transfers,
+        "pads": pads,
+        "memcpy_peak_gbps": memcpy_gbps,
+    }
+    h2d = transfers.get("h2d") or {}
+    if h2d.get("gb_per_s") is not None:
+        out["h2d_vs_memcpy_peak"] = round(h2d["gb_per_s"] / memcpy_gbps, 4)
     return out
 
 

@@ -15,12 +15,17 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..telemetry import accounting as _accounting
+from ..telemetry import device_observatory as _devobs
 from ..telemetry import metrics as _metrics
 
 # Bound once: device_array is the hottest instrumented path (every device op
 # over cached host columns) — per-call cost is one locked int add.
 _HITS = _metrics.counter("cache.device_upload.hits")
 _MISSES = _metrics.counter("cache.device_upload.misses")
+# Footprint watermarks (exporter frames / prometheus): live bytes pinned by
+# the upload memo, and the high-water mark across the process lifetime.
+_CACHE_BYTES = _metrics.gauge("cache.device_upload.bytes")
+_CACHE_BYTES_PEAK = _metrics.gauge("cache.device_upload.bytes_peak")
 
 _cache: dict = {}  # id(host) -> (weakref, device_array); insertion order = LRU
 # Device copies are pinned until their host arrays die (the scan cache bounds
@@ -33,6 +38,13 @@ _bytes = 0
 _lock = threading.RLock()
 
 
+def _note_bytes() -> None:
+    """Publish the live footprint + high-water mark (called with `_lock`
+    held, after any `_bytes` mutation)."""
+    _CACHE_BYTES.set(_bytes)
+    _CACHE_BYTES_PEAK.set_max(_bytes)
+
+
 def _evict_over_budget(protect_key) -> None:
     global _bytes
     while _bytes > _BUDGET:
@@ -42,6 +54,7 @@ def _evict_over_budget(protect_key) -> None:
         dropped = _cache.pop(victim, None)
         if dropped is not None:
             _bytes -= int(dropped[1].nbytes)
+            _note_bytes()
 
 
 def device_array(host: np.ndarray):
@@ -58,9 +71,21 @@ def device_array(host: np.ndarray):
             return hit[1]
 
     _MISSES.inc()
-    dev = jnp.asarray(host)
-    # Upload-miss = a real host→device transfer this query caused.
+    # Upload-miss = a real host→device transfer this query caused. Timing it
+    # requires forcing the (async) transfer to completion, so seconds only
+    # arrive under HYPERSPACE_DEVICE_TIMING — bytes and count always.
+    if _devobs.timing_mode():
+        import time as _time
+
+        t0 = _time.monotonic()
+        dev = jnp.asarray(host)
+        dev.block_until_ready()
+        upload_s = _time.monotonic() - t0
+    else:
+        dev = jnp.asarray(host)
+        upload_s = None
     _accounting.add("device_upload_bytes", int(dev.nbytes))
+    _devobs.record_h2d(int(dev.nbytes), upload_s)
 
     def _evict(wr, key=key):
         # Only drop the entry this weakref installed: a dead array's id can be
@@ -71,6 +96,7 @@ def device_array(host: np.ndarray):
             if ent_now is not None and ent_now[0] is wr:
                 _cache.pop(key, None)
                 _bytes -= int(ent_now[1].nbytes)
+                _note_bytes()
 
     try:
         ref = weakref.ref(host, _evict)
@@ -84,5 +110,6 @@ def device_array(host: np.ndarray):
             _bytes -= int(hit[1].nbytes)  # displaced stale entry leaves accounting
         _cache[key] = (ref, dev)
         _bytes += int(dev.nbytes)
+        _note_bytes()
         _evict_over_budget(key)
     return dev

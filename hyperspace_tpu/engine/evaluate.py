@@ -662,14 +662,22 @@ def _pow2_padded_eager_mask(expr: Expr, table: Table):
     try:
         spellings = _collect_col_spellings(expr)
         cols = {}
+        pad_payload = pad_padded = 0
         for sp in spellings:
             c = table.column(sp)
             data = np.asarray(c.data)
+            pad_payload += n * int(data.dtype.itemsize)
+            pad_padded += (m - n) * int(data.dtype.itemsize)
             data = np.concatenate([data, np.zeros(m - n, dtype=data.dtype)])
             valid = None
             if c.validity is not None:
                 valid = np.concatenate([c.validity, np.zeros(m - n, dtype=bool)])
+                pad_payload += n
+                pad_padded += m - n
             cols[sp] = Column(c.dtype, data, c.dictionary, valid)
+        from ..telemetry import device_observatory as _devobs
+
+        _devobs.record_pad("eval_mask", pad_payload, pad_padded)
     except Exception:
         return None
     mask = _evaluate_predicate_eager(expr, Table(cols))

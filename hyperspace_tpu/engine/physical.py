@@ -1633,6 +1633,17 @@ _MEMO_HITS = {t: _metrics.counter(f"memo.{t}.hits") for t in _CACHES}
 _MEMO_MISSES = {t: _metrics.counter(f"memo.{t}.misses") for t in _CACHES}
 _MEMO_PEEK_HITS = {t: _metrics.counter(f"memo.{t}.peek_hits") for t in _CACHES}
 _MEMO_EVICTIONS = _metrics.counter("memo.evictions")
+# Footprint watermarks (device cost observatory): live device bytes pinned by
+# the join memos, and the process-lifetime high-water mark.
+_MEMO_BYTES = _metrics.gauge("memo.device_cache.bytes")
+_MEMO_BYTES_PEAK = _metrics.gauge("memo.device_cache.bytes_peak")
+
+
+def _note_memo_bytes() -> None:
+    """Publish the memo footprint gauges (called with `_cache_lock` held,
+    after any `_device_cache_bytes` mutation)."""
+    _MEMO_BYTES.set(_device_cache_bytes)
+    _MEMO_BYTES_PEAK.set_max(_device_cache_bytes)
 
 # Concurrent queries (thread-local active sessions) share these memos; the
 # byte accounting is read-modify-write and eviction iterates the recency dict,
@@ -1701,6 +1712,7 @@ def clear_device_memos() -> None:
             c.clear()
         _recency.clear()
         _device_cache_bytes = 0
+        _note_memo_bytes()
 
 
 def _drop_entry(tag: str, key) -> None:
@@ -1710,6 +1722,7 @@ def _drop_entry(tag: str, key) -> None:
         dropped = _CACHES[tag].pop(key, None)
         if dropped is not None:
             _device_cache_bytes -= _entry_nbytes(tag, dropped)
+            _note_memo_bytes()
 
 
 def _evict_over_budget(protect: tuple) -> None:
@@ -1803,6 +1816,7 @@ def _cached_by_table(cache: Dict[int, tuple], table: Table, subkey, compute):
             else:
                 val = ent[1][subkey]  # raced: keep the first insert's accounting
             _touch(tag, key)
+            _note_memo_bytes()
             _evict_over_budget((tag, key))
         return val
 
@@ -1885,6 +1899,7 @@ def _cached_two_table(
             cache[key] = (weakref.ref(left, _evict), weakref.ref(right, _evict), val)
             _device_cache_bytes += _val_nbytes(val)
             _touch(tag, key)
+            _note_memo_bytes()
             _evict_over_budget((tag, key))
         return val
 

@@ -399,6 +399,19 @@ def stream_join_aggregate(agg_exec, join_exec, chain, ctx) -> Optional[Table]:
         from collections import deque
         from concurrent.futures import ThreadPoolExecutor
 
+        from ..telemetry import accounting as _accounting
+
+        # Workers adopt the submitting query's ledger and deadline scope
+        # (the io.py pool contract): without this, chunk work on pool
+        # threads — including any XLA compiles its device programs trigger —
+        # billed to NOTHING instead of the query that caused it.
+        led = _accounting.current_ledger()
+        sc = resilience.current_scope()
+
+        def build_chunk_adopted(lo: int, hi: int):
+            with _accounting.use_ledger(led), resilience.use_scope(sc):
+                return build_chunk(lo, hi)
+
         pool = ThreadPoolExecutor(max_workers=workers)
         try:
             pending: "deque" = deque()
@@ -409,7 +422,7 @@ def stream_join_aggregate(agg_exec, join_exec, chain, ctx) -> Optional[Table]:
                 # resident chunk memory bounded while the NEXT chunk's
                 # verify/gather overlaps this one's aggregator fold.
                 while i < len(slices) and len(pending) < workers + 1:
-                    pending.append(pool.submit(build_chunk, *slices[i]))
+                    pending.append(pool.submit(build_chunk_adopted, *slices[i]))
                     i += 1
                 consume(pending.popleft().result())
         finally:

@@ -33,6 +33,7 @@ from ..engine.schema import BOOL, FLOAT32, FLOAT64, INT32, INT64, STRING
 from ..engine.table import Column, Table
 from ..exceptions import HyperspaceException
 from ..engine.device_cache import device_array
+from ..telemetry import device_observatory as _devobs
 from ..telemetry.compile_log import observed_jit as _observed_jit
 from .hashing import key64
 
@@ -1224,10 +1225,18 @@ class StreamAggregator:
         buffer-donating, off-CPU) program quantized to pow2 segment counts."""
         n = t.num_rows
         cap = _pow2_ceil(n)
+        staged_bytes = [0, 0]  # [payload, pow2 padding] across all lanes
+
+        def _stage(host_arr):
+            # One pow2-padded H2D staging lane; the split feeds the padding
+            # ledger once all lanes are up (`pad.agg_partials.*`).
+            sz = int(np.asarray(host_arr).dtype.itemsize)
+            staged_bytes[0] += n * sz
+            staged_bytes[1] += (cap - n) * sz
+            return jax.device_put(_pad_repeat_first(host_arr, cap))
+
         key_cols = [t.column(k) for k in self.group_keys]
-        staged_keys = [
-            jax.device_put(_pad_repeat_first(c.data, cap)) for c in key_cols
-        ]
+        staged_keys = [_stage(c.data) for c in key_cols]
         k64 = key64(key_cols, staged_keys)
         flat, has_valid = [], []
         staged_valid = []
@@ -1235,7 +1244,7 @@ class StreamAggregator:
             flat.append(arr)
             has_valid.append(c.validity is not None)
             if c.validity is not None:
-                sv = jax.device_put(_pad_repeat_first(c.validity, cap))
+                sv = _stage(c.validity)
                 staged_valid.append(sv)
                 flat.append(sv)
             else:
@@ -1251,11 +1260,13 @@ class StreamAggregator:
         for k, c, arr, sv in zip(
             self.group_keys, key_cols, staged_keys, staged_valid
         ):
-            data = np.asarray(arr[rep_rows])[:n_groups]
+            data = _devobs.to_host(arr[rep_rows])[:n_groups]
             v = (
                 None
                 if sv is None
-                else np.asarray(sv[rep_rows], dtype=bool)[:n_groups].copy()
+                else _devobs.to_host(sv[rep_rows])
+                .astype(bool, copy=False)[:n_groups]
+                .copy()
             )
             if c.is_string:
                 codes = data.astype(np.int32)
@@ -1277,15 +1288,20 @@ class StreamAggregator:
                 lanes.append(jnp.zeros(cap, jnp.int32))
                 continue
             specs.append((sfn, col.validity is not None))
-            lanes.append(jax.device_put(_pad_repeat_first(col.data, cap)))
+            lanes.append(_stage(col.data))
             if col.validity is not None:
-                lanes.append(jax.device_put(_pad_repeat_first(col.validity, cap)))
+                lanes.append(_stage(col.validity))
+        _devobs.record_pad("agg_partials", staged_bytes[0], staged_bytes[1])
+        _devobs.record_h2d(staged_bytes[0] + staged_bytes[1])
         row_valid = jnp.arange(cap) < n
         donate = jax.default_backend() != "cpu"
         results = jax.device_get(
             _stream_reduce_fn(len(lanes), donate)(
                 tuple(specs), n_seg, gid, perm, row_valid, *lanes
             )
+        )
+        _devobs.record_d2h(
+            sum(int(getattr(r, "nbytes", 0) or 0) for r in results)
         )
         states = []
         for i, (_out, fn, cname) in enumerate(self.aggs):

@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..telemetry import device_observatory as _devobs
 from ..telemetry.compile_log import observed_jit as _observed_jit
 
 _PAD = jnp.iinfo(jnp.int64).max
@@ -326,7 +327,17 @@ def pad_buckets_by_value(vals, starts_np: np.ndarray) -> Optional[PaddedBuckets]
     keys, lengths, ok = _pad_only(vals, jnp.asarray(starts_np), B, cap, pad)
     if not bool(ok):
         return None
+    _record_bucket_pad(int(starts_np[-1]), B, cap, int(vals.dtype.itemsize))
     return PaddedBuckets(keys, lengths, None, starts_np, "value")
+
+
+def _record_bucket_pad(rows: int, B: int, cap: int, itemsize: int) -> None:
+    """Padding-tax ledger for one padded [B, cap] key matrix: `rows` real
+    keys staged inside B×cap slots (the cost the size-classed layout exists
+    to shrink — now measured per query, not modeled)."""
+    _devobs.record_pad(
+        "join_buckets", rows * itemsize, (B * cap - rows) * itemsize
+    )
 
 
 def pad_buckets_by_hash(key64_arr, starts_np: np.ndarray) -> PaddedBuckets:
@@ -354,12 +365,14 @@ def pad_buckets_by_hash(key64_arr, starts_np: np.ndarray) -> PaddedBuckets:
                     keys_nudged, jnp.asarray(starts_np), B, cap
                 )
                 keys, order = sort_padded_with_order(padded)
+                _record_bucket_pad(int(starts_np[-1]), B, cap, 8)
                 return PaddedBuckets(
                     keys, lengths, np.asarray(order), starts_np, "hash"
                 )
             except Exception as e:  # Mosaic lowering/runtime problems
                 record_sort_failure(e)
     keys, order, lengths = _pad_and_sort(keys_nudged, jnp.asarray(starts_np), B, cap)
+    _record_bucket_pad(int(starts_np[-1]), B, cap, 8)
     return PaddedBuckets(keys, lengths, np.asarray(order), starts_np, "hash")
 
 
@@ -656,6 +669,13 @@ def _build_side(
             rep.keys, rep.lengths, rep.order, gstarts_pad, int(rep.keys.shape[1])
         )
     B = len(ids)
+    # Classed host build stages its own [B, cap] matrix; the device branch's
+    # tax is recorded inside `pad_buckets_by_*` (no double counting).
+    _devobs.record_pad(
+        "join_class",
+        int(lens.sum()) * int(vals.dtype.itemsize),
+        (B * cap - int(lens.sum())) * int(vals.dtype.itemsize),
+    )
     keys = np.full((B, cap), _host_pad_value(vals.dtype), vals.dtype)
     order = np.zeros((B, cap), np.int64) if mode == "hash" else None
     for k, b in enumerate(ids):

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..telemetry import device_observatory as _devobs
 from ..telemetry.compile_log import observed_jit as _observed_jit
 
 from typing import TYPE_CHECKING
@@ -174,6 +175,7 @@ def host_hash_dictionary(dictionary: np.ndarray, seed: int):
         # invisible to the hash values.
         n_pad = _pow2_len(len(out))
         if n_pad != len(out):
+            _devobs.record_pad("hash_dict", len(out) * 4, (n_pad - len(out)) * 4)
             out = np.concatenate([out, np.zeros(n_pad - len(out), np.uint32)])
     dev = jnp.asarray(out)
 
@@ -278,7 +280,15 @@ def _quantized_row_inputs(device_arrays):
     n = int(jnp.asarray(device_arrays[0]).shape[0])
     if n == 0 or _pow2_len(n) == n:
         return device_arrays, None
-    return [_pad_pow2(a) for a in device_arrays], n
+    padded = [_pad_pow2(a) for a in device_arrays]
+    # Padding-tax ledger: real rows vs the pow2 tail, summed over operands.
+    itemsizes = [int(jnp.asarray(a).dtype.itemsize) for a in device_arrays]
+    _devobs.record_pad(
+        "hash_quantize",
+        sum(n * sz for sz in itemsizes),
+        sum((_pow2_len(n) - n) * sz for sz in itemsizes),
+    )
+    return padded, n
 
 
 def combined_hash_u32(columns, device_arrays, seed: np.uint32):

@@ -154,6 +154,12 @@ def _record_exchange(n_valid: int, n_dev: int, cap: int, lanes) -> None:
         moved += n_dev * n_dev * cap * item
     _EXCHANGE_BYTES_PAYLOAD.inc(payload)
     _EXCHANGE_BYTES_MOVED.inc(moved)
+    # The mesh exchange was the ORIGINAL payload-vs-moved honesty split; it
+    # now also feeds the generalized padding ledger so `pad_ratio` covers
+    # every pow2 staging site with one definition (padding = moved − payload).
+    from ..telemetry import device_observatory as _devobs
+
+    _devobs.record_pad("mesh_exchange", payload, moved - payload)
 
 
 def exchange_rows(

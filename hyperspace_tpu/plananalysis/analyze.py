@@ -222,6 +222,41 @@ def explain_analyze_string(df) -> str:
     else:
         lines.append("  (no ledger recorded)")
 
+    # Device cost section (device_observatory): the device/host wall split
+    # from the sampled execute probes, the transfer bytes both ways, and the
+    # pow2 padding tax. Probes ride HYPERSPACE_DEVICE_TIMING; without it the
+    # split is unknown and says so rather than claiming host-only time.
+    lines.append("")
+    lines.append("Device cost (this query):")
+    if led is not None:
+        d = led.to_dict()
+        dev_s = d.get("device_time_s")
+        if dev_s is not None:
+            wall = d.get("wall_s") or 0.0
+            host_s = d.get("host_time_s", max(0.0, wall - dev_s))
+            pct = f" ({dev_s / wall:.0%} of wall)" if wall else ""
+            lines.append(
+                f"  device={_fmt_seconds(dev_s)}{pct}  host={_fmt_seconds(host_s)}"
+                "  (sampled execute probes; see docs/observability.md)"
+            )
+        else:
+            lines.append(
+                "  device/host split unknown (set HYPERSPACE_DEVICE_TIMING=1 "
+                "to sample execute timing)"
+            )
+        h2d = d.get("device_upload_bytes")
+        d2h = d.get("d2h_bytes")
+        if h2d or d2h:
+            lines.append(f"  transfers: h2d={h2d or 0}B d2h={d2h or 0}B")
+        if d.get("pad_ratio") is not None:
+            lines.append(
+                f"  padding tax: payload={d.get('pad_bytes_payload', 0)}B "
+                f"padded={d.get('pad_bytes_padded', 0)}B "
+                f"pad_ratio={d['pad_ratio']}"
+            )
+    else:
+        lines.append("  (no ledger recorded)")
+
     delta = metrics.counters_delta(snap0, snap1)
     lines.append("")
     # The registry is process-wide: under concurrent queries this section

@@ -71,6 +71,12 @@ _COUNTER_FIELDS = (
     "cache_bytes_charged",
     "cache_bytes_evicted",
     "device_upload_bytes",
+    # Device cost observatory (telemetry/device_observatory.py): bytes pulled
+    # device→host at materialization boundaries, and the pow2 staging split
+    # (real payload vs padding) summed over every pad site the query hit.
+    "d2h_bytes",
+    "pad_bytes_payload",
+    "pad_bytes_padded",
 )
 
 _current: "contextvars.ContextVar[Optional[QueryLedger]]" = contextvars.ContextVar(
@@ -297,20 +303,29 @@ def reset_tenant_rollup() -> None:
 #: point-in-time reading either way, not per-query attribution.
 _DEVICE_SAMPLE_MIN_INTERVAL_S = 1.0
 _device_sample_lock = threading.Lock()
-_device_sample: list = [-_DEVICE_SAMPLE_MIN_INTERVAL_S, None]  # [mono ts, bytes]
+# [claim mono ts, bytes, value mono ts] — the claim ts rate-limits the walk;
+# the value ts is when the reading was actually taken (what age reports).
+_device_sample: list = [-_DEVICE_SAMPLE_MIN_INTERVAL_S, None, None]
 
 
-def _device_live_bytes() -> Optional[int]:
-    """`jax.live_arrays()` byte total, only when jax is ALREADY imported
-    (accounting must never pay the import) and the probe succeeds; sampled
-    at most once per `_DEVICE_SAMPLE_MIN_INTERVAL_S` (stale value reused)."""
+def device_live_bytes_sample() -> "tuple[Optional[int], Optional[float]]":
+    """`jax.live_arrays()` byte total plus the sample's AGE in seconds, only
+    when jax is ALREADY imported (accounting must never pay the import) and
+    the probe succeeds; sampled at most once per
+    `_DEVICE_SAMPLE_MIN_INTERVAL_S`. A reused reading comes back with its
+    real age so consumers (ledger ``device_live_bytes_age_s``, exporter
+    frames) can see the freshness instead of mistaking a stale 1 Hz sample
+    for a live one. Shared by ledger close and the exporter — one walk serves
+    both."""
     jax = sys.modules.get("jax")
     if jax is None:
-        return None
+        return None, None
     now = time.monotonic()
     with _device_sample_lock:
         if now - _device_sample[0] < _DEVICE_SAMPLE_MIN_INTERVAL_S:
-            return _device_sample[1]
+            taken = _device_sample[2]
+            age = (now - taken) if taken is not None else None
+            return _device_sample[1], age
         _device_sample[0] = now  # claim the slot: concurrent closers reuse
     try:
         val = int(sum(int(a.nbytes) for a in jax.live_arrays()))
@@ -318,7 +333,14 @@ def _device_live_bytes() -> Optional[int]:
         val = None
     with _device_sample_lock:
         _device_sample[1] = val
-    return val
+        _device_sample[2] = time.monotonic()
+    if val is not None:
+        _metrics.gauge("device.live_bytes.peak").set_max(val)
+    return val, 0.0
+
+
+def _device_live_bytes() -> Optional[int]:
+    return device_live_bytes_sample()[0]
 
 
 @contextlib.contextmanager
@@ -360,10 +382,28 @@ def ledger_scope(query_id: str, name: str, root=None) -> Iterator[QueryLedger]:
         if wall is None:
             wall = time.monotonic() - t0
         led.wall_s = wall
-        dev = _device_live_bytes()
+        dev, age = device_live_bytes_sample()
         if dev is not None:
             led.add("device_live_bytes", dev)
+            if age is not None:
+                # Freshness signal: a reading reused from inside the 1 Hz
+                # rate-limit window is honest only WITH its age attached.
+                led.set_value("device_live_bytes_age_s", round(age, 3))
             _metrics.gauge("device.live_bytes").set(dev)
+        # Device/host split (device_observatory probes): probed device time
+        # accumulated on the ledger yields the host-side remainder — what
+        # `explain(analyze=True)` renders as the device section.
+        dev_s = led.get("device_time_s")
+        if dev_s:
+            led.set_value("host_time_s", round(max(0.0, wall - dev_s), 6))
+        # Padding-tax ratio: fraction of this query's staged bytes that was
+        # pow2 padding (0.0 = every staged byte was real payload).
+        pad_payload = led.get("pad_bytes_payload")
+        pad_padded = led.get("pad_bytes_padded")
+        if pad_payload or pad_padded:
+            led.set_value(
+                "pad_ratio", round(pad_padded / (pad_payload + pad_padded), 4)
+            )
         # Latency distribution: fed HERE (not at span end) so exporter-only
         # runs still get p50/p99 — and a traced run observes exactly once.
         _metrics.histogram(f"latency.{name.replace(':', '.')}").observe(wall)

@@ -45,6 +45,7 @@ import warnings
 from typing import Dict, Optional
 
 from ..exceptions import CompileTimeoutError
+from . import device_observatory as _devobs
 from . import faults as _faults
 from . import metrics as _metrics
 from . import tracing as _tracing
@@ -270,7 +271,11 @@ def observed_jit(fun=None, *, label: Optional[str] = None, **jit_kwargs):
         stack = getattr(_local, "stack", None)
         if stack is None:
             stack = _local.stack = []
-        stack.append([lbl, False])  # [label, saw-a-trace-event-this-call]
+        cell = [lbl, False]  # [label, saw-a-trace-event-this-call]
+        stack.append(cell)
+        # Device-time probe (HYPERSPACE_DEVICE_TIMING): decided BEFORE
+        # dispatch; one env read when off. See device_observatory.
+        probe_t0 = _devobs.probe_start(lbl)
         if cache_size is not None:
             import time as _time
 
@@ -279,8 +284,12 @@ def observed_jit(fun=None, *, label: Optional[str] = None, **jit_kwargs):
         try:
             limit = compile_timeout_s()
             if limit > 0.0:
-                return _call_under_deadline(jitted, args, kwargs, lbl, limit)
-            return jitted(*args, **kwargs)
+                out = _call_under_deadline(jitted, args, kwargs, lbl, limit, cell)
+            else:
+                out = jitted(*args, **kwargs)
+            if probe_t0 is not None:
+                _devobs.probe_finish(lbl, probe_t0, out, traced=cell[1])
+            return out
         finally:
             stack.pop()
             if cache_size is not None and cache_size() > before:
@@ -299,24 +308,29 @@ def observed_jit(fun=None, *, label: Optional[str] = None, **jit_kwargs):
     return wrapper
 
 
-def _call_under_deadline(fn, args, kwargs, label: str, limit_s: float):
+def _call_under_deadline(fn, args, kwargs, label: str, limit_s: float, cell=None):
     """Run one jitted call on a watchdog thread with a hard deadline. On
     timeout the caller gets a classified, program-attributed
     `CompileTimeoutError`; the abandoned daemon thread may finish its compile
     in the background (XLA compiles are not preemptible), but the query is no
-    longer hostage to it. The worker pushes the program label onto ITS OWN
-    thread-local stack so the monitoring listener still attributes the
-    compile correctly."""
+    longer hostage to it. The worker pushes the CALLER's stack cell onto its
+    own thread-local stack (so the trace flag lands where the caller's device
+    probe reads it) and runs under a COPY of the caller's context, so the
+    monitoring listener's span and ledger attribution — both contextvar
+    reads — see the submitting query, not a blank worker context."""
+    import contextvars as _contextvars
+
     result: list = []
     err: list = []
+    ctx = _contextvars.copy_context()
 
     def run() -> None:
         stack = getattr(_local, "stack", None)
         if stack is None:
             stack = _local.stack = []
-        stack.append([label, False])
+        stack.append(cell if cell is not None else [label, False])
         try:
-            result.append(fn(*args, **kwargs))
+            result.append(ctx.run(fn, *args, **kwargs))
         except BaseException as e:  # re-raised on the calling thread
             err.append(e)
         finally:

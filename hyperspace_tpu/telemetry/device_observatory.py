@@ -1,0 +1,465 @@
+"""Device cost observatory: where device time, transfer bytes, and padding go.
+
+The host side of the engine is measured exhaustively (per-query ledgers,
+compile observatory, workload history) but the device side was nearly blind:
+``xla_compiles`` and ``device_upload_bytes`` existed, yet nothing said where
+device *time* goes per program, what the pow2 padding tax costs outside the
+mesh exchange, or how close any path runs to memory-bandwidth peak. ROADMAP
+items 4 (measured cost model) and 5 (device-resident encoded execution) are
+gated on exactly these numbers. This module is the measurement substrate:
+
+- **Per-program device time** — `probe_start`/`probe_finish` wrap each
+  `observed_jit` dispatch: under ``HYPERSPACE_DEVICE_TIMING`` a sampled (or,
+  with ``=all``, every) call is followed by ``jax.block_until_ready``, and
+  the dispatch→ready wall feeds ``latency.device.<label>`` histograms, a
+  per-label `device_summary`, and the ambient ledger's ``device_time_s``.
+  Calls that TRACED are skipped — compile time is billed separately by the
+  compile observatory, and folding it in here would poison the steady-state
+  execute distribution. Off (the default), the probe is one env read per
+  jit call (the standing one-env-check contract); the sampled mode bounds
+  the synchronization tax to one forced sync per label per interval.
+- **Transfer ledgers** — `record_h2d`/`record_d2h` count bytes and events at
+  the device-cache upload and host-materialization boundaries
+  (``transfer.h2d.*`` / ``transfer.d2h.*``); transfer *seconds* are only
+  timed under ``HYPERSPACE_DEVICE_TIMING`` (timing a transfer forces a
+  sync). `to_host` is the D2H chokepoint: every deliberate device→host
+  materialization funnels through it.
+- **Padding ledgers** — `record_pad(site, payload, padded)` generalizes the
+  mesh-only ``bytes_payload`` vs ``bytes_moved`` honesty split to EVERY pow2
+  staging site (hash quantize, classed join matrices, streaming partials,
+  eager masks): ``pad.bytes_payload|bytes_padded`` globally and per site,
+  mirrored onto the ledger so each query carries its own ``pad_ratio``.
+  These are unconditional integer adds, same always-on philosophy as the
+  registry counters they feed.
+- **Profile capture** — `maybe_capture(reason)` writes ONE bounded profile
+  window into ``HYPERSPACE_PROFILE_DIR`` when an Nσ anomaly or SLO
+  fast-burn fires: a synchronously-written, always-parseable
+  ``capture.json`` manifest (reason, program/device/pad summaries, recent
+  ledgers) plus a ``jax.profiler`` trace collected on a daemon thread for
+  ``HYPERSPACE_PROFILE_WINDOW_S`` seconds where the profiler is available.
+  Rate-limited (``HYPERSPACE_PROFILE_MIN_INTERVAL_S``) and keep-N rotated
+  (``HYPERSPACE_PROFILE_KEEP`` capture directories) so a flapping alert can
+  never fill a disk.
+
+Everything here is import-light: jax is only touched from call sites that
+have it imported by definition (`observed_jit` probes) or inside the
+capture thread.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+from . import metrics as _metrics
+
+ENV_DEVICE_TIMING = "HYPERSPACE_DEVICE_TIMING"
+#: Per-label probe interval in sampled mode ("1"); "all" probes every call.
+ENV_TIMING_INTERVAL_S = "HYPERSPACE_DEVICE_TIMING_INTERVAL_S"
+_DEFAULT_TIMING_INTERVAL_S = 0.25
+
+ENV_PROFILE_DIR = "HYPERSPACE_PROFILE_DIR"
+ENV_PROFILE_KEEP = "HYPERSPACE_PROFILE_KEEP"
+ENV_PROFILE_WINDOW_S = "HYPERSPACE_PROFILE_WINDOW_S"
+ENV_PROFILE_MIN_INTERVAL_S = "HYPERSPACE_PROFILE_MIN_INTERVAL_S"
+_DEFAULT_PROFILE_KEEP = 3
+_DEFAULT_PROFILE_WINDOW_S = 2.0
+_DEFAULT_PROFILE_MIN_INTERVAL_S = 60.0
+
+# Bound once: these ride warm paths (every upload miss / pad staging).
+_H2D_BYTES = _metrics.counter("transfer.h2d.bytes")
+_H2D_COUNT = _metrics.counter("transfer.h2d.count")
+_D2H_BYTES = _metrics.counter("transfer.d2h.bytes")
+_D2H_COUNT = _metrics.counter("transfer.d2h.count")
+_PAD_PAYLOAD = _metrics.counter("pad.bytes_payload")
+_PAD_PADDED = _metrics.counter("pad.bytes_padded")
+_CAPTURES = _metrics.counter("profiler.captures")
+_CAPTURES_SUPPRESSED = _metrics.counter("profiler.captures_suppressed")
+
+_lock = threading.Lock()
+#: label -> last probe monotonic ts (sampled mode rate limit).
+_last_probe: Dict[str, float] = {}
+#: label -> {"calls": probed calls, "device_s": summed dispatch→ready wall}.
+_device_programs: Dict[str, dict] = {}
+#: site -> [payload_bytes, padded_bytes] (mirrors the per-site counters).
+_pad_sites: Dict[str, list] = {}
+#: direction -> [bytes, count, seconds] (seconds only when timing is on).
+_transfers: Dict[str, list] = {"h2d": [0, 0, 0.0], "d2h": [0, 0, 0.0]}
+#: [last capture monotonic ts] — profile-capture rate limit.
+_last_capture: list = [-1e18]
+_capture_seq = 0
+#: Only one jax.profiler window may ever be in flight: overlapping
+#: start_trace calls crash some builds outright (observed segfault on the
+#: XLA-CPU profiler), so a second capture inside a live window writes its
+#: manifest but skips the trace.
+_trace_in_flight = threading.Event()
+#: The live trace thread, drained (bounded join) at interpreter exit: the
+#: runtime tears the profiler down underneath a still-running daemon thread
+#: and segfaults if we just let the process die mid-window.
+_trace_thread: list = [None]
+
+
+def _drain_trace_thread() -> None:
+    t = _trace_thread[0]
+    if t is not None and t.is_alive():
+        t.join(timeout=_profile_window_s() + 15.0)
+
+
+def timing_mode() -> str:
+    """'' = off (the default), '1' = sampled probes, 'all' = every call.
+    ONE env read — this is the whole hot-path cost when off."""
+    return os.environ.get(ENV_DEVICE_TIMING, "") or ""
+
+
+def _timing_interval_s() -> float:
+    try:
+        return max(
+            0.0,
+            float(
+                os.environ.get(ENV_TIMING_INTERVAL_S, "")
+                or _DEFAULT_TIMING_INTERVAL_S
+            ),
+        )
+    except ValueError:
+        return _DEFAULT_TIMING_INTERVAL_S
+
+
+def probe_start(label: str) -> Optional[float]:
+    """Decide BEFORE dispatch whether this `observed_jit` call gets a device
+    probe; returns the probe's t0 or None. Off = one env read. Sampled mode
+    admits one probe per label per interval, so the forced sync a probe
+    implies stays bounded regardless of call rate."""
+    mode = timing_mode()
+    if not mode:
+        return None
+    now = time.monotonic()
+    if mode != "all":
+        interval = _timing_interval_s()
+        with _lock:
+            if now - _last_probe.get(label, -1e18) < interval:
+                return None
+            _last_probe[label] = now
+    return now
+
+
+def probe_finish(label: str, t0: float, out, traced: bool) -> None:
+    """Block until `out` is device-ready and bill dispatch→ready wall to
+    `label` — unless the call traced (its wall is compile, already billed by
+    the compile observatory; recording it here would poison the execute
+    distribution)."""
+    import jax
+
+    try:
+        jax.block_until_ready(out)
+    except Exception:
+        return
+    if traced:
+        return
+    dt = time.monotonic() - t0
+    _metrics.histogram(f"latency.device.{label}").observe(dt)
+    with _lock:
+        p = _device_programs.get(label)
+        if p is None:
+            p = _device_programs[label] = {"calls": 0, "device_s": 0.0}
+        p["calls"] += 1
+        p["device_s"] += dt
+    from . import accounting as _accounting
+
+    _accounting.add("device_time_s", dt)
+
+
+def device_summary() -> dict:
+    """Per-program probed device time: {label: {calls, device_s}}, labels
+    sorted — the device twin of `compile_log.program_summary` (exporter
+    frames, ``bench_detail.device_observatory``). Empty when timing never
+    ran."""
+    with _lock:
+        return {
+            lbl: {"calls": p["calls"], "device_s": round(p["device_s"], 6)}
+            for lbl, p in sorted(_device_programs.items())
+        }
+
+
+def record_h2d(nbytes: int, seconds: Optional[float] = None) -> None:
+    """One host→device transfer of `nbytes` (device-cache upload miss,
+    explicit `device_put` staging). Seconds only arrive when the caller
+    timed the transfer under ``HYPERSPACE_DEVICE_TIMING``."""
+    _H2D_BYTES.inc(int(nbytes))
+    _H2D_COUNT.inc()
+    with _lock:
+        t = _transfers["h2d"]
+        t[0] += int(nbytes)
+        t[1] += 1
+        if seconds is not None:
+            t[2] += seconds
+    if seconds is not None:
+        _metrics.histogram("transfer.h2d.seconds").observe(seconds)
+
+
+def record_d2h(nbytes: int, seconds: Optional[float] = None) -> None:
+    """One device→host materialization of `nbytes` (see `to_host`)."""
+    _D2H_BYTES.inc(int(nbytes))
+    _D2H_COUNT.inc()
+    from . import accounting as _accounting
+
+    _accounting.add("d2h_bytes", int(nbytes))
+    with _lock:
+        t = _transfers["d2h"]
+        t[0] += int(nbytes)
+        t[1] += 1
+        if seconds is not None:
+            t[2] += seconds
+    if seconds is not None:
+        _metrics.histogram("transfer.d2h.seconds").observe(seconds)
+
+
+def to_host(arr):
+    """THE device→host chokepoint: materialize a device array to numpy,
+    recording bytes+count always and seconds under the timing flag. Host
+    numpy passes through untouched (zero cost beyond the isinstance)."""
+    import numpy as np
+
+    if isinstance(arr, np.ndarray):
+        return arr
+    nbytes = int(getattr(arr, "nbytes", 0) or 0)
+    if timing_mode():
+        t0 = time.monotonic()
+        host = np.asarray(arr)
+        record_d2h(nbytes, time.monotonic() - t0)
+    else:
+        host = np.asarray(arr)
+        record_d2h(nbytes)
+    return host
+
+
+def record_pad(site: str, payload_bytes: int, padded_bytes: int) -> None:
+    """One pow2 staging event at `site`: `payload_bytes` of real data were
+    staged inside `payload+padded` bytes of device buffer. The mesh
+    exchange's payload-vs-moved honesty split, generalized: every site that
+    pads to a shape class reports its tax here. Unconditional integer adds
+    (the always-on registry philosophy); the ambient ledger — when one is
+    open — carries the per-query split and derives ``pad_ratio`` at close."""
+    payload_bytes = int(payload_bytes)
+    padded_bytes = int(padded_bytes)
+    if padded_bytes < 0:
+        padded_bytes = 0
+    _PAD_PAYLOAD.inc(payload_bytes)
+    _PAD_PADDED.inc(padded_bytes)
+    _metrics.counter(f"pad.{site}.bytes_payload").inc(payload_bytes)
+    _metrics.counter(f"pad.{site}.bytes_padded").inc(padded_bytes)
+    with _lock:
+        s = _pad_sites.get(site)
+        if s is None:
+            s = _pad_sites[site] = [0, 0]
+        s[0] += payload_bytes
+        s[1] += padded_bytes
+    from . import accounting as _accounting
+
+    _accounting.add("pad_bytes_payload", payload_bytes)
+    _accounting.add("pad_bytes_padded", padded_bytes)
+
+
+def pad_summary() -> dict:
+    """Per-site padding tax: {site: {bytes_payload, bytes_padded,
+    pad_ratio}} — pad_ratio is the fraction of staged bytes that is padding
+    (0.0 = every staged byte was real data)."""
+    with _lock:
+        out = {}
+        for site, (payload, padded) in sorted(_pad_sites.items()):
+            total = payload + padded
+            out[site] = {
+                "bytes_payload": payload,
+                "bytes_padded": padded,
+                "pad_ratio": round(padded / total, 4) if total else 0.0,
+            }
+        return out
+
+
+def transfer_summary() -> dict:
+    """H2D/D2H rollup: {direction: {bytes, count[, seconds, gb_per_s]}} —
+    seconds (and the derived effective GB/s) only appear once something was
+    timed under ``HYPERSPACE_DEVICE_TIMING``."""
+    with _lock:
+        out = {}
+        for d, (nbytes, count, seconds) in sorted(_transfers.items()):
+            e = {"bytes": nbytes, "count": count}
+            if seconds > 0:
+                e["seconds"] = round(seconds, 6)
+                e["gb_per_s"] = round(nbytes / seconds / 1e9, 3)
+            out[d] = e
+        return out
+
+
+def reset() -> None:
+    """Zero the module-local summaries (tests/bench; the registry counters
+    reset separately via `metrics.reset`). Probe rate-limit state clears too
+    so a fresh bench section probes immediately."""
+    with _lock:
+        _device_programs.clear()
+        _pad_sites.clear()
+        _last_probe.clear()
+        for t in _transfers.values():
+            t[0] = t[1] = 0
+            t[2] = 0.0
+        _last_capture[0] = -1e18
+
+
+# ---------------------------------------------------------------------------
+# Anomaly-triggered profile capture
+# ---------------------------------------------------------------------------
+
+
+def profile_keep() -> int:
+    try:
+        return max(
+            1, int(os.environ.get(ENV_PROFILE_KEEP, "") or _DEFAULT_PROFILE_KEEP)
+        )
+    except ValueError:
+        return _DEFAULT_PROFILE_KEEP
+
+
+def _profile_window_s() -> float:
+    try:
+        return max(
+            0.05,
+            float(
+                os.environ.get(ENV_PROFILE_WINDOW_S, "")
+                or _DEFAULT_PROFILE_WINDOW_S
+            ),
+        )
+    except ValueError:
+        return _DEFAULT_PROFILE_WINDOW_S
+
+
+def _profile_min_interval_s() -> float:
+    try:
+        return max(
+            0.0,
+            float(
+                os.environ.get(ENV_PROFILE_MIN_INTERVAL_S, "")
+                or _DEFAULT_PROFILE_MIN_INTERVAL_S
+            ),
+        )
+    except ValueError:
+        return _DEFAULT_PROFILE_MIN_INTERVAL_S
+
+
+def maybe_capture(reason: str, detail: Optional[dict] = None) -> Optional[str]:
+    """Capture one bounded profile window into ``HYPERSPACE_PROFILE_DIR``
+    (returns the capture directory, or None when disabled/suppressed).
+
+    Called from the anomaly (history Nσ) and SLO fast-burn paths, both of
+    which can flap — so captures are rate-limited to one per
+    ``HYPERSPACE_PROFILE_MIN_INTERVAL_S`` and the directory is keep-N
+    rotated (``capture/`` → ``capture.1/`` → …, `profile_keep` generations).
+    The manifest (``capture.json``) writes SYNCHRONOUSLY so the capture is
+    parseable the moment this returns; the ``jax.profiler`` trace — where
+    the profiler works at all — collects on a daemon thread for the bounded
+    window and marks completion in ``trace.json``. Never raises: a broken
+    profiler must not take the query path down with it."""
+    base_dir = os.environ.get(ENV_PROFILE_DIR)
+    if not base_dir:
+        return None
+    global _capture_seq
+    now = time.monotonic()
+    with _lock:
+        if now - _last_capture[0] < _profile_min_interval_s():
+            _CAPTURES_SUPPRESSED.inc()
+            return None
+        _last_capture[0] = now
+        _capture_seq += 1
+        seq = _capture_seq
+    try:
+        from . import compile_log as _compile_log
+        from . import rotation as _rotation
+
+        cap_dir = os.path.join(base_dir, "capture")
+        os.makedirs(base_dir, exist_ok=True)
+        _rotation.rotate_dir(cap_dir, keep=profile_keep())
+        os.makedirs(cap_dir, exist_ok=True)
+        window_s = _profile_window_s()
+        from . import accounting as _accounting
+
+        manifest = {
+            "schema_version": 1,
+            "reason": reason,
+            "seq": seq,
+            "ts": time.time(),
+            "window_s": window_s,
+            "detail": detail or {},
+            "programs": _compile_log.program_summary(),
+            "device": device_summary(),
+            "pads": pad_summary(),
+            "transfers": transfer_summary(),
+            "recent_ledgers": [
+                led.to_dict() for led in _accounting.recent_ledgers()[-8:]
+            ],
+        }
+        with open(os.path.join(cap_dir, "capture.json"), "w") as f:
+            json.dump(manifest, f, indent=1, sort_keys=True)
+        _CAPTURES.inc()
+        with _lock:
+            start_trace = not _trace_in_flight.is_set()
+            if start_trace:
+                _trace_in_flight.set()
+        if start_trace:
+            import atexit
+
+            t = threading.Thread(
+                target=_trace_window,
+                args=(cap_dir, window_s),
+                name="hyperspace-profile-capture",
+                daemon=True,
+            )
+            if _trace_thread[0] is None:
+                atexit.register(_drain_trace_thread)
+            _trace_thread[0] = t
+            t.start()
+        else:
+            # A previous window is still collecting; overlapping profiler
+            # sessions are unsafe, so this capture is manifest-only.
+            with open(os.path.join(cap_dir, "trace.json"), "w") as f:
+                json.dump(
+                    {"window_s": window_s, "trace": False,
+                     "error": "skipped: trace already in flight"},
+                    f,
+                )
+        return cap_dir
+    except Exception:
+        return None
+
+
+def _trace_window(cap_dir: str, window_s: float) -> None:
+    """Bounded jax.profiler trace into `cap_dir` (daemon thread). Status —
+    including 'unavailable' on builds/backends without a working profiler —
+    lands in ``trace.json`` so the capture is self-describing either way."""
+    status = {"window_s": window_s, "trace": False}
+    import sys as _sys
+
+    jax = _sys.modules.get("jax")
+    started = False
+    try:
+        if jax is not None:
+            jax.profiler.start_trace(cap_dir)
+            started = True
+        time.sleep(window_s)
+    except Exception as e:
+        status["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+                status["trace"] = True
+            except Exception as e:
+                status["error"] = f"{type(e).__name__}: {e}"
+        if jax is None:
+            status["error"] = "jax not imported"
+        _trace_in_flight.clear()
+        try:
+            with open(os.path.join(cap_dir, "trace.json"), "w") as f:
+                json.dump(status, f)
+        except OSError:
+            pass

@@ -71,14 +71,13 @@ def _interval_from_env() -> float:
     return max(0.01, v)
 
 
-def _device_live_bytes() -> Optional[int]:
-    jax = sys.modules.get("jax")
-    if jax is None:
-        return None
-    try:
-        return int(sum(int(a.nbytes) for a in jax.live_arrays()))
-    except Exception:
-        return None
+def _device_live_bytes():
+    """(bytes, age_s) via the accounting module's shared rate-limited sampler
+    — one `jax.live_arrays()` walk serves ledger closes AND frames, and the
+    age rides the frame so a reused reading is never mistaken for live."""
+    from . import accounting as _accounting
+
+    return _accounting.device_live_bytes_sample()
 
 
 class MetricsExporter:
@@ -147,10 +146,28 @@ class MetricsExporter:
         hist = _history.frame_summary()
         if hist:
             out["history"] = hist
-        dev = _device_live_bytes()
+        dev, age = _device_live_bytes()
         if dev is not None:
             out["device_live_bytes"] = dev
+            if age is not None:
+                out["device_live_bytes_age_s"] = round(age, 3)
             _metrics.gauge("device.live_bytes").set(dev)
+        # Device cost observatory rollups (probed device time, H2D/D2H,
+        # padding tax): omitted while empty so pre-existing frame consumers
+        # see unchanged schemas.
+        from . import device_observatory as _devobs
+
+        dev_programs = _devobs.device_summary()
+        pads = _devobs.pad_summary()
+        transfers = _devobs.transfer_summary()
+        if dev_programs or pads or any(
+            t["count"] for t in transfers.values()
+        ):
+            out["device"] = {
+                "programs": dev_programs,
+                "pads": pads,
+                "transfers": transfers,
+            }
         if final:
             out["final"] = True
         return out

@@ -93,6 +93,14 @@ TRACKED_FIELDS = (
     "io_retries",
     "xla_compiles",
     "rows_produced",
+    # Device cost vectors (telemetry/device_observatory.py): per-class
+    # device time, transfer bytes both ways, and the pow2 padding split —
+    # the measured per-class costs the future planner prices against.
+    "device_time_s",
+    "device_upload_bytes",
+    "d2h_bytes",
+    "pad_bytes_payload",
+    "pad_bytes_padded",
 )
 
 _RECORDS = _metrics.counter("history.records")
@@ -629,6 +637,15 @@ class HistoryStore:
             verdict["query_id"] = ledger.get("query_id")
             verdict["name"] = ledger.get("name")
             _PENDING_ANOMALIES.append(verdict)
+            # Anomaly-triggered profile capture (HYPERSPACE_PROFILE_DIR):
+            # one bounded trace window per rate-limit interval, keep-N
+            # rotated. Never lets a capture failure reach the query path.
+            try:
+                from . import device_observatory as _devobs
+
+                _devobs.maybe_capture("anomaly", dict(verdict))
+            except Exception:
+                pass
             if fingerprint not in _warned_fingerprints:
                 _warned_fingerprints.add(fingerprint)
                 warnings.warn(

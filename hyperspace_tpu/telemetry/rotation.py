@@ -68,6 +68,28 @@ def rotate(path: str) -> None:
     _ROTATIONS.inc()
 
 
+def rotate_dir(path: str, keep: Optional[int] = None) -> None:
+    """Directory twin of `rotate`: shift `path/` → `path.1/` → … →
+    `path.<keep>/` (oldest generation removed). Used by the profile-capture
+    directories (``HYPERSPACE_PROFILE_DIR``), whose keep count rides its own
+    knob — callers pass it explicitly; None falls back to the sink keep."""
+    import shutil
+
+    if keep is None:
+        keep = keep_files()
+    shutil.rmtree(f"{path}.{keep}", ignore_errors=True)
+    for i in range(keep - 1, 0, -1):
+        try:
+            os.replace(f"{path}.{i}", f"{path}.{i + 1}")
+        except OSError:
+            continue  # that generation doesn't exist yet
+    try:
+        os.replace(path, f"{path}.1")
+    except OSError:
+        return  # nothing to rotate yet
+    _ROTATIONS.inc()
+
+
 def append(path: str, text: str, max_mb_env: Optional[str] = None) -> None:
     """Append `text` to `path`, rotating first when the configured cap
     (`max_mb_env`, e.g. ``HYPERSPACE_TRACE_MAX_MB``) would be crossed.

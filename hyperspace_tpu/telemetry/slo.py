@@ -194,6 +194,19 @@ class SLOMonitor:
         if burn < FAST_BURN_THRESHOLD:
             return
         _FAST_BURN_ALERTS.inc()
+        # Fast-burn is the second profile-capture trigger (the first is the
+        # history Nσ anomaly): grab one bounded trace window while the burn
+        # is actually happening. Rate-limited/rotated inside; never raises
+        # into the serving completion path.
+        try:
+            from . import device_observatory as _devobs
+
+            _devobs.maybe_capture(
+                "slo_fast_burn",
+                {"lane": lane, "burn": round(burn, 2), "window": WINDOWS[0][1]},
+            )
+        except Exception:
+            pass
         if lane in self._fast_burn_warned:
             return
         self._fast_burn_warned.add(lane)

@@ -1,0 +1,437 @@
+"""Device cost observatory: per-program device-time probes, transfer and
+padding ledgers, memory watermarks, and anomaly-triggered profile capture.
+
+Pins the PR-14 contracts:
+- ``HYPERSPACE_DEVICE_TIMING`` unset = zero cost: no probes, no
+  ``latency.device.*`` series, exactly one env check per `observed_jit`
+  call, and traced-vs-untraced results identical;
+- probes bill dispatch→ready wall per label (``all`` = every call,
+  sampled ``1`` = one probe per label per interval) and SKIP compiling
+  calls — compile wall is the compile observatory's, not execute time;
+- pad/transfer BYTE counters are always on (registry philosophy); SECONDS
+  only appear under timing (they force a sync);
+- the query ledger closes with ``device_time_s``/``host_time_s``,
+  ``pad_ratio``, and ``device_live_bytes_age_s`` (the staleness of the
+  shared 1 Hz device-bytes sample);
+- pool workers adopt the submitting query's ledger (`use_ledger`), so
+  chunk work on streamed-join threads bills the query, not nothing;
+- profile capture is manifest-first (``capture.json`` parses the moment
+  `maybe_capture` returns), rate-limited, keep-N rotated, and never
+  overlaps trace windows (concurrent jax.profiler sessions crash).
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu.engine import HyperspaceSession, col
+from hyperspace_tpu.telemetry import accounting, compile_log, metrics
+from hyperspace_tpu.telemetry import device_observatory as dv
+
+
+@pytest.fixture(autouse=True)
+def _fresh_observatory(monkeypatch):
+    monkeypatch.delenv(dv.ENV_DEVICE_TIMING, raising=False)
+    monkeypatch.delenv(dv.ENV_PROFILE_DIR, raising=False)
+    dv.reset()
+    yield
+    dv.reset()
+
+
+@pytest.fixture()
+def session(tmp_path):
+    return HyperspaceSession(warehouse=str(tmp_path))
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# Zero-cost-off oracle
+# ---------------------------------------------------------------------------
+
+
+class TestZeroCostOff:
+    def test_no_probes_no_series_by_default(self):
+        jnp = _jnp()
+        f = compile_log.observed_jit(lambda x: x * 2, label="obs.test_off")
+        f(jnp.arange(8))
+        f(jnp.arange(8))
+        assert dv.device_summary() == {}
+        assert dv.probe_start("anything") is None
+        hists = metrics.snapshot().get("histograms", {})
+        assert not any(n.startswith("latency.device.") for n in hists)
+
+    def test_exactly_one_env_check_per_call(self, monkeypatch):
+        """The whole off-path cost of a probe is ONE timing_mode() read
+        inside probe_start — nothing else on the observed_jit hot path
+        touches the observatory."""
+        jnp = _jnp()
+        calls = {"n": 0}
+        real = dv.timing_mode
+
+        def counted():
+            calls["n"] += 1
+            return real()
+
+        monkeypatch.setattr(dv, "timing_mode", counted)
+        f = compile_log.observed_jit(lambda x: x + 3, label="obs.env_check")
+        x = jnp.arange(4)
+        f(x)  # compile
+        calls["n"] = 0
+        for _ in range(5):
+            f(x)
+        assert calls["n"] == 5
+
+    def test_rows_identical_with_timing_on(self, session, tmp_path, monkeypatch):
+        src = os.path.join(str(tmp_path), "t")
+        session.write_parquet(
+            {
+                "k": list(range(400)),
+                "grp": [i % 7 for i in range(400)],
+                "v": [float(i) for i in range(400)],
+            },
+            src,
+        )
+
+        def q():
+            return (
+                session.read.parquet(src)
+                .filter(col("k") > 50)
+                .group_by("grp")
+                .agg(s=("v", "sum"), n=("*", "count"))
+                .collect()
+                .sorted_rows()
+            )
+
+        off = q()
+        monkeypatch.setenv(dv.ENV_DEVICE_TIMING, "all")
+        monkeypatch.setenv(dv.ENV_TIMING_INTERVAL_S, "0")
+        on = q()
+        assert off == on
+
+
+# ---------------------------------------------------------------------------
+# Device-time probes
+# ---------------------------------------------------------------------------
+
+
+class TestProbes:
+    def test_all_mode_bills_per_label_and_skips_compile(self, monkeypatch):
+        monkeypatch.setenv(dv.ENV_DEVICE_TIMING, "all")
+        jnp = _jnp()
+        f = compile_log.observed_jit(lambda x: x + 1, label="obs.probe_all")
+        x = jnp.arange(16)
+        f(x)  # compile: traced, probe must NOT record
+        f(x)
+        f(x)
+        summ = dv.device_summary()["obs.probe_all"]
+        assert summ["calls"] == 2
+        assert summ["device_s"] >= 0.0
+        hists = metrics.snapshot().get("histograms", {})
+        assert hists["latency.device.obs.probe_all"]["count"] == 2
+
+    def test_sampled_mode_one_probe_per_interval(self, monkeypatch):
+        jnp = _jnp()
+        f = compile_log.observed_jit(lambda x: x - 1, label="obs.probe_sampled")
+        x = jnp.arange(16)
+        f(x)  # compile with timing OFF: no probe slot consumed
+        monkeypatch.setenv(dv.ENV_DEVICE_TIMING, "1")
+        monkeypatch.setenv(dv.ENV_TIMING_INTERVAL_S, "9999")
+        for _ in range(5):
+            f(x)
+        assert dv.device_summary()["obs.probe_sampled"]["calls"] == 1
+
+    def test_ledger_gets_device_host_split(self, monkeypatch):
+        monkeypatch.setenv(dv.ENV_DEVICE_TIMING, "all")
+        jnp = _jnp()
+        f = compile_log.observed_jit(lambda x: x * 3, label="obs.probe_ledger")
+        x = jnp.arange(32)
+        f(x)  # compile outside the ledger
+        with accounting.ledger_scope("qid-devsplit", "query:test"):
+            f(x)
+            f(x)
+        led = accounting.ledger_for("qid-devsplit")
+        assert led is not None
+        d = led.to_dict()
+        assert d["device_time_s"] > 0.0
+        assert d["host_time_s"] >= 0.0
+        assert d["host_time_s"] <= d["wall_s"]
+
+
+# ---------------------------------------------------------------------------
+# Padding + transfer ledgers (bytes always on)
+# ---------------------------------------------------------------------------
+
+
+class TestPadsAndTransfers:
+    def test_record_pad_sites_and_ratio(self):
+        c0 = metrics.counter("pad.bytes_padded").value
+        dv.record_pad("site_x", 300, 100)
+        dv.record_pad("site_x", 100, 0)
+        s = dv.pad_summary()["site_x"]
+        assert s["bytes_payload"] == 400
+        assert s["bytes_padded"] == 100
+        assert s["pad_ratio"] == 0.2
+        assert metrics.counter("pad.bytes_padded").value == c0 + 100
+
+    def test_hash_dictionary_records_pad_without_timing(self):
+        from hyperspace_tpu.ops import hashing
+
+        words = np.array([f"w{i:03d}" for i in range(100)], dtype=object)
+        os.environ["HYPERSPACE_HASH_QUANTIZE"] = "1"
+        try:
+            hashing.host_hash_dictionary(words, seed=7)
+        finally:
+            os.environ.pop("HYPERSPACE_HASH_QUANTIZE", None)
+        s = dv.pad_summary()
+        assert "hash_dict" in s
+        assert s["hash_dict"]["bytes_payload"] > 0
+
+    def test_to_host_is_passthrough_for_numpy_and_records_d2h(self):
+        a = np.arange(8)
+        assert dv.to_host(a) is a
+        jnp = _jnp()
+        arr = jnp.arange(1024)
+        before = dv.transfer_summary().get("d2h", {}).get("bytes", 0)
+        out = dv.to_host(arr)
+        assert isinstance(out, np.ndarray)
+        assert dv.transfer_summary()["d2h"]["bytes"] >= before + arr.nbytes
+
+    def test_device_cache_upload_records_h2d_and_gauge(self):
+        from hyperspace_tpu.engine import device_cache
+
+        host = np.random.RandomState(0).rand(4096)
+        before = dv.transfer_summary().get("h2d", {}).get("bytes", 0)
+        device_cache.device_array(host)
+        after = dv.transfer_summary()["h2d"]
+        assert after["bytes"] >= before + host.nbytes
+        g = metrics.snapshot().get("gauges", {})
+        assert g.get("cache.device_upload.bytes", 0) >= host.nbytes
+
+    def test_transfer_seconds_only_under_timing(self, monkeypatch):
+        jnp = _jnp()
+        dv.to_host(jnp.arange(256))
+        assert "seconds" not in dv.transfer_summary()["d2h"]
+        monkeypatch.setenv(dv.ENV_DEVICE_TIMING, "all")
+        dv.to_host(jnp.arange(256) * 2)
+        t = dv.transfer_summary()["d2h"]
+        assert t["seconds"] >= 0.0
+        assert "gb_per_s" in t
+
+    def test_ledger_pad_ratio(self):
+        with accounting.ledger_scope("qid-padratio", "query:test"):
+            dv.record_pad("site_y", 300, 100)
+        d = accounting.ledger_for("qid-padratio").to_dict()
+        assert d["pad_bytes_payload"] == 300
+        assert d["pad_bytes_padded"] == 100
+        assert d["pad_ratio"] == 0.25
+
+
+# ---------------------------------------------------------------------------
+# Memory watermarks + sample age
+# ---------------------------------------------------------------------------
+
+
+class TestWatermarks:
+    def test_device_live_bytes_sample_reports_age(self, monkeypatch):
+        # Clear the shared 1 Hz slot so THIS call takes a fresh reading.
+        monkeypatch.setattr(accounting, "_device_sample", [-1e18, None, None])
+        val, age = accounting.device_live_bytes_sample()
+        if val is None:
+            pytest.skip("backend exposes no live-bytes stats")
+        assert age == 0.0  # fresh sample
+        val2, age2 = accounting.device_live_bytes_sample()
+        assert val2 == val  # rate-limited: reused reading...
+        assert age2 >= 0.0  # ...with its honest age
+
+    def test_ledger_close_attaches_sample_age(self):
+        with accounting.ledger_scope("qid-age", "query:test"):
+            pass
+        d = accounting.ledger_for("qid-age").to_dict()
+        if "device_live_bytes" in d:
+            assert "device_live_bytes_age_s" in d
+            assert d["device_live_bytes_age_s"] >= 0.0
+
+    def test_memo_footprint_gauge_registered(self, session, tmp_path):
+        src = os.path.join(str(tmp_path), "t")
+        session.write_parquet({"k": list(range(64))}, src)
+        session.read.parquet(src).filter(col("k") > 3).collect()
+        g = metrics.snapshot().get("gauges", {})
+        # Registered and consistent: the peak never lags the live value.
+        if "memo.device_cache.bytes" in g:
+            assert g["memo.device_cache.bytes_peak"] >= g["memo.device_cache.bytes"]
+        if "cache.device_upload.bytes" in g:
+            assert g["cache.device_upload.bytes_peak"] >= g["cache.device_upload.bytes"]
+
+
+# ---------------------------------------------------------------------------
+# Pool workers adopt the query ledger (streamed join chunks)
+# ---------------------------------------------------------------------------
+
+
+class TestPoolLedgerAdoption:
+    def test_stream_join_workers_bill_the_query_ledger(self, tmp_path, monkeypatch):
+        """Chunk work on the streamed-join pool must see the SUBMITTING
+        query's ledger — without `use_ledger` adoption its compiles, pads,
+        and device probes bill nothing."""
+        from hyperspace_tpu import IndexConfig, IndexConstants
+        from hyperspace_tpu.engine import physical
+        from hyperspace_tpu.hyperspace import Hyperspace, enable_hyperspace
+
+        physical.clear_device_memos()
+        s = HyperspaceSession(warehouse=str(tmp_path))
+        s.conf.set(IndexConstants.INDEX_SYSTEM_PATH, str(tmp_path / "indexes"))
+        s.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 8)
+        hs = Hyperspace(s)
+        rng = np.random.RandomState(3)
+        n = 9000
+        s.write_parquet(
+            {
+                "k": rng.randint(0, 300, n).astype(np.int64),
+                "v": rng.randint(0, 100, n).astype(np.int64),
+            },
+            str(tmp_path / "l"),
+        )
+        s.write_parquet(
+            {
+                "k2": np.arange(300, dtype=np.int64),
+                "g": rng.randint(0, 20, 300).astype(np.int64),
+            },
+            str(tmp_path / "r"),
+        )
+        hs.create_index(
+            s.read.parquet(str(tmp_path / "l")), IndexConfig("dvJl", ["k"], ["v"])
+        )
+        hs.create_index(
+            s.read.parquet(str(tmp_path / "r")), IndexConfig("dvJr", ["k2"], ["g"])
+        )
+        enable_hyperspace(s)
+        monkeypatch.setenv("HYPERSPACE_ACCOUNTING", "1")  # queries carry ledgers
+        monkeypatch.setenv("HYPERSPACE_QUERY_STREAMING", "1")
+        monkeypatch.setenv("HYPERSPACE_JOIN_CHUNK_ROWS", "2000")
+        monkeypatch.delenv("HYPERSPACE_BUILD_DECODE_THREADS", raising=False)
+        monkeypatch.delenv("HYPERSPACE_FORCE_DEVICE_OPS", raising=False)
+
+        seen = []
+        real = physical._assemble_join
+
+        def spy(*args, **kwargs):
+            seen.append(
+                (threading.current_thread() is threading.main_thread(),
+                 accounting.current_ledger())
+            )
+            return real(*args, **kwargs)
+
+        monkeypatch.setattr(physical, "_assemble_join", spy)
+
+        def q():
+            l = s.read.parquet(str(tmp_path / "l"))
+            r = s.read.parquet(str(tmp_path / "r"))
+            return (
+                l.join(r, col("k") == col("k2"))
+                .group_by("g")
+                .agg(sv=("v", "sum"), n=("*", "count"))
+            )
+
+        streamed = q().collect().sorted_rows()
+        from hyperspace_tpu.telemetry.profiling import last_join_stages
+
+        js = last_join_stages()
+        assert js is not None and js["mode"] == "join-stream" and js["chunks"] > 1
+        worker_calls = [(m, led) for m, led in seen if not m]
+        assert worker_calls, "join did not stream on the worker pool"
+        led_ids = {led.query_id for _, led in worker_calls if led is not None}
+        assert led_ids, "worker chunks saw no adopted ledger"
+        closed = {l.query_id for l in accounting.recent_ledgers()}
+        assert led_ids & closed, "adopted ledger is not the query's own"
+
+        monkeypatch.setenv("HYPERSPACE_QUERY_STREAMING", "0")
+        physical.clear_device_memos()
+        assert streamed == q().collect().sorted_rows()
+
+
+# ---------------------------------------------------------------------------
+# Profile capture
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def _stub_trace(monkeypatch):
+    """Replace the jax.profiler window with a recording stub: these tests
+    pin capture mechanics (manifest, rate limit, rotation, overlap guard) —
+    the real profiler runs once in the CI smoke leg."""
+    windows = []
+
+    def stub(cap_dir, window_s):
+        windows.append(cap_dir)
+        try:
+            with open(os.path.join(cap_dir, "trace.json"), "w") as f:
+                json.dump({"window_s": window_s, "trace": True, "stub": True}, f)
+        finally:
+            dv._trace_in_flight.clear()
+
+    monkeypatch.setattr(dv, "_trace_window", stub)
+    return windows
+
+
+class TestProfileCapture:
+    def test_disabled_without_env(self):
+        assert dv.maybe_capture("anomaly") is None
+
+    def test_manifest_parses_and_rate_limit(self, tmp_path, monkeypatch, _stub_trace):
+        monkeypatch.setenv(dv.ENV_PROFILE_DIR, str(tmp_path / "prof"))
+        monkeypatch.setenv(dv.ENV_PROFILE_MIN_INTERVAL_S, "60")
+        c0 = metrics.counter("profiler.captures_suppressed").value
+        d1 = dv.maybe_capture("anomaly", {"sigma": 4.2})
+        assert d1 is not None
+        m = json.load(open(os.path.join(d1, "capture.json")))
+        assert m["schema_version"] == 1
+        assert m["reason"] == "anomaly"
+        assert m["detail"] == {"sigma": 4.2}
+        assert "pads" in m and "transfers" in m and "programs" in m
+        assert dv.maybe_capture("anomaly") is None  # suppressed
+        assert metrics.counter("profiler.captures_suppressed").value == c0 + 1
+
+    def test_keep_n_rotation(self, tmp_path, monkeypatch, _stub_trace):
+        monkeypatch.setenv(dv.ENV_PROFILE_DIR, str(tmp_path / "prof"))
+        monkeypatch.setenv(dv.ENV_PROFILE_MIN_INTERVAL_S, "0")
+        monkeypatch.setenv(dv.ENV_PROFILE_KEEP, "2")
+        for i in range(4):
+            assert dv.maybe_capture("slo_fast_burn", {"i": i}) is not None
+        names = sorted(os.listdir(str(tmp_path / "prof")))
+        assert "capture" in names and "capture.1" in names
+        assert "capture.3" not in names  # keep=2 bounds the generations
+        newest = json.load(open(str(tmp_path / "prof" / "capture" / "capture.json")))
+        assert newest["detail"] == {"i": 3}
+
+    def test_overlap_guard_skips_trace_not_manifest(
+        self, tmp_path, monkeypatch, _stub_trace
+    ):
+        monkeypatch.setenv(dv.ENV_PROFILE_DIR, str(tmp_path / "prof"))
+        monkeypatch.setenv(dv.ENV_PROFILE_MIN_INTERVAL_S, "0")
+        dv._trace_in_flight.set()  # a window is "running"
+        try:
+            d1 = dv.maybe_capture("anomaly")
+            assert d1 is not None  # manifest still lands
+            t = json.load(open(os.path.join(d1, "trace.json")))
+            assert t["trace"] is False
+            assert "in flight" in t["error"]
+            assert _stub_trace == []  # no second window spawned
+        finally:
+            dv._trace_in_flight.clear()
+        d2 = dv.maybe_capture("anomaly")
+        assert _stub_trace == [d2]  # guard released: window runs again
+
+    def test_anomaly_hook_never_raises(self, monkeypatch):
+        """The history/SLO call sites wrap maybe_capture in try/except, and
+        maybe_capture itself must swallow capture-side failures."""
+        monkeypatch.setenv(dv.ENV_PROFILE_DIR, "/dev/null/not-a-dir")
+        monkeypatch.setenv(dv.ENV_PROFILE_MIN_INTERVAL_S, "0")
+        assert dv.maybe_capture("anomaly") is None

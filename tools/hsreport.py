@@ -23,6 +23,11 @@ Sections:
 - **Hotspots** — compile-storm classes (most XLA compiles) and retry
   hotspots (most io retries): where warm-path latency is going to compile
   or fault churn.
+- **Device cost** (`telemetry.device_observatory` fields, recorded when
+  ``HYPERSPACE_DEVICE_TIMING`` was on) — device-time hotspots per class
+  (device seconds and share of class wall), the pow2 padding tax per class
+  (payload vs padded bytes, pad_ratio), and effective transfer bandwidth
+  (h2d+d2h bytes over class wall).
 - ``--compare OTHER_DIR`` — two stores' per-class baselines flattened and
   diffed with `tools.bench_compare`'s machinery (shared `flatten`/`compare`
   — one comparison semantics across both tools); regressed classes exit 1.
@@ -132,8 +137,80 @@ def build_report(dir_path: str, top: int, recent_k: int) -> dict:
             )[:top]
             if s.get("io_retries")
         ],
+        "device_hotspots": _device_hotspots(baselines, top),
+        "pad_tax": _pad_tax(baselines, top),
+        "transfer_bandwidth": _transfer_bandwidth(baselines, top),
     }
     return report
+
+
+def _device_hotspots(baselines: Dict[str, dict], top: int) -> List[dict]:
+    """Classes by attributed device seconds (sampled execute probes). The
+    share column is device over class wall: a class at ~1.0 is
+    device-bound; one near 0 spends its wall on host decode/plan."""
+    rows = []
+    for fp, s in baselines.items():
+        dev = s.get("device_time_s")
+        if not dev:
+            continue
+        wall = s.get("wall_total_s") or 0.0
+        rows.append(
+            {
+                "fingerprint": fp,
+                "names": s.get("names"),
+                "n": s.get("n"),
+                "device_time_s": round(dev, 6),
+                "device_share": round(dev / wall, 3) if wall else None,
+            }
+        )
+    rows.sort(key=lambda r: -r["device_time_s"])
+    return rows[:top]
+
+
+def _pad_tax(baselines: Dict[str, dict], top: int) -> List[dict]:
+    """Classes by pow2 padding tax: padded (wasted) bytes staged next to
+    payload bytes, worst pad_ratio first among the biggest wasters."""
+    rows = []
+    for fp, s in baselines.items():
+        payload = s.get("pad_bytes_payload", 0)
+        padded = s.get("pad_bytes_padded", 0)
+        if not (payload or padded):
+            continue
+        rows.append(
+            {
+                "fingerprint": fp,
+                "names": s.get("names"),
+                "n": s.get("n"),
+                "pad_bytes_payload": payload,
+                "pad_bytes_padded": padded,
+                "pad_ratio": round(padded / (payload + padded), 4),
+            }
+        )
+    rows.sort(key=lambda r: -r["pad_bytes_padded"])
+    return rows[:top]
+
+
+def _transfer_bandwidth(baselines: Dict[str, dict], top: int) -> List[dict]:
+    """Effective transfer bandwidth per class: (h2d + d2h bytes) over the
+    class's total wall. This is NOT link peak — it answers "how much of
+    this class's wall is moving bytes", comparable across classes."""
+    rows = []
+    for fp, s in baselines.items():
+        moved = (s.get("device_upload_bytes", 0) or 0) + (s.get("d2h_bytes", 0) or 0)
+        if not moved:
+            continue
+        wall = s.get("wall_total_s") or 0.0
+        rows.append(
+            {
+                "fingerprint": fp,
+                "names": s.get("names"),
+                "n": s.get("n"),
+                "bytes_moved": moved,
+                "effective_gbps": round(moved / wall / 1e9, 4) if wall else None,
+            }
+        )
+    rows.sort(key=lambda r: -r["bytes_moved"])
+    return rows[:top]
 
 
 def _fmt_s(v: Optional[float]) -> str:
@@ -192,6 +269,36 @@ def render(report: dict) -> str:
             lines.append(
                 f"  {h['fingerprint']}  retries={h['io_retries']} over "
                 f"{h['n']} queries  [{','.join(h.get('names') or [])}]"
+            )
+    if report.get("device_hotspots"):
+        lines += ["", "device-time hotspots (sampled execute probes per class):"]
+        for h in report["device_hotspots"]:
+            share = (
+                f" ({h['device_share']:.0%} of wall)"
+                if h.get("device_share") is not None
+                else ""
+            )
+            lines.append(
+                f"  {h['fingerprint']}  device={_fmt_s(h['device_time_s'])}{share}"
+                f" over {h['n']} queries  [{','.join(h.get('names') or [])}]"
+            )
+    if report.get("pad_tax"):
+        lines += ["", "pow2 padding tax (wasted staged bytes per class):"]
+        for h in report["pad_tax"]:
+            lines.append(
+                f"  {h['fingerprint']}  payload={h['pad_bytes_payload']}B"
+                f" padded={h['pad_bytes_padded']}B"
+                f" pad_ratio={h['pad_ratio']}"
+                f"  [{','.join(h.get('names') or [])}]"
+            )
+    if report.get("transfer_bandwidth"):
+        lines += ["", "effective transfer bandwidth (h2d+d2h over class wall):"]
+        for h in report["transfer_bandwidth"]:
+            gbps = h.get("effective_gbps")
+            lines.append(
+                f"  {h['fingerprint']}  moved={h['bytes_moved']}B"
+                f"  {gbps if gbps is not None else '-'} GB/s"
+                f"  [{','.join(h.get('names') or [])}]"
             )
     return "\n".join(lines)
 
