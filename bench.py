@@ -499,6 +499,12 @@ def run_bench(deadline: float = None) -> dict:
         #    through scan/build/join (cold on/off splits + effective GB/s +
         #    the encoded/materialized byte counters that prove the path)
         ph.run("encoded_exec", lambda: d.update(_encoded_section(s, base, col, runs, hs)))
+        # -- device-resident codes: narrow code lanes across the H2D boundary
+        #    and the mesh exchange (flag on/off H2D + bytes_moved reductions)
+        ph.run(
+            "encoded_device",
+            lambda: d.update(_encoded_device_section(s, base, col, runs, hs)),
+        )
         # -- multi-tenant serving: N clients × mixed Q1/Q3/Q14/point workload
         #    through the QueryServer (throughput, per-class p50/p99, dedup
         #    counters, cold-scan single-flight probe)
@@ -912,6 +918,113 @@ def _encoded_section(s, base, col, runs, hs) -> dict:
         else:
             os.environ[env_key] = saved
     return {"encoded_exec": out}
+
+
+def _encoded_device_section(s, base, col, runs, hs) -> dict:
+    """Device-resident codes (`HYPERSPACE_ENCODED_DEVICE`): how many bytes the
+    narrow code lanes keep OFF the host→device boundary and the mesh wire, on
+    a low-cardinality string-key workload (card 100 → int8 codes, the 4x
+    narrowing class):
+
+    - a cold string-key count-join measured with the flag on vs off, with the
+      `transfer.h2d.bytes` delta for each mode → ``h2d_reduction_x``;
+    - the pow2 padding split of the ON leg (payload vs padded bytes).
+
+    The mesh half — `parallel.exchange.bytes_moved` on vs off → the
+    ``bytes_moved_reduction_x`` the code-space exchange buys (flat 20 B/row
+    send lanes vs coded 8 B/row) — needs a multi-device mesh, so it runs in
+    `run_mesh_bench`'s forced-8-device child and `_finish` folds it into this
+    section's dict.
+
+    `tools/bench_compare.py --keys 'encoded_device*'` gates these: the two
+    reduction ratios are higher-is-better counters, the seconds are timings."""
+    from hyperspace_tpu.engine import io as _eio
+    from hyperspace_tpu.engine.physical import clear_device_memos
+    from hyperspace_tpu.engine.scan_cache import (
+        global_bucketed_cache,
+        global_concat_cache,
+        global_filtered_cache,
+        global_scan_cache,
+    )
+    from hyperspace_tpu.engine.table import Table as _T
+    from hyperspace_tpu.hyperspace import disable_hyperspace
+    from hyperspace_tpu.telemetry import metrics
+
+    n = int(os.environ.get("BENCH_ENCODED_DEVICE_ROWS", 300_000))
+    n_dim = max(n // 8, 1000)
+    card = 100  # int8 code class — where narrowing bites hardest
+    fact_dir = os.path.join(base, "fact_encdev")
+    dim_dir = os.path.join(base, "dim_encdev")
+    rng = np.random.RandomState(29)
+    dictionary = np.asarray([f"sku#{i:04d}" for i in range(card)])
+    _eio.write_parquet(
+        _T.from_pydict(
+            {
+                "k": dictionary[rng.randint(0, card, n)].tolist(),
+                "v": rng.randint(0, 1000, n).astype(np.int64).tolist(),
+            }
+        ),
+        os.path.join(fact_dir, "part-00000.parquet"),
+    )
+    _eio.write_parquet(
+        _T.from_pydict(
+            {
+                "k": dictionary[rng.randint(0, card, n_dim)].tolist(),
+                "w": rng.randint(0, 100, n_dim).astype(np.int64).tolist(),
+            }
+        ),
+        os.path.join(dim_dir, "part-00000.parquet"),
+    )
+
+    def q_join():
+        return s.read.parquet(fact_dir).join(
+            s.read.parquet(dim_dir), col("k") == col("k")
+        )
+
+    def clear():
+        global_scan_cache().clear()
+        global_concat_cache().clear()
+        global_filtered_cache().clear()
+        global_bucketed_cache().clear()
+        clear_device_memos()
+
+    def cval(name):
+        return metrics.counter(name).value
+
+    env_key = "HYPERSPACE_ENCODED_DEVICE"
+    saved = os.environ.get(env_key)
+    out = {"rows": n, "key_cardinality": card}
+    try:
+        disable_hyperspace(s)
+        rows_seen = None
+        for label, flag in (("on", "1"), ("off", "0")):
+            os.environ[env_key] = flag
+            clear()
+            h0 = cval("transfer.h2d.bytes")
+            p0 = cval("pad.bytes_payload"), cval("pad.bytes_padded")
+            t0 = _now()
+            rows = q_join().count()
+            out[f"join_cold_{label}_s"] = round(_now() - t0, 3)
+            out[f"h2d_bytes_{label}"] = cval("transfer.h2d.bytes") - h0
+            if rows_seen is None:
+                rows_seen = rows
+            assert rows == rows_seen, (rows, rows_seen)  # flag oracle
+            if label == "on":
+                payload = cval("pad.bytes_payload") - p0[0]
+                padded = cval("pad.bytes_padded") - p0[1]
+                out["pad_ratio_on"] = round(
+                    padded / max(payload + padded, 1), 4
+                )
+        out["join_rows"] = int(rows_seen)
+        out["h2d_reduction_x"] = round(
+            out["h2d_bytes_off"] / max(out["h2d_bytes_on"], 1), 2
+        )
+    finally:
+        if saved is None:
+            os.environ.pop(env_key, None)
+        else:
+            os.environ[env_key] = saved
+    return {"encoded_device": out}
 
 
 def _serving_section(s, base, col, runs, hs) -> dict:
@@ -2185,7 +2298,65 @@ def run_mesh_bench() -> dict:
         def delta(key):
             return int(c1.get(key, 0) - c0.get(key, 0))
 
+        # -- device-resident codes over the mesh wire -----------------------
+        # String-key builds with HYPERSPACE_ENCODED_DEVICE on vs off: the
+        # coded exchange sends (narrow bucket + int8 validity + int32 rowid +
+        # int8 codes) where the flat one sends (u32 hash + i32 validity + i64
+        # rowid + i32 codes). Runs AFTER the compile-once asserts — the coded
+        # and string-sort shapes are their own compile classes, outside the
+        # int-key workload those asserts pin. `_finish` folds this dict into
+        # `bench_detail.encoded_device` next to the H2D half.
+        n_enc = int(os.environ.get("BENCH_ENCODED_DEVICE_ROWS", 300_000))
+        card = 100  # int8 code class
+        dictionary = np.asarray([f"sku#{i:04d}" for i in range(card)])
+        s.write_parquet(
+            {
+                "sk": dictionary[rng.randint(0, card, n_enc)],
+                "v": rng.randint(0, 1000, n_enc).astype(np.int64),
+            },
+            os.path.join(base, "fact_encdev"),
+        )
+        disable_hyperspace(s)
+        enc = {"rows": n_enc, "key_cardinality": card}
+        saved_ed = os.environ.get("HYPERSPACE_ENCODED_DEVICE")
+        try:
+            from hyperspace_tpu.engine.physical import clear_device_memos
+            from hyperspace_tpu.engine.scan_cache import (
+                global_bucketed_cache,
+                global_filtered_cache,
+            )
+
+            for label, flag in (("on", "1"), ("off", "0")):
+                os.environ["HYPERSPACE_ENCODED_DEVICE"] = flag
+                global_scan_cache().clear()
+                global_concat_cache().clear()
+                global_filtered_cache().clear()
+                global_bucketed_cache().clear()
+                clear_device_memos()
+                m0 = metrics.counter("parallel.exchange.bytes_moved").value
+                t0 = _now()
+                hs.create_index(
+                    s.read.parquet(os.path.join(base, "fact_encdev")),
+                    IndexConfig(f"encDev{label}", ["sk"], ["v"]),
+                )
+                enc[f"build_{label}_s"] = round(_now() - t0, 3)
+                enc[f"exchange_bytes_moved_{label}"] = (
+                    metrics.counter("parallel.exchange.bytes_moved").value - m0
+                )
+                hs.delete_index(f"encDev{label}")
+            enc["bytes_moved_reduction_x"] = round(
+                enc["exchange_bytes_moved_off"]
+                / max(enc["exchange_bytes_moved_on"], 1),
+                2,
+            )
+        finally:
+            if saved_ed is None:
+                os.environ.pop("HYPERSPACE_ENCODED_DEVICE", None)
+            else:
+                os.environ["HYPERSPACE_ENCODED_DEVICE"] = saved_ed
+
         return {
+            "encoded_device": enc,
             # These run on ONE host pretending to be 8 devices — never quote
             # them as speedups (r3 weak item 6).
             "virtual_mesh": True,
@@ -2571,6 +2742,17 @@ def _finish(result: dict, diag: dict, t_setup0: float) -> None:
     detail = result.get("detail", {})
     if not (os.environ.get("BENCH_SKIP_MESH") or os.environ.get("BENCH_SKIP_DIST")):
         detail["mesh"] = _run_mesh_subprocess()
+        # The encoded-device section's mesh half (exchange bytes_moved on vs
+        # off) is measured inside the multi-device child; fold it in next to
+        # the section's own H2D half so `bench_detail.encoded_device` carries
+        # the whole story.
+        enc_dev = (
+            detail["mesh"].pop("encoded_device", None)
+            if isinstance(detail.get("mesh"), dict)
+            else None
+        )
+        if isinstance(enc_dev, dict):
+            detail.setdefault("encoded_device", {}).update(enc_dev)
     detail["backend_probe"] = diag
     detail["setup_s"] = round(_now() - t_setup0, 1)
     # Full detail on its own line; the compact machine-readable record LAST

@@ -3,7 +3,14 @@
 A query executes many device ops over the same cached host columns; re-uploading
 them per query would dominate on a real TPU (HBM transfers over PCIe/tunnel). Device
 arrays are cached per host-array identity (weakref-keyed, so entries die with their
-host arrays — which are themselves owned by the scan cache)."""
+host arrays — which are themselves owned by the scan cache).
+
+Encoded staging (`engine/encoded_device.py`) uploads NARROW code lanes through
+the same memo: callers pass `site`/`flat_bytes`/`charged_bytes` so the entry is
+charged its TRUE encoded footprint (codes + dictionary + validity — the PR-8
+ScanCache accounting, applied to device memory), warm hits tick
+`cache.device_upload.encoded_hits`, and the miss records the flat-vs-staged
+byte split in the encoded-staging ledger."""
 
 from __future__ import annotations
 
@@ -22,12 +29,19 @@ from ..telemetry import metrics as _metrics
 # over cached host columns) — per-call cost is one locked int add.
 _HITS = _metrics.counter("cache.device_upload.hits")
 _MISSES = _metrics.counter("cache.device_upload.misses")
+# Warm hits served from CODE-SPACE entries: how much of the steady state rides
+# encoded staging (the device mirror of `cache.scan.encoded_hits`).
+_ENCODED_HITS = _metrics.counter("cache.device_upload.encoded_hits")
 # Footprint watermarks (exporter frames / prometheus): live bytes pinned by
 # the upload memo, and the high-water mark across the process lifetime.
 _CACHE_BYTES = _metrics.gauge("cache.device_upload.bytes")
 _CACHE_BYTES_PEAK = _metrics.gauge("cache.device_upload.bytes_peak")
 
-_cache: dict = {}  # id(host) -> (weakref, device_array); insertion order = LRU
+# id(host) -> (weakref, device_array, charged_bytes, encoded); insertion
+# order = LRU. `charged_bytes` is what the budget accounting carries for the
+# entry — the device array's own bytes for flat stages, the TRUE encoded
+# footprint for code-space stages.
+_cache: dict = {}
 # Device copies are pinned until their host arrays die (the scan cache bounds
 # hosts at 4 GiB); this byte budget additionally bounds DEVICE memory so the
 # memo can never approach HBM capacity on its own.
@@ -53,21 +67,29 @@ def _evict_over_budget(protect_key) -> None:
             return
         dropped = _cache.pop(victim, None)
         if dropped is not None:
-            _bytes -= int(dropped[1].nbytes)
+            _bytes -= int(dropped[2])
             _note_bytes()
 
 
-def device_array(host: np.ndarray):
-    """jnp view of a host numpy array, cached by identity."""
+def device_array(host: np.ndarray, *, site=None, flat_bytes=None, charged_bytes=None):
+    """jnp view of a host numpy array, cached by identity.
+
+    `flat_bytes`/`charged_bytes`/`site` mark an ENCODED stage (narrow code
+    lane): the entry is charged `charged_bytes` against the byte budget, the
+    upload miss records `flat_bytes` vs the actual narrow bytes in the
+    encoded-staging ledger, and warm hits tick the encoded-hit counter."""
     global _bytes
     if not isinstance(host, np.ndarray):
         return jnp.asarray(host)
+    encoded = flat_bytes is not None
     key = id(host)
     with _lock:
         hit = _cache.get(key)
         if hit is not None and hit[0]() is host:
             _cache[key] = _cache.pop(key)  # LRU refresh
             _HITS.inc()
+            if hit[3]:
+                _ENCODED_HITS.inc()
             return hit[1]
 
     _MISSES.inc()
@@ -86,6 +108,9 @@ def device_array(host: np.ndarray):
         upload_s = None
     _accounting.add("device_upload_bytes", int(dev.nbytes))
     _devobs.record_h2d(int(dev.nbytes), upload_s)
+    if encoded:
+        _devobs.record_encoded_stage(site or "?", int(flat_bytes), int(dev.nbytes))
+    charged = int(charged_bytes) if charged_bytes is not None else int(dev.nbytes)
 
     def _evict(wr, key=key):
         # Only drop the entry this weakref installed: a dead array's id can be
@@ -95,7 +120,7 @@ def device_array(host: np.ndarray):
             ent_now = _cache.get(key)
             if ent_now is not None and ent_now[0] is wr:
                 _cache.pop(key, None)
-                _bytes -= int(ent_now[1].nbytes)
+                _bytes -= int(ent_now[2])
                 _note_bytes()
 
     try:
@@ -107,9 +132,9 @@ def device_array(host: np.ndarray):
         if hit is not None:
             if hit[0]() is host:
                 return hit[1]  # raced: reuse the first upload, drop ours
-            _bytes -= int(hit[1].nbytes)  # displaced stale entry leaves accounting
-        _cache[key] = (ref, dev)
-        _bytes += int(dev.nbytes)
+            _bytes -= int(hit[2])  # displaced stale entry leaves accounting
+        _cache[key] = (ref, dev, charged, encoded)
+        _bytes += charged
         _note_bytes()
         _evict_over_budget(key)
     return dev

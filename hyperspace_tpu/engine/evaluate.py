@@ -50,7 +50,19 @@ def _and_valid(a, b):
 
 def _device(table: Table, devcols: Dict[str, jnp.ndarray], name: str):
     if name not in devcols:
-        devcols[name] = device_array(table.column(name).data)
+        col = table.column(name)
+        if col.is_string:
+            # Qualifying string columns UPLOAD narrow dictionary codes and
+            # widen on device (encoded_device.py): H2D moves the compressed
+            # lane, downstream code-space ops keep seeing int32.
+            from .encoded_device import stage_codes
+
+            arr = stage_codes(col, "eval_pred")
+            if arr.dtype != jnp.int32:
+                arr = arr.astype(jnp.int32)
+            devcols[name] = arr
+        else:
+            devcols[name] = device_array(col.data)
     return devcols[name]
 
 
@@ -675,6 +687,10 @@ def _pow2_padded_eager_mask(expr: Expr, table: Table):
                 pad_payload += n
                 pad_padded += m - n
             cols[sp] = Column(c.dtype, data, c.dictionary, valid)
+            if getattr(c, "_encoded_read", False):
+                # Padded copies keep the encoded-read provenance so the
+                # eager fallback's device staging still rides narrow codes.
+                cols[sp]._encoded_read = True
         from ..telemetry import device_observatory as _devobs
 
         _devobs.record_pad("eval_mask", pad_payload, pad_padded)

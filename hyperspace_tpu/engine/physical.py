@@ -1071,9 +1071,15 @@ class _JoinedDeviceEnv:
 
     def _gather(self, side: str, col: Column):
         from ..ops.aggregate import DevCol
+        from .encoded_device import stage_codes
 
         idx = self.li if side == "l" else self.ri
-        arr = device_array(col.data)[idx]
+        # Upload narrow codes, gather the SURVIVING rows, widen on device:
+        # the H2D transfer moves the compressed lane; DevCol consumers keep
+        # seeing int32 codes (late materialization stays downstream).
+        arr = stage_codes(col, "join_gather")[idx]
+        if col.is_string and arr.dtype != jnp.int32:
+            arr = arr.astype(jnp.int32)
         valid = (
             device_array(col.validity)[idx] if col.validity is not None else None
         )
@@ -1098,7 +1104,9 @@ class _JoinedDeviceEnv:
                 continue
             plan[lname] = col
             sides.append(side)
-            arrays.append(device_array(col.data))
+            from .encoded_device import stage_codes
+
+            arrays.append(stage_codes(col, "join_gather"))
             if col.validity is not None:
                 sides.append(side)
                 arrays.append(device_array(col.validity))
@@ -1108,6 +1116,10 @@ class _JoinedDeviceEnv:
         i = 0
         for lname, col in plan.items():
             arr = gathered[i]
+            if col.is_string and arr.dtype != jnp.int32:
+                # Narrow-staged codes widen AFTER the gather (on device, over
+                # surviving rows only) so DevCol consumers see int32 codes.
+                arr = arr.astype(jnp.int32)
             i += 1
             valid = None
             if col.validity is not None:
@@ -2166,8 +2178,15 @@ def _table_key64(table: Table, keys: List[str], force_float=None):
     JOINT decision of both join sides — see `_joint_float_flags`)."""
 
     def compute():
+        from .encoded_device import stage_codes
+
         cols = [table.column(k) for k in keys]
-        return key64(cols, [device_array(c.data) for c in cols], force_float)
+        # String keys stage as narrow dictionary codes when they qualify
+        # (encoded_device.py): the hash lane gathers dh_table[codes], so the
+        # key64 VALUES are identical — only the upload bytes shrink.
+        return key64(
+            cols, [stage_codes(c, "join_key64") for c in cols], force_float
+        )
 
     subkey = (
         tuple(k.lower() for k in keys),
@@ -2219,6 +2238,8 @@ def _verify_lanes(
     """Device inputs for the fused pair-verification programs: per key pair the
     comparable value arrays (union-dictionary-aligned codes for strings) plus
     any validity lanes — the device mirror of `_verify_pairs`' semantics."""
+    from .encoded_device import stage_aligned
+
     lanes, flat = [], []
     for lk, rk in zip(left_keys, right_keys):
         lc, rc = left.column(lk), right.column(rk)
@@ -2226,10 +2247,15 @@ def _verify_lanes(
             raise HyperspaceException("Join key type mismatch (string vs numeric)")
         if lc.is_string:
             la, ra = _aligned_key_codes(left, right, lk, rk)
+            # Union-aligned codes stage narrow when the source columns
+            # qualify: the verification compares code VALUES for equality,
+            # which narrowing preserves (encoded_device.py).
+            flat.append(stage_aligned(la, lc, "join_verify"))
+            flat.append(stage_aligned(ra, rc, "join_verify"))
         else:
             la, ra = lc.data, rc.data
-        flat.append(device_array(la))
-        flat.append(device_array(ra))
+            flat.append(device_array(la))
+            flat.append(device_array(ra))
         lv = lc.validity is not None
         rv = rc.validity is not None
         lanes.append((lv, rv))

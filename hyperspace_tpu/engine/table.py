@@ -79,7 +79,13 @@ class Column:
 
     def take(self, indices: np.ndarray) -> "Column":
         v = self.validity[indices] if self.validity is not None else None
-        return Column(self.dtype, self.data[indices], self.dictionary, v)
+        out = Column(self.dtype, self.data[indices], self.dictionary, v)
+        if getattr(self, "_encoded_read", False):
+            # The encoded-read provenance marker survives row selection: the
+            # codes are the same dictionary's (engine/encoded_device.py gates
+            # device code staging on it in auto mode).
+            out._encoded_read = True
+        return out
 
     @staticmethod
     def from_values(values: np.ndarray) -> "Column":
@@ -227,6 +233,9 @@ class Table:
                     union = np.union1d(union, c.dictionary)
                 codes = np.concatenate([_remap_codes(c, union) for c in cols])
                 out[n] = Column(STRING, codes, union, validity)
+                if all(getattr(c, "_encoded_read", False) for c in cols):
+                    # Every child rode an encoded read → the union column did.
+                    out[n]._encoded_read = True
             else:
                 data = np.concatenate([c.data for c in cols])
                 # Mixed numeric widths promote in the concatenate; the dtype

@@ -695,25 +695,33 @@ def hash_aggregate(table: Table, group_keys, aggs: Sequence[AggTriple]) -> Table
         direct = _direct_host_aggregate(table, group_keys, key_cols, aggs)
         if direct is not None:
             return direct
-    arrs = [device_array(c.data) for c in key_cols]
+    from ..engine.encoded_device import stage_codes
+
+    arrs = [stage_codes(c, "agg_keys") for c in key_cols]
     k64 = key64(key_cols, arrs)
 
     # Group boundaries from ADJACENT ACTUAL VALUES (+ validity), never the
     # hash. ONE host-side lane list (data [+ validity] per key column); the
-    # device branch maps it through the memoized upload cache, the host
-    # branch consumes it directly.
+    # device branch stages each lane through the memoized upload cache
+    # (string keys as narrow codes — adjacent equality is value-preserving
+    # under narrowing), the host branch consumes the flat lanes directly.
     flat_host = []
     has_valid = []
+    flat_dev = [] if device else None
     for c in key_cols:
         flat_host.append(c.data)
+        if device:
+            flat_dev.append(stage_codes(c, "agg_keys"))
         has_valid.append(c.validity is not None)
         if c.validity is not None:
             flat_host.append(c.validity)
+            if device:
+                flat_dev.append(device_array(c.validity))
     if device:
         # One fused program for sort + boundary detection + group ids: each
         # eager op is a dispatch, and on the axon relay a round-trip.
         perm, boundary, gid = _group_ids_fused(
-            tuple(has_valid), k64, *(device_array(a) for a in flat_host)
+            tuple(has_valid), k64, *flat_dev
         )
         n_groups = int(gid[-1]) + 1
         seg_rows = jax.ops.segment_sum(
@@ -1125,7 +1133,9 @@ class StreamAggregator:
             # passes instead of a per-chunk hash-sort — the same trade
             # `_direct_host_aggregate` makes for the one-pass path.
             return self._partial_host_direct(t, key_cols, layout)
-        k64 = key64(key_cols, [device_array(c.data) for c in key_cols])
+        from ..engine.encoded_device import stage_codes
+
+        k64 = key64(key_cols, [stage_codes(c, "agg_keys") for c in key_cols])
         perm = stable_argsort_host(k64)
         flat_host, has_valid = [], []
         for c in key_cols:
@@ -1236,7 +1246,23 @@ class StreamAggregator:
             return jax.device_put(_pad_repeat_first(host_arr, cap))
 
         key_cols = [t.column(k) for k in self.group_keys]
-        staged_keys = [_stage(c.data) for c in key_cols]
+        from ..engine.encoded_device import column_qualifies, narrow_codes
+
+        enc_split = [0, 0]  # [flat, staged] bytes of narrowed key lanes
+
+        def _key_lane(c):
+            # Qualifying string keys stage as narrow codes; the rep
+            # materialization below widens back to int32 before any Column
+            # is built, and key64/group boundaries are value-preserving.
+            if column_qualifies(c):
+                narrow = narrow_codes(c)
+                if narrow is not c.data:
+                    enc_split[0] += int(c.data.nbytes)
+                    enc_split[1] += int(narrow.nbytes)
+                    return narrow
+            return c.data
+
+        staged_keys = [_stage(_key_lane(c)) for c in key_cols]
         k64 = key64(key_cols, staged_keys)
         flat, has_valid = [], []
         staged_valid = []
@@ -1293,6 +1319,8 @@ class StreamAggregator:
                 lanes.append(_stage(col.validity))
         _devobs.record_pad("agg_partials", staged_bytes[0], staged_bytes[1])
         _devobs.record_h2d(staged_bytes[0] + staged_bytes[1])
+        if enc_split[1]:
+            _devobs.record_encoded_stage("agg_partials", enc_split[0], enc_split[1])
         row_valid = jnp.arange(cap) < n
         donate = jax.default_backend() != "cpu"
         results = jax.device_get(
@@ -1386,8 +1414,10 @@ class StreamAggregator:
                 for data, lo, st in zip(datas, los, strides):
                     gid0 += (data.astype(np.int64) - lo) * st
                 return np.argsort(gid0, kind="stable")
+        from ..engine.encoded_device import stage_codes
+
         k64 = np.asarray(
-            key64(key_cols, [device_array(c.data) for c in key_cols])
+            key64(key_cols, [stage_codes(c, "agg_keys") for c in key_cols])
         )
         return np.argsort(k64, kind="stable")
 

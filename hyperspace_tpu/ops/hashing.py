@@ -198,6 +198,11 @@ def column_hash_u32(column: Column, device_data, seed: np.uint32):
 
     ``device_data`` is the column's device representation (codes for strings)."""
     if column.is_string:
+        # Narrow code lanes must widen before the gather: the pow2-padded
+        # table's axis size (e.g. 128) can exceed the narrow index dtype's
+        # range. The cast runs on device — H2D already moved narrow bytes.
+        if device_data.dtype != jnp.int32:
+            device_data = device_data.astype(jnp.int32)
         return host_hash_dictionary(column.dictionary, int(seed))[device_data]
     return hash_device_values(device_data, seed)
 
@@ -210,7 +215,13 @@ def _lane_trace(seed, dh_slot, cols):
     h = None
     for c in cols:
         if c[0] == "str":
-            hc = c[2 + dh_slot][c[1]]
+            codes = c[1]
+            # Narrow code lanes must widen before the gather: the pow2-padded
+            # table's axis size (e.g. 128) can exceed the narrow index
+            # dtype's range. On-device cast; the wire already moved narrow.
+            if codes.dtype != jnp.int32:
+                codes = codes.astype(jnp.int32)
+            hc = c[2 + dh_slot][codes]
         else:
             hc = hash_device_values(c[1], seed, force_float=(c[0] == "numf"))
         h = hc if h is None else fmix32(_mix_combine(h, hc))
@@ -258,7 +269,13 @@ def _flat_inputs(columns, device_arrays, seeds, force_float=None):
     """(kinds, flat) for the fused kernels: string columns contribute their
     codes plus one host-hashed dictionary table per seed. `force_float[i]`
     canonicalizes numeric column i through float64 (the cross-kind join
-    space — see `_words_u32`)."""
+    space — see `_words_u32`).
+
+    Code arrays may arrive NARROW (int8/int16 — engine/encoded_device.py
+    stages them that way when the dictionary fits): the string lane is a
+    `dh_table[codes]` gather, so any integer code width produces identical
+    hashes, and the width folds into the jit cache key as a bounded
+    {int8, int16, int32} class set — never a per-cardinality shape."""
     kinds, flat = [], []
     for i, (col, arr) in enumerate(zip(columns, device_arrays)):
         if col.is_string:

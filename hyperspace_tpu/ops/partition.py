@@ -112,9 +112,13 @@ def bucketize_table(
     columns within each bucket. Returns (reordered table, bucket start offsets of
     length num_buckets+1): bucket b = rows[starts[b]:starts[b+1]]."""
     cols = [table.column(c) for c in bucket_columns]
-    from ..engine.device_cache import device_array
+    from ..engine.encoded_device import stage_codes
 
-    arrs = [device_array(c.data) for c in cols]
+    # String key lanes stage as NARROW dictionary codes when the cardinality
+    # allows (engine/encoded_device.py): the hash gathers dh_table[codes] and
+    # the sort compares code VALUES, so both are bit-identical from narrow
+    # lanes — only the upload bytes shrink.
+    arrs = [stage_codes(c, "partition_build") for c in cols]
     b = bucket_id(cols, arrs, num_buckets)
     from .backend import use_device_path
 

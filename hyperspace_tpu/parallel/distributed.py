@@ -220,6 +220,127 @@ def distributed_bucketize(
 
 
 # ---------------------------------------------------------------------------
+# Code-space exchange (HYPERSPACE_ENCODED_DEVICE): same two-pass shape, but
+# the wire lanes are narrowed — the caller ships a pre-computed bucket lane in
+# the smallest width num_buckets fits (instead of the uint32 hash), an int8
+# validity lane, an int32 row id when the global row count allows, and
+# dictionary codes narrowed to the dictionary's width. Every sort operand
+# carries the SAME VALUES as the flat path (narrowing is value-preserving and
+# bucket/dest are computed from the identical h1 % num_buckets), so the
+# receive-side permutation — and therefore the index files and join outputs —
+# are byte-identical in both flag states; only `parallel.exchange.bytes_moved`
+# shrinks. Both programs keep their flat twins' observability labels: the
+# compile-per-class contract is about the label's compile COUNT per workload
+# class, and a process runs one staging mode per class.
+# ---------------------------------------------------------------------------
+
+
+@lru_cache(maxsize=128)
+def _counts_coded_program(mesh: Mesh, num_buckets: int):
+    n_dev = mesh.devices.size
+
+    def count_fn(bucket_local):
+        dest = bucket_local.astype(jnp.int32) * n_dev // num_buckets
+        one_hot = jax.nn.one_hot(dest, n_dev, dtype=jnp.int32)
+        return jnp.sum(one_hot, axis=0, keepdims=True)  # [1, n_dev]
+
+    return _observed_jit(
+        shard_map(count_fn, mesh=mesh, in_specs=P(BUCKET_AXIS), out_specs=P(BUCKET_AXIS)),
+        label="parallel.exchange_counts",
+    )
+
+
+def exchange_counts_coded(mesh: Mesh, bucket, num_buckets: int) -> np.ndarray:
+    """Pass 1 over a pre-computed (narrow) bucket-id lane."""
+    return np.asarray(_counts_coded_program(mesh, num_buckets)(bucket))
+
+
+@lru_cache(maxsize=128)
+def _exchange_coded_program(
+    mesh: Mesh, num_buckets: int, cap: int, sort_from_payload: tuple
+):
+    """Coded twin of `_exchange_program`: input lanes arrive narrow, and sort
+    keys may be REFERENCED from payload lanes (`sort_from_payload` indexes)
+    instead of shipped twice — the k64 of the exchanged join travels once."""
+    n_dev = mesh.devices.size
+
+    def fn(bucket_local, valid_local, payload_local, keys_local):
+        n_local = bucket_local.shape[0]
+        dest = bucket_local.astype(jnp.int32) * n_dev // num_buckets
+        order = jnp.argsort(dest)  # stable: ties keep original (= global) order
+        dest_s = dest[order]
+        starts = jnp.searchsorted(dest_s, jnp.arange(n_dev))
+        slot = jnp.arange(n_local) - starts[dest_s]
+
+        def scatter(col):
+            send = jnp.zeros((n_dev, cap), dtype=col.dtype)
+            send = send.at[dest_s, slot].set(col[order])
+            return jax.lax.all_to_all(
+                send, BUCKET_AXIS, split_axis=0, concat_axis=0, tiled=False
+            )
+
+        valid_recv = scatter(valid_local)
+        bucket_recv = scatter(bucket_local)
+        payload_recv = [scatter(c) for c in payload_local]
+        keys_recv = [scatter(c) for c in keys_local]
+
+        # Receive-side widening is free (post-wire); the sort operand VALUES
+        # match the flat program's exactly, so the permutation — and with it
+        # the canonical stable build order — is identical.
+        flat_valid = valid_recv.reshape(-1).astype(jnp.int32)
+        bucket = bucket_recv.reshape(-1).astype(jnp.int32)
+        sort_lanes = [payload_recv[i].reshape(-1) for i in sort_from_payload]
+        sort_lanes += [k.reshape(-1) for k in keys_recv]
+        sort_operands = (
+            1 - flat_valid,
+            bucket,
+            *sort_lanes,
+            jnp.arange(flat_valid.shape[0], dtype=jnp.int32),
+        )
+        res = jax.lax.sort(sort_operands, num_keys=2 + len(sort_lanes))
+        perm = res[-1]
+        out_bucket = bucket[perm][None]
+        out_valid = flat_valid[perm][None]
+        out_payload = [c.reshape(-1)[perm][None] for c in payload_recv]
+        return out_bucket, out_valid, out_payload
+
+    return _observed_jit(
+        shard_map(
+            fn,
+            mesh=mesh,
+            in_specs=(P(BUCKET_AXIS), P(BUCKET_AXIS), P(BUCKET_AXIS), P(BUCKET_AXIS)),
+            out_specs=(P(BUCKET_AXIS), P(BUCKET_AXIS), P(BUCKET_AXIS)),
+        ),
+        label="parallel.exchange",
+    )
+
+
+def distributed_bucketize_coded(
+    mesh: Mesh,
+    bucket,
+    payload: Sequence[jnp.ndarray],
+    sort_keys: Sequence[jnp.ndarray],
+    num_buckets: int,
+    in_valid,
+    n_valid: int,
+    sort_from_payload: Sequence[int] = (),
+):
+    """Two-pass distributed bucketize over NARROW lanes: `bucket` is the
+    pre-computed (h1 % num_buckets) lane in its smallest width, `in_valid` is
+    int8, and `sort_from_payload` names payload lanes that double as sort
+    keys (so they are not shipped twice). Output contract (and bytes of the
+    output) match `distributed_bucketize`: int32 bucket ids, int32 validity,
+    payload lanes in their input dtypes."""
+    counts = exchange_counts_coded(mesh, bucket, num_buckets)
+    cap = quantize_cap(int(counts.max()) if counts.size else 0)
+    n_dev = mesh.devices.size
+    _record_exchange(n_valid, n_dev, cap, [bucket, in_valid, *payload, *sort_keys])
+    return _exchange_coded_program(
+        mesh, num_buckets, cap, tuple(sort_from_payload)
+    )(bucket, in_valid, list(payload), list(sort_keys))
+
+
+# ---------------------------------------------------------------------------
 # Distributed co-bucketed join: zero-communication by construction
 # ---------------------------------------------------------------------------
 

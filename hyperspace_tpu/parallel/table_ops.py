@@ -41,11 +41,37 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..engine.table import Table
 from ..ops.hashing import _SEED1, combined_hash_u32, key64
 from ..telemetry.compile_log import observed_jit as _observed_jit
-from .distributed import distributed_bucketize
+from .distributed import distributed_bucketize, distributed_bucketize_coded
 from .mesh import BUCKET_AXIS, quantize_cap, quantized_rows, row_sharding
 from .shim import shard_map
 
 _PAD = np.iinfo(np.int64).max
+
+
+def _bucket_lane_dtype(num_buckets: int):
+    """Smallest signed width carrying bucket ids [0, num_buckets)."""
+    if num_buckets <= 127:
+        return np.int8
+    if num_buckets <= 32767:
+        return np.int16
+    return np.int32
+
+
+def _coded_rowid_dtype(n_pad_total: int):
+    """int32 row ids whenever the padded global row count fits (it always
+    does at realistic per-host scales; the int64 fallback keeps the contract
+    total)."""
+    return np.int32 if n_pad_total <= np.iinfo(np.int32).max else np.int64
+
+
+def _record_coded_stage(n_rows: int, flat_lanes, coded_lanes) -> None:
+    """Encoded-vs-flat ledger entry for one exchange's wire lanes: what the
+    flat itemsizes would have staged vs what the narrow lanes stage."""
+    from ..telemetry import device_observatory as _devobs
+
+    flat = sum(n_rows * int(np.dtype(d).itemsize) for d in flat_lanes)
+    staged = sum(n_rows * int(a.dtype.itemsize) for a in coded_lanes)
+    _devobs.record_encoded_stage("mesh_exchange", flat, staged)
 
 
 def _pad_rows(arr: np.ndarray, pad: int, fill=0) -> np.ndarray:
@@ -54,10 +80,19 @@ def _pad_rows(arr: np.ndarray, pad: int, fill=0) -> np.ndarray:
     return np.concatenate([arr, np.full(pad, fill, dtype=arr.dtype)])
 
 
-def _sort_key_arrays(table: Table, columns: Sequence[str], pad: int) -> List[np.ndarray]:
+def _sort_key_arrays(
+    table: Table, columns: Sequence[str], pad: int, narrow: bool = False
+) -> List[np.ndarray]:
+    from ..engine.encoded_device import narrow_codes, narrowable
+
     out = []
     for c in columns:
-        a = table.column(c).data
+        col = table.column(c)
+        a = col.data
+        if narrow and narrowable(col):
+            # Code-space wire lane: narrowing preserves code VALUES, so the
+            # receive-side (bucket, keys..., row) sort orders identically.
+            a = narrow_codes(col)
         if a.dtype == np.bool_:
             a = a.astype(np.int32)
         out.append(_pad_rows(a, pad))
@@ -70,8 +105,17 @@ def _padded_hash_inputs(cols, pad: int):
     for the real rows, and the program traces ONE shape per pow2 class
     instead of one per exact table size. String columns ride their dictionary
     codes (pad code 0 = a valid in-range index; the pad rows are dropped by
-    the exchange's validity lane anyway)."""
-    return [jnp.asarray(_pad_rows(c.data, pad)) for c in cols]
+    the exchange's validity lane anyway), narrowed to the dictionary's width
+    when the encoded-device path is on — the hash gathers dh_table[codes],
+    so the hash values are identical from narrow lanes."""
+    from ..engine.encoded_device import narrow_codes, narrowable
+
+    return [
+        jnp.asarray(
+            _pad_rows(narrow_codes(c) if narrowable(c) else c.data, pad)
+        )
+        for c in cols
+    ]
 
 
 def _gather_valid_perm(bucket, valid, rowid) -> Tuple[np.ndarray, np.ndarray]:
@@ -108,25 +152,59 @@ def distributed_bucketize_table(
     arrs_p = _padded_hash_inputs(cols, pad)
     h1_np = np.asarray(combined_hash_u32(cols, arrs_p, _SEED1))
 
-    valid_p = np.ones(n + pad, np.int32)
-    valid_p[n:] = 0
-    rowid_p = _pad_rows(np.arange(n, dtype=np.int64), pad)
-    keys_p = _sort_key_arrays(table, bucket_columns, pad)
-
     sh = row_sharding(mesh)
 
     def put(x):
         return jax.device_put(jnp.asarray(x), sh)
 
-    bucket, out_valid, (rowid_out,) = distributed_bucketize(
-        mesh,
-        put(h1_np),
-        [put(rowid_p)],
-        [put(k) for k in keys_p],
-        num_buckets,
-        in_valid=put(valid_p),
-        n_valid=n,
-    )
+    from ..engine.encoded_device import encoded_device_enabled
+
+    if encoded_device_enabled():
+        # Code-space wire lanes: the narrow (h1 % num_buckets) lane replaces
+        # the uint32 hash, validity rides int8, row ids int32 when the
+        # padded count fits, and string sort keys travel as narrow codes.
+        # Every lane carries the SAME VALUES as the flat path, so the
+        # exchange permutation — and the index files — are byte-identical;
+        # only `parallel.exchange.bytes_moved` shrinks.
+        bucket_np = (h1_np % np.uint32(num_buckets)).astype(
+            _bucket_lane_dtype(num_buckets)
+        )
+        valid_p = np.ones(n + pad, np.int8)
+        valid_p[n:] = 0
+        rowid_p = _pad_rows(np.arange(n, dtype=_coded_rowid_dtype(n_pad_total)), pad)
+        keys_p = _sort_key_arrays(table, bucket_columns, pad, narrow=True)
+        flat_keys = [
+            np.int32 if c.data.dtype == np.bool_ else c.data.dtype for c in cols
+        ]
+        _record_coded_stage(
+            n_pad_total,
+            [np.uint32, np.int32, np.int64, *flat_keys],
+            [bucket_np, valid_p, rowid_p, *keys_p],
+        )
+        bucket, out_valid, (rowid_out,) = distributed_bucketize_coded(
+            mesh,
+            put(bucket_np),
+            [put(rowid_p)],
+            [put(k) for k in keys_p],
+            num_buckets,
+            in_valid=put(valid_p),
+            n_valid=n,
+        )
+    else:
+        valid_p = np.ones(n + pad, np.int32)
+        valid_p[n:] = 0
+        rowid_p = _pad_rows(np.arange(n, dtype=np.int64), pad)
+        keys_p = _sort_key_arrays(table, bucket_columns, pad)
+
+        bucket, out_valid, (rowid_out,) = distributed_bucketize(
+            mesh,
+            put(h1_np),
+            [put(rowid_p)],
+            [put(k) for k in keys_p],
+            num_buckets,
+            in_valid=put(valid_p),
+            n_valid=n,
+        )
     perm, bucket_v = _gather_valid_perm(bucket, out_valid, rowid_out)
     assert len(perm) == n, f"exchange dropped rows: {len(perm)} != {n}"
     starts = np.searchsorted(bucket_v, np.arange(num_buckets + 1))
@@ -162,24 +240,53 @@ def distributed_exchange_table(
     h1_np = np.asarray(combined_hash_u32(cols, arrs_p, _SEED1))
     k64_p = np.asarray(key64(cols, arrs_p))
 
-    valid_p = np.ones(n + pad, np.int32)
-    valid_p[n:] = 0
-    rowid_p = _pad_rows(np.arange(n, dtype=np.int64), pad)
-
     sh = row_sharding(mesh)
 
     def put(x):
         return jax.device_put(jnp.asarray(x), sh)
 
-    bucket, out_valid, (rowid_out, k64_out) = distributed_bucketize(
-        mesh,
-        put(h1_np),
-        [put(rowid_p), put(k64_p)],
-        [put(k64_p)],
-        num_partitions,
-        in_valid=put(valid_p),
-        n_valid=n,
-    )
+    from ..engine.encoded_device import encoded_device_enabled
+
+    if encoded_device_enabled():
+        # Code-space exchange: narrow partition lane instead of the uint32
+        # hash, int8 validity, int32 row ids when they fit — and the k64
+        # payload lane DOUBLES as the sort key (`sort_from_payload`), so it
+        # crosses the interconnect once instead of twice.
+        bucket_np = (h1_np % np.uint32(num_partitions)).astype(
+            _bucket_lane_dtype(num_partitions)
+        )
+        valid_p = np.ones(n + pad, np.int8)
+        valid_p[n:] = 0
+        rowid_p = _pad_rows(np.arange(n, dtype=_coded_rowid_dtype(n_pad_total)), pad)
+        _record_coded_stage(
+            n_pad_total,
+            [np.uint32, np.int32, np.int64, np.int64, np.int64],
+            [bucket_np, valid_p, rowid_p, k64_p],
+        )
+        bucket, out_valid, (rowid_out, k64_out) = distributed_bucketize_coded(
+            mesh,
+            put(bucket_np),
+            [put(rowid_p), put(k64_p)],
+            [],
+            num_partitions,
+            in_valid=put(valid_p),
+            n_valid=n,
+            sort_from_payload=(1,),
+        )
+    else:
+        valid_p = np.ones(n + pad, np.int32)
+        valid_p[n:] = 0
+        rowid_p = _pad_rows(np.arange(n, dtype=np.int64), pad)
+
+        bucket, out_valid, (rowid_out, k64_out) = distributed_bucketize(
+            mesh,
+            put(h1_np),
+            [put(rowid_p), put(k64_p)],
+            [put(k64_p)],
+            num_partitions,
+            in_valid=put(valid_p),
+            n_valid=n,
+        )
     valid_h = np.asarray(out_valid).reshape(-1).astype(bool)
     perm = np.asarray(rowid_out).reshape(-1)[valid_h]
     bucket_v = np.asarray(bucket).reshape(-1)[valid_h]

@@ -77,6 +77,11 @@ _COUNTER_FIELDS = (
     "d2h_bytes",
     "pad_bytes_payload",
     "pad_bytes_padded",
+    # Device-resident encoded staging (engine/encoded_device.py): bytes the
+    # flat path would have staged vs the narrow code bytes actually staged —
+    # the encoded-vs-flat split of the transfer/pad ledgers.
+    "device_code_bytes_flat",
+    "device_code_bytes_staged",
 )
 
 _current: "contextvars.ContextVar[Optional[QueryLedger]]" = contextvars.ContextVar(

@@ -76,6 +76,10 @@ _D2H_BYTES = _metrics.counter("transfer.d2h.bytes")
 _D2H_COUNT = _metrics.counter("transfer.d2h.count")
 _PAD_PAYLOAD = _metrics.counter("pad.bytes_payload")
 _PAD_PADDED = _metrics.counter("pad.bytes_padded")
+# Encoded device staging (engine/encoded_device.py): bytes the flat path
+# would have staged vs the narrow code bytes actually staged.
+_ENC_FLAT = _metrics.counter("device.encoded.bytes_flat")
+_ENC_STAGED = _metrics.counter("device.encoded.bytes_staged")
 _CAPTURES = _metrics.counter("profiler.captures")
 _CAPTURES_SUPPRESSED = _metrics.counter("profiler.captures_suppressed")
 
@@ -86,6 +90,8 @@ _last_probe: Dict[str, float] = {}
 _device_programs: Dict[str, dict] = {}
 #: site -> [payload_bytes, padded_bytes] (mirrors the per-site counters).
 _pad_sites: Dict[str, list] = {}
+#: site -> [flat_bytes, staged_bytes, count] — encoded-vs-flat staging split.
+_encoded_sites: Dict[str, list] = {}
 #: direction -> [bytes, count, seconds] (seconds only when timing is on).
 _transfers: Dict[str, list] = {"h2d": [0, 0, 0.0], "d2h": [0, 0, 0.0]}
 #: [last capture monotonic ts] — profile-capture rate limit.
@@ -262,6 +268,47 @@ def record_pad(site: str, payload_bytes: int, padded_bytes: int) -> None:
     _accounting.add("pad_bytes_padded", padded_bytes)
 
 
+def record_encoded_stage(site: str, flat_bytes: int, staged_bytes: int) -> None:
+    """One encoded (code-space) device staging event at `site`: the flat path
+    would have moved `flat_bytes` across the boundary; the narrow code lane
+    actually moved `staged_bytes`. The gap is the decoded-bytes tax the
+    device half no longer pays — the encoded-vs-flat split `tools/hsreport.py`
+    reports next to the pad tax."""
+    flat_bytes = int(flat_bytes)
+    staged_bytes = int(staged_bytes)
+    _ENC_FLAT.inc(flat_bytes)
+    _ENC_STAGED.inc(staged_bytes)
+    _metrics.counter(f"device.encoded.{site}.bytes_flat").inc(flat_bytes)
+    _metrics.counter(f"device.encoded.{site}.bytes_staged").inc(staged_bytes)
+    with _lock:
+        s = _encoded_sites.get(site)
+        if s is None:
+            s = _encoded_sites[site] = [0, 0, 0]
+        s[0] += flat_bytes
+        s[1] += staged_bytes
+        s[2] += 1
+    from . import accounting as _accounting
+
+    _accounting.add("device_code_bytes_flat", flat_bytes)
+    _accounting.add("device_code_bytes_staged", staged_bytes)
+
+
+def encoded_stage_summary() -> dict:
+    """Per-site encoded-vs-flat staging split: {site: {bytes_flat,
+    bytes_staged, count, saved_ratio}} — saved_ratio is the fraction of the
+    flat bytes that never crossed the boundary (0.0 = no saving)."""
+    with _lock:
+        out = {}
+        for site, (flat, staged, count) in sorted(_encoded_sites.items()):
+            out[site] = {
+                "bytes_flat": flat,
+                "bytes_staged": staged,
+                "count": count,
+                "saved_ratio": round((flat - staged) / flat, 4) if flat else 0.0,
+            }
+        return out
+
+
 def pad_summary() -> dict:
     """Per-site padding tax: {site: {bytes_payload, bytes_padded,
     pad_ratio}} — pad_ratio is the fraction of staged bytes that is padding
@@ -300,6 +347,7 @@ def reset() -> None:
     with _lock:
         _device_programs.clear()
         _pad_sites.clear()
+        _encoded_sites.clear()
         _last_probe.clear()
         for t in _transfers.values():
             t[0] = t[1] = 0

@@ -101,6 +101,9 @@ TRACKED_FIELDS = (
     "d2h_bytes",
     "pad_bytes_payload",
     "pad_bytes_padded",
+    # Encoded device staging split (engine/encoded_device.py).
+    "device_code_bytes_flat",
+    "device_code_bytes_staged",
 )
 
 _RECORDS = _metrics.counter("history.records")

@@ -140,6 +140,7 @@ def build_report(dir_path: str, top: int, recent_k: int) -> dict:
         "device_hotspots": _device_hotspots(baselines, top),
         "pad_tax": _pad_tax(baselines, top),
         "transfer_bandwidth": _transfer_bandwidth(baselines, top),
+        "code_staging": _code_staging(baselines, top),
     }
     return report
 
@@ -210,6 +211,33 @@ def _transfer_bandwidth(baselines: Dict[str, dict], top: int) -> List[dict]:
             }
         )
     rows.sort(key=lambda r: -r["bytes_moved"])
+    return rows[:top]
+
+
+def _code_staging(baselines: Dict[str, dict], top: int) -> List[dict]:
+    """Encoded-vs-flat device staging per class: what the lanes WOULD have
+    cost as flat int32 codes vs the narrow bytes actually moved
+    (``device_code_bytes_flat`` / ``device_code_bytes_staged``, recorded by
+    the encoded-staging ledger under ``HYPERSPACE_ENCODED_DEVICE``). A class
+    with no rows here staged nothing in code space — flat fallback or
+    numeric-only keys."""
+    rows = []
+    for fp, s in baselines.items():
+        flat = s.get("device_code_bytes_flat", 0)
+        staged = s.get("device_code_bytes_staged", 0)
+        if not (flat or staged):
+            continue
+        rows.append(
+            {
+                "fingerprint": fp,
+                "names": s.get("names"),
+                "n": s.get("n"),
+                "code_bytes_flat": flat,
+                "code_bytes_staged": staged,
+                "saved_ratio": round(1.0 - staged / flat, 4) if flat else None,
+            }
+        )
+    rows.sort(key=lambda r: -(r["code_bytes_flat"] - r["code_bytes_staged"]))
     return rows[:top]
 
 
@@ -298,6 +326,16 @@ def render(report: dict) -> str:
             lines.append(
                 f"  {h['fingerprint']}  moved={h['bytes_moved']}B"
                 f"  {gbps if gbps is not None else '-'} GB/s"
+                f"  [{','.join(h.get('names') or [])}]"
+            )
+    if report.get("code_staging"):
+        lines += ["", "device code staging (encoded vs flat H2D bytes):"]
+        for h in report["code_staging"]:
+            saved = h.get("saved_ratio")
+            saved_str = f" saved={saved:.0%}" if saved is not None else ""
+            lines.append(
+                f"  {h['fingerprint']}  flat={h['code_bytes_flat']}B"
+                f" staged={h['code_bytes_staged']}B{saved_str}"
                 f"  [{','.join(h.get('names') or [])}]"
             )
     return "\n".join(lines)
