@@ -505,6 +505,14 @@ def run_bench(deadline: float = None) -> dict:
             "encoded_device",
             lambda: d.update(_encoded_device_section(s, base, col, runs, hs)),
         )
+        # -- bit-packed sub-byte code lanes: 1/2/4-bit packing below int8
+        #    across H2D + probe-on-packed vs widen-then-probe (the mesh
+        #    exchange half runs in the forced-8-device child; _finish folds
+        #    it into this section)
+        ph.run(
+            "packed_codes",
+            lambda: d.update(_packed_codes_section(s, base, col, runs, hs)),
+        )
         # -- multi-tenant serving: N clients × mixed Q1/Q3/Q14/point workload
         #    through the QueryServer (throughput, per-class p50/p99, dedup
         #    counters, cold-scan single-flight probe)
@@ -1025,6 +1033,197 @@ def _encoded_device_section(s, base, col, runs, hs) -> dict:
         else:
             os.environ[env_key] = saved
     return {"encoded_device": out}
+
+
+def _packed_codes_section(s, base, col, runs, hs) -> dict:
+    """Bit-packed sub-byte code lanes (`HYPERSPACE_PACKED_CODES`): what 1/2/4-bit
+    lane packing buys BELOW the int8 narrow-code floor, on a ≤16-distinct
+    string-key join (card 12 → the 4-bit lane class):
+
+    - a cold string-key count-join under encoded execution with packing on vs
+      off: `transfer.h2d.bytes` per leg → ``h2d_reduction_x`` (the off leg is
+      the PR-15 int8 narrow path — the ratio is packed vs int8, not vs flat);
+    - the ON leg's `device_code_bytes_{flat,staged,packed}` deltas →
+      ``bits_per_code`` actually charged on the wire;
+    - a measured packed-words upload (64M 4-bit codes = 32 MiB of words)
+      against the host memcpy peak → ``h2d_vs_memcpy_peak``;
+    - probe-on-packed vs widen-then-probe p50 over `PackedCodeBuckets` reps
+      (`ops.bucket_join.probe_code_ranges` auto dispatch vs the forced unpack
+      fallback), with the resident rep bytes next to the int8 equivalent.
+
+    The mesh half — `parallel.exchange.bytes_moved` with packing on vs off
+    (sub-byte key/bucket/validity lanes + the 16-bit rowid wire class vs the
+    int8 coded exchange) — needs a multi-device mesh; `run_mesh_bench`'s
+    child measures it and `_finish` folds it in here.
+
+    `tools/bench_compare.py --keys 'packed*'` gates these (self-gating: keys
+    absent from both artifacts pass)."""
+    import jax
+
+    from hyperspace_tpu.engine import io as _eio
+    from hyperspace_tpu.engine.packed_codes import pack_codes_host
+    from hyperspace_tpu.engine.physical import clear_device_memos
+    from hyperspace_tpu.engine.scan_cache import (
+        global_bucketed_cache,
+        global_concat_cache,
+        global_filtered_cache,
+        global_scan_cache,
+    )
+    from hyperspace_tpu.engine.table import Table as _T
+    from hyperspace_tpu.hyperspace import disable_hyperspace
+    from hyperspace_tpu.ops import bucket_join as _bj
+    from hyperspace_tpu.telemetry import metrics
+
+    n = int(os.environ.get("BENCH_PACKED_CODES_ROWS", 300_000))
+    n_dim = max(n // 8, 1000)
+    card = 12  # biased codes fit 4 bits; probe bound (card+2 <= 16) holds too
+    fact_dir = os.path.join(base, "fact_packed")
+    dim_dir = os.path.join(base, "dim_packed")
+    rng = np.random.RandomState(31)
+    dictionary = np.asarray([f"cat#{i:02d}" for i in range(card)])
+    _eio.write_parquet(
+        _T.from_pydict(
+            {
+                "k": dictionary[rng.randint(0, card, n)].tolist(),
+                "v": rng.randint(0, 1000, n).astype(np.int64).tolist(),
+            }
+        ),
+        os.path.join(fact_dir, "part-00000.parquet"),
+    )
+    _eio.write_parquet(
+        _T.from_pydict(
+            {
+                "k": dictionary[rng.randint(0, card, n_dim)].tolist(),
+                "w": rng.randint(0, 100, n_dim).astype(np.int64).tolist(),
+            }
+        ),
+        os.path.join(dim_dir, "part-00000.parquet"),
+    )
+
+    def q_join():
+        return s.read.parquet(fact_dir).join(
+            s.read.parquet(dim_dir), col("k") == col("k")
+        )
+
+    def clear():
+        global_scan_cache().clear()
+        global_concat_cache().clear()
+        global_filtered_cache().clear()
+        global_bucketed_cache().clear()
+        clear_device_memos()
+
+    def cval(name):
+        return int(metrics.counter(name).value)
+
+    env_enc = "HYPERSPACE_ENCODED_DEVICE"
+    env_pk = "HYPERSPACE_PACKED_CODES"
+    saved = {k: os.environ.get(k) for k in (env_enc, env_pk)}
+    out = {"rows": n, "key_cardinality": card}
+    try:
+        disable_hyperspace(s)
+        os.environ[env_enc] = "1"  # both legs ride encoded execution
+        rows_seen = None
+        for label, flag in (("on", "1"), ("off", "0")):
+            os.environ[env_pk] = flag
+            clear()
+            h0 = metrics.counter("transfer.h2d.bytes").value
+            c0 = {
+                k: cval(f"device.encoded.bytes_{k}")
+                for k in ("flat", "staged", "packed")
+            }
+            t0 = _now()
+            rows = q_join().count()
+            out[f"join_cold_{label}_s"] = round(_now() - t0, 3)
+            out[f"h2d_bytes_{label}"] = (
+                metrics.counter("transfer.h2d.bytes").value - h0
+            )
+            if rows_seen is None:
+                rows_seen = rows
+            assert rows == rows_seen, (rows, rows_seen)  # flag oracle
+            code = {
+                k: cval(f"device.encoded.bytes_{k}") - c0[k]
+                for k in ("flat", "staged", "packed")
+            }
+            out[f"code_bytes_{label}"] = code
+            if label == "on" and code["packed"]:
+                # Bits actually charged per code across every packed stage.
+                out["bits_per_code"] = round(
+                    code["packed"] * 8 / max(code["flat"] // 4, 1), 2
+                )
+        out["join_rows"] = int(rows_seen)
+        out["h2d_reduction_x"] = round(
+            out["h2d_bytes_off"] / max(out["h2d_bytes_on"], 1), 2
+        )
+
+        # -- measured packed upload vs the host memcpy peak ------------------
+        n_up = 64 * 1024 * 1024  # 64M 4-bit codes -> 32 MiB of words
+        codes_up = rng.randint(0, card, n_up).astype(np.int8)
+        words_up = pack_codes_host(codes_up, 4)
+        buf = np.ones(64 * 1024 * 1024 // 8, dtype=np.float64)
+        dst = np.empty_like(buf)
+        t0 = _now()
+        np.copyto(dst, buf)
+        memcpy_gbps = buf.nbytes / max(_now() - t0, 1e-9) / 1e9
+        jax.device_put(words_up).block_until_ready()  # warm the path
+        t0 = _now()
+        jax.device_put(words_up).block_until_ready()
+        h2d_gbps = words_up.nbytes / max(_now() - t0, 1e-9) / 1e9
+        out["memcpy_peak_gbps"] = round(memcpy_gbps, 2)
+        out["packed_h2d_gbps"] = round(h2d_gbps, 2)
+        out["h2d_vs_memcpy_peak"] = round(h2d_gbps / max(memcpy_gbps, 1e-9), 4)
+
+        # -- probe-on-packed vs widen-then-probe -----------------------------
+        os.environ[env_pk] = "1"
+        n_probe = int(os.environ.get("BENCH_PACKED_PROBE_ROWS", 120_000))
+        B = 64
+        l_lens = rng.randint(0, 2 * n_probe // B, B)
+        r_lens = rng.randint(0, 2 * n_probe // B, B)
+        l_starts = np.concatenate([[0], np.cumsum(l_lens)])
+        r_starts = np.concatenate([[0], np.cumsum(r_lens)])
+        lrep = _bj.pad_buckets_by_codes(
+            rng.randint(0, card, l_starts[-1]), l_starts, card
+        )
+        rrep = _bj.pad_buckets_by_codes(
+            rng.randint(0, card, r_starts[-1]), r_starts, card
+        )
+        if lrep is not None and rrep is not None:
+
+            def sync_probe():
+                lo, cnt = _bj.probe_code_ranges(lrep, rrep)
+                np.asarray(cnt)
+
+            saved_probe = os.environ.get("HYPERSPACE_PALLAS_PROBE")
+            try:
+                sync_probe()  # compile/warm whichever path auto picks
+                packed_p50 = timed_p50(sync_probe, runs)
+                os.environ["HYPERSPACE_PALLAS_PROBE"] = "0"  # force widen path
+                sync_probe()
+                widen_p50 = timed_p50(sync_probe, runs)
+            finally:
+                if saved_probe is None:
+                    os.environ.pop("HYPERSPACE_PALLAS_PROBE", None)
+                else:
+                    os.environ["HYPERSPACE_PALLAS_PROBE"] = saved_probe
+            out["probe"] = {
+                "rows_l": int(l_starts[-1]),
+                "rows_r": int(r_starts[-1]),
+                "bits": lrep.bits,
+                "probe_packed_p50_s": packed_p50,
+                "probe_widen_p50_s": widen_p50,
+                # Resident rep words vs the int8 flat matrix it replaces.
+                "rep_bytes_packed": int(lrep.words.nbytes + rrep.words.nbytes),
+                "rep_bytes_int8": int(
+                    lrep.words.shape[0] * lrep.cap + rrep.words.shape[0] * rrep.cap
+                ),
+                "backend": jax.default_backend(),
+            }
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return {"packed_codes": out}
 
 
 def _serving_section(s, base, col, runs, hs) -> dict:
@@ -2355,8 +2554,68 @@ def run_mesh_bench() -> dict:
             else:
                 os.environ["HYPERSPACE_ENCODED_DEVICE"] = saved_ed
 
+        # -- bit-packed sub-byte lanes over the mesh wire --------------------
+        # Same build, card 12 (the 4-bit lane class), ENCODED on for BOTH
+        # legs: packing on vs off isolates what the sub-byte wire classes buy
+        # BELOW the int8 coded exchange (4-bit key + 6-bit bucket + 1-bit
+        # validity + 16-bit rowid vs int8/int8/int8/int32). `_finish` folds
+        # this into `bench_detail.packed_codes`.
+        n_pk = int(os.environ.get("BENCH_PACKED_MESH_ROWS", 60_000))
+        card_pk = 12
+        dict_pk = np.asarray([f"cat#{i:02d}" for i in range(card_pk)])
+        s.write_parquet(
+            {
+                "pk": dict_pk[rng.randint(0, card_pk, n_pk)],
+                "v": rng.randint(0, 1000, n_pk).astype(np.int64),
+            },
+            os.path.join(base, "fact_packedmesh"),
+        )
+        pk = {"rows": n_pk, "key_cardinality": card_pk}
+        saved_flags = {
+            k: os.environ.get(k)
+            for k in ("HYPERSPACE_ENCODED_DEVICE", "HYPERSPACE_PACKED_CODES")
+        }
+        try:
+            from hyperspace_tpu.engine.physical import clear_device_memos
+            from hyperspace_tpu.engine.scan_cache import (
+                global_bucketed_cache,
+                global_filtered_cache,
+            )
+
+            os.environ["HYPERSPACE_ENCODED_DEVICE"] = "1"
+            for label, flag in (("on", "1"), ("off", "0")):
+                os.environ["HYPERSPACE_PACKED_CODES"] = flag
+                global_scan_cache().clear()
+                global_concat_cache().clear()
+                global_filtered_cache().clear()
+                global_bucketed_cache().clear()
+                clear_device_memos()
+                m0 = metrics.counter("parallel.exchange.bytes_moved").value
+                t0 = _now()
+                hs.create_index(
+                    s.read.parquet(os.path.join(base, "fact_packedmesh")),
+                    IndexConfig(f"pkMesh{label}", ["pk"], ["v"]),
+                )
+                pk[f"build_{label}_s"] = round(_now() - t0, 3)
+                pk[f"exchange_bytes_moved_{label}"] = (
+                    metrics.counter("parallel.exchange.bytes_moved").value - m0
+                )
+                hs.delete_index(f"pkMesh{label}")
+            pk["bytes_moved_reduction_x"] = round(
+                pk["exchange_bytes_moved_off"]
+                / max(pk["exchange_bytes_moved_on"], 1),
+                2,
+            )
+        finally:
+            for k, v in saved_flags.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
         return {
             "encoded_device": enc,
+            "packed_codes": pk,
             # These run on ONE host pretending to be 8 devices — never quote
             # them as speedups (r3 weak item 6).
             "virtual_mesh": True,
@@ -2753,6 +3012,16 @@ def _finish(result: dict, diag: dict, t_setup0: float) -> None:
         )
         if isinstance(enc_dev, dict):
             detail.setdefault("encoded_device", {}).update(enc_dev)
+        # Same fold for the packed-lane section's mesh half.
+        pk_mesh = (
+            detail["mesh"].pop("packed_codes", None)
+            if isinstance(detail.get("mesh"), dict)
+            else None
+        )
+        if isinstance(pk_mesh, dict):
+            detail.setdefault("packed_codes", {}).update(
+                {f"mesh_{k}": v for k, v in pk_mesh.items()}
+            )
     detail["backend_probe"] = diag
     detail["setup_s"] = round(_now() - t_setup0, 1)
     # Full detail on its own line; the compact machine-readable record LAST

@@ -71,13 +71,18 @@ def _evict_over_budget(protect_key) -> None:
             _note_bytes()
 
 
-def device_array(host: np.ndarray, *, site=None, flat_bytes=None, charged_bytes=None):
+def device_array(
+    host: np.ndarray, *, site=None, flat_bytes=None, charged_bytes=None, packed=False
+):
     """jnp view of a host numpy array, cached by identity.
 
     `flat_bytes`/`charged_bytes`/`site` mark an ENCODED stage (narrow code
     lane): the entry is charged `charged_bytes` against the byte budget, the
     upload miss records `flat_bytes` vs the actual narrow bytes in the
-    encoded-staging ledger, and warm hits tick the encoded-hit counter."""
+    encoded-staging ledger, and warm hits tick the encoded-hit counter.
+    `packed=True` additionally marks the stage as a BIT-PACKED lane
+    (`engine/packed_codes.py`): the upload's true word bytes land in the
+    `packed` tier of the encoded-staging ledger."""
     global _bytes
     if not isinstance(host, np.ndarray):
         return jnp.asarray(host)
@@ -109,7 +114,12 @@ def device_array(host: np.ndarray, *, site=None, flat_bytes=None, charged_bytes=
     _accounting.add("device_upload_bytes", int(dev.nbytes))
     _devobs.record_h2d(int(dev.nbytes), upload_s)
     if encoded:
-        _devobs.record_encoded_stage(site or "?", int(flat_bytes), int(dev.nbytes))
+        _devobs.record_encoded_stage(
+            site or "?",
+            int(flat_bytes),
+            int(dev.nbytes),
+            packed_bytes=int(dev.nbytes) if packed else None,
+        )
     charged = int(charged_bytes) if charged_bytes is not None else int(dev.nbytes)
 
     def _evict(wr, key=key):

@@ -133,13 +133,36 @@ def _charged_bytes(col, narrow: np.ndarray) -> int:
     return total
 
 
+def widen_for_gather(codes):
+    """Widen a narrow (or packed-then-unpacked) code lane to int32 before it
+    INDEXES a pow2-padded table: the table's axis size (e.g. a 128-slot hash
+    table for a 100-entry dictionary) can exceed the narrow index dtype's
+    range. The cast runs on device — the wire already moved narrow/packed
+    bytes. ONE home for the widen rule (`ops/hashing.py`, `ops/aggregate.py`,
+    `engine/physical.py` gathers, and the packed tier all route here; the
+    per-site ad-hoc casts this replaces were the PR 15 wart)."""
+    import jax.numpy as jnp
+
+    if codes.dtype != jnp.int32:
+        return codes.astype(jnp.int32)
+    return codes
+
+
 def stage_codes(col, site: str):
-    """Device-stage a column's key lane: narrow codes when the column
-    qualifies, flat data (byte-identical legacy path) otherwise."""
+    """Device-stage a column's key lane: bit-packed sub-byte words when the
+    dictionary fits a packed class (`engine/packed_codes.py` — H2D moves
+    `bits` bits per code, the device unpacks back to the narrow int8 lane),
+    narrow codes when the column merely qualifies for encoded staging, flat
+    data (byte-identical legacy path) otherwise."""
     from .device_cache import device_array
 
     if not column_qualifies(col):
         return device_array(col.data)
+    from .packed_codes import packable_bits, stage_packed_codes
+
+    bits = packable_bits(col)
+    if bits is not None:
+        return stage_packed_codes(col, site, bits)
     narrow = narrow_codes(col)
     if narrow is col.data:
         return device_array(col.data)

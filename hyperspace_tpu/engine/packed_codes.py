@@ -1,0 +1,327 @@
+"""Bit-packed sub-byte code lanes: the width layer BELOW `encoded_device.py`.
+
+PR 15 narrowed dictionary codes to {int8, int16, int32} and stalled at one
+byte per code. This module extends the width policy downward with bit-packed
+classes — 1/2/4-bit lanes packed into uint32 words — so a boolean-like or
+low-cardinality string key crosses the host→device boundary (and the mesh
+exchange — `parallel/distributed.py`) at its true information width.
+
+Layout contract (the compute-on-packed soundness lemma):
+
+- Lanes are stored BIASED: lane value = code + 1, so the null code -1 folds
+  into the code space as the RESERVED lane value 0 — no separate mask lane
+  rides the wire. A dictionary of `card` entries therefore needs lane values
+  [0, card], i.e. `card + 1 <= 2**bits` (`bits_for_cardinality`).
+- Lanes pack BIG-ENDIAN within each uint32 word: lane j of a word occupies
+  bits [32 - bits*(j+1), 32 - bits*j). Consequence: comparing two packed
+  words as UNSIGNED integers compares their lane tuples lexicographically —
+  which is what lets the probe/sort kernels (`ops/pallas_probe.py`,
+  `ops/pallas_sort.py`) compare packed words directly and unpack only
+  survivors. `tests/test_packed_codes.py` pins both bijectivity and this
+  order lemma property-style.
+- The probe/sort compute path additionally reserves the TOP lane value
+  `2**bits - 1` as the pad slot (pads must sort LAST), so it requires
+  `card + 2 <= 2**bits` (`probe_bits_for_cardinality`).
+
+Compile-class boundedness (the PR 15 trick, continued): `bits` comes from the
+BOUNDED class set {1, 2, 4} (plus the 16-bit wire class the mesh exchange
+uses for row ids). The H2D buffer itself is word-granular EXACT (the wire
+moves only real bits — like the narrow path's exact-byte uploads); the word
+array zero-pads to pow2 on the device side before the jitted unpack runs, so
+the programs compile once per (bits, pow2-size) class — never per
+cardinality. Asserted in tests via the compile observatory.
+
+Gate: `HYPERSPACE_PACKED_CODES` — unset = auto (rides
+`HYPERSPACE_ENCODED_DEVICE`: packing is a refinement of encoded staging),
+`1` = force, `0` = byte-identical narrow/flat fallback in the standing
+oracle style (index files and query results sha256-identical across flag
+states — pinned by tests/test_packed_codes.py).
+"""
+
+from __future__ import annotations
+
+import os
+import weakref
+
+import numpy as np
+
+ENV_PACKED_CODES = "HYPERSPACE_PACKED_CODES"
+
+_WORD_BITS = 32
+#: The bounded sub-byte width-class set. 8/16-bit lanes already travel at
+#: their true width through the PR 15 narrow layer; 16 additionally serves as
+#: a WIRE class for mesh-exchange row ids (`parallel/table_ops.py`).
+PACKED_BITS = (1, 2, 4)
+
+
+def packed_codes_mode() -> str:
+    """"off" | "force" | "auto" (the unset default)."""
+    raw = os.environ.get(ENV_PACKED_CODES)
+    if raw is None or raw == "":
+        return "auto"
+    if raw == "0":
+        return "off"
+    return "force"
+
+
+def packed_codes_enabled() -> bool:
+    """Is the bit-packed lane layer on? Auto rides the encoded-device switch:
+    packing refines narrow staging, so it inherits that path's gate."""
+    mode = packed_codes_mode()
+    if mode == "off":
+        return False
+    if mode == "force":
+        return True
+    from .encoded_device import encoded_device_enabled
+
+    return encoded_device_enabled()
+
+
+def lanes_per_word(bits: int) -> int:
+    return _WORD_BITS // bits
+
+
+def bits_for_cardinality(card: int):
+    """Smallest packed width whose lane space holds biased codes [0, card]
+    (code + 1; the reserved 0 is the folded null). None = sub-byte packing
+    does not apply — the dictionary rides the narrow {int8,int16} classes."""
+    for bits in PACKED_BITS:
+        if card + 1 <= (1 << bits):
+            return bits
+    return None
+
+
+def probe_bits_for_cardinality(card: int):
+    """Packed width for the COMPUTE path (probe/sort on packed words): the
+    top lane value `2**bits - 1` is additionally reserved as the pad slot
+    (pads must sort last), so the class bound tightens by one."""
+    for bits in PACKED_BITS:
+        if card + 2 <= (1 << bits):
+            return bits
+    return None
+
+
+#: Mesh WIRE classes: the sub-byte set plus 8/16 — an int32 row-id lane packs
+#: at 16 bits whenever the padded global row count fits, which is where the
+#: exchange's bytes_moved win actually lives (row ids dominate the coded wire).
+WIRE_BITS = (1, 2, 4, 8, 16)
+
+
+def wire_bits_for_range(n_values: int):
+    """Smallest mesh-wire class holding unsigned field values [0, n_values);
+    None when even 16 bits is too narrow (the lane ships unpacked)."""
+    for bits in WIRE_BITS:
+        if n_values <= (1 << bits):
+            return bits
+    return None
+
+
+def _pow2(n: int) -> int:
+    return 1 << max(int(n) - 1, 0).bit_length() if n > 1 else 1
+
+
+def packed_lane_count(n: int, bits: int) -> int:
+    """EXACT word-granular lane count a length-`n` code array packs to: the
+    H2D upload moves only real bits (at most one word of tail padding — the
+    narrow int8 path uploads exact bytes too, so the packed-vs-narrow byte
+    ratio stays the intrinsic `8/bits`). Pow2 quantization happens on the
+    DEVICE side (`unpack_codes_device` zero-pads the word array before the
+    jitted unpack), so the compile grid stays bounded without taxing the
+    wire."""
+    lpw = lanes_per_word(bits)
+    return -(-max(int(n), 1) // lpw) * lpw
+
+
+def packed_word_count(n: int, bits: int) -> int:
+    return packed_lane_count(n, bits) // lanes_per_word(bits)
+
+
+def pack_codes_host(codes: np.ndarray, bits: int) -> np.ndarray:
+    """Pack a host code array (values >= -1) into big-endian biased uint32
+    words. Lanes past `len(codes)` (the sub-word tail) hold the reserved 0 —
+    they unpack to the null code -1 and are sliced off by the consumer."""
+    n = int(len(codes))
+    lpw = lanes_per_word(bits)
+    n_lanes = packed_lane_count(n, bits)
+    biased = np.zeros(n_lanes, np.uint32)
+    biased[:n] = (codes.astype(np.int64) + 1).astype(np.uint32)
+    lanes = biased.reshape(-1, lpw)
+    shifts = (_WORD_BITS - bits * (np.arange(lpw) + 1)).astype(np.uint32)
+    return (lanes << shifts[None, :]).sum(axis=1, dtype=np.uint32)
+
+
+def unpack_codes_host(words: np.ndarray, n: int, bits: int) -> np.ndarray:
+    """Host inverse of `pack_codes_host`: the first `n` lanes, un-biased back
+    to codes (reserved 0 -> the null code -1). The round trip is bijective
+    for every dictionary within the class bound (pinned property-style)."""
+    lpw = lanes_per_word(bits)
+    mask = np.uint32((1 << bits) - 1)
+    shifts = (_WORD_BITS - bits * (np.arange(lpw) + 1)).astype(np.uint32)
+    lanes = (words[:, None] >> shifts[None, :]) & mask
+    return lanes.reshape(-1)[:n].astype(np.int64).astype(np.int32) - 1
+
+
+# --- traced row-matrix pack/unpack: the shared word-layout primitives the
+# mesh exchange (`parallel/distributed.py`) and the compute-on-packed kernels
+# (`ops/pallas_probe.py`, `ops/pallas_sort.py`, `ops/bucket_join.py`) build
+# on. Operands are 2-D [rows, lanes] matrices of NON-NEGATIVE (already
+# biased) field values; the lane axis must divide into whole words. ----------
+
+
+def pack_rows_traced(mat, bits: int):
+    """[R, C] non-negative field values -> [R, C/lanes_per_word] uint32 words,
+    big-endian lane layout. Traced (jit-safe); fields are disjoint, so the
+    lane-axis sum IS the bitwise-or."""
+    import jax.numpy as jnp
+
+    lpw = lanes_per_word(bits)
+    lanes = mat.astype(jnp.uint32).reshape(mat.shape[0], -1, lpw)
+    shifts = (
+        _WORD_BITS - bits * (jnp.arange(lpw, dtype=jnp.uint32) + 1)
+    ).astype(jnp.uint32)
+    return (lanes << shifts[None, None, :]).sum(axis=2, dtype=jnp.uint32)
+
+
+def unpack_rows_traced(words, bits: int):
+    """Traced inverse of `pack_rows_traced`: [R, W] uint32 -> [R, W*lpw]
+    int32 field values."""
+    import jax.numpy as jnp
+
+    lpw = lanes_per_word(bits)
+    shifts = (
+        _WORD_BITS - bits * (jnp.arange(lpw, dtype=jnp.uint32) + 1)
+    ).astype(jnp.uint32)
+    mask = jnp.uint32((1 << bits) - 1)
+    lanes = (words[:, :, None] >> shifts[None, None, :]) & mask
+    return lanes.reshape(words.shape[0], -1).astype(jnp.int32)
+
+
+# --- device unpack: shift/mask gather, one compiled program per bounded
+# (bits, pow2-lane-count) class ------------------------------------------------
+
+
+def _unpack_program(bits: int, n_lanes: int):
+    import jax.numpy as jnp
+
+    from ..telemetry.compile_log import observed_jit as _observed_jit
+
+    lpw = lanes_per_word(bits)
+    mask = np.uint32((1 << bits) - 1)
+    shifts = (_WORD_BITS - bits * (np.arange(lpw) + 1)).astype(np.uint32)
+
+    @_observed_jit(label="packed.unpack")
+    def unpack(words):
+        lanes = (words[:, None] >> jnp.asarray(shifts)[None, :]) & jnp.uint32(mask)
+        # Biased lanes -> codes: int8 keeps the device working set (and every
+        # downstream compile class) IDENTICAL to the PR 15 narrow path.
+        return (lanes.reshape(-1).astype(jnp.int32) - 1).astype(jnp.int8)
+
+    return unpack
+
+
+_unpack_programs: dict = {}
+
+
+def unpack_codes_device(words_dev, bits: int):
+    """Jitted shift/mask unpack of a device word array -> the pow2 lane array
+    as int8 codes (biased 0 back to -1). The exact-size upload is zero-padded
+    to the pow2 word count ON DEVICE first (zero words are all-reserved-null
+    lanes — the same eager pad-to-pow2 the hash layer applies to its narrow
+    lanes), so the program cache stays keyed by the bounded (bits, pow2)
+    class while the H2D transfer moved only real words."""
+    import jax.numpy as jnp
+
+    n_words = int(words_dev.shape[0])
+    n_words_pow2 = _pow2(n_words)
+    if n_words_pow2 != n_words:
+        words_dev = jnp.pad(words_dev, (0, n_words_pow2 - n_words))
+    n_lanes = n_words_pow2 * lanes_per_word(bits)
+    key = (bits, n_lanes)
+    fn = _unpack_programs.get(key)
+    if fn is None:
+        fn = _unpack_programs[key] = _unpack_program(bits, n_lanes)
+    return fn(words_dev)
+
+
+# --- column staging: the packed tier of `encoded_device.stage_codes` ---------
+
+#: id(packed host words) -> (weakref, unpacked int8 device lane). The eager
+#: slice to the column's true length runs ONCE per column here; steady-state
+#: queries reuse the sliced device lane with zero dispatches.
+_unpacked_memo: dict = {}
+
+
+def packable_bits(col):
+    """Packed width for a column's code lane, or None when the packed layer
+    is off / the column doesn't qualify for encoded staging / the dictionary
+    exceeds every sub-byte class."""
+    if not packed_codes_enabled():
+        return None
+    from .encoded_device import column_qualifies
+
+    if not column_qualifies(col):
+        return None
+    if col.data.dtype != np.int32:
+        return None
+    return bits_for_cardinality(len(col.dictionary))
+
+
+def packed_host_codes(col, bits: int) -> np.ndarray:
+    """Packed uint32 words of a column's code lane, memoized on the Column so
+    the identity-keyed upload cache keeps hitting across queries."""
+    cached = getattr(col, "_packed_codes", None)
+    if cached is not None and cached[0] == bits and cached[1] == len(col.data):
+        return cached[2]
+    words = pack_codes_host(col.data, bits)
+    try:
+        col._packed_codes = (bits, len(col.data), words)
+    except Exception:
+        pass  # slotted/frozen column subclass: lose the memo, not the packing
+    return words
+
+
+def _charged_packed_bytes(col, words: np.ndarray) -> int:
+    """TRUE packed footprint: packed words + dictionary + validity (the same
+    accounting `encoded_device._charged_bytes` applies to narrow lanes)."""
+    total = int(words.nbytes)
+    if col.dictionary is not None:
+        total += int(col.dictionary.nbytes)
+    if col.validity is not None:
+        total += int(col.validity.nbytes)
+    return total
+
+
+def stage_packed_codes(col, site: str, bits: int):
+    """Device-stage a column's code lane through the PACKED tier: upload the
+    uint32 words (H2D moves `bits` bits per code — charged as true packed
+    bytes in the `packed` tier of the encoded-staging ledger), then widen on
+    device with the jitted shift/mask unpack. The returned lane is int8 with
+    the exact values of `encoded_device.narrow_codes` — every consumer
+    downstream of the boundary sees the PR 15 narrow path, bit for bit."""
+    from .device_cache import device_array
+
+    words = packed_host_codes(col, bits)
+    n = len(col.data)
+    key = id(words)
+    ent = _unpacked_memo.get(key)
+    if ent is not None and ent[0]() is words:
+        return ent[1]
+    dev_words = device_array(
+        words,
+        site=site,
+        flat_bytes=int(col.data.nbytes),
+        charged_bytes=_charged_packed_bytes(col, words),
+        packed=True,
+    )
+    lane = unpack_codes_device(dev_words, bits)[:n]
+    try:
+        ref = weakref.ref(words, lambda _wr, k=key: _unpacked_memo.pop(k, None))
+    except TypeError:
+        return lane
+    _unpacked_memo[key] = (ref, lane)
+    return lane
+
+
+def clear_packed_memos() -> None:
+    """Drop the unpack memo (tests/bench cold-path measurements)."""
+    _unpacked_memo.clear()

@@ -1071,15 +1071,15 @@ class _JoinedDeviceEnv:
 
     def _gather(self, side: str, col: Column):
         from ..ops.aggregate import DevCol
-        from .encoded_device import stage_codes
+        from .encoded_device import stage_codes, widen_for_gather
 
         idx = self.li if side == "l" else self.ri
         # Upload narrow codes, gather the SURVIVING rows, widen on device:
         # the H2D transfer moves the compressed lane; DevCol consumers keep
         # seeing int32 codes (late materialization stays downstream).
         arr = stage_codes(col, "join_gather")[idx]
-        if col.is_string and arr.dtype != jnp.int32:
-            arr = arr.astype(jnp.int32)
+        if col.is_string:
+            arr = widen_for_gather(arr)
         valid = (
             device_array(col.validity)[idx] if col.validity is not None else None
         )
@@ -1116,10 +1116,12 @@ class _JoinedDeviceEnv:
         i = 0
         for lname, col in plan.items():
             arr = gathered[i]
-            if col.is_string and arr.dtype != jnp.int32:
+            if col.is_string:
                 # Narrow-staged codes widen AFTER the gather (on device, over
                 # surviving rows only) so DevCol consumers see int32 codes.
-                arr = arr.astype(jnp.int32)
+                from .encoded_device import widen_for_gather
+
+                arr = widen_for_gather(arr)
             i += 1
             valid = None
             if col.validity is not None:
@@ -1173,8 +1175,11 @@ class _JoinedDeviceEnv:
         v = evaluate(expr, _PredTableFacade(self.num_rows, metas), devcols)
         n = self.num_rows
         if v.kind == "str":
-            arr = v.arr if v.arr.dtype == jnp.int32 else v.arr.astype(jnp.int32)
-            out = DevCol("string", arr, np.asarray(v.dictionary), v.valid)
+            from .encoded_device import widen_for_gather
+
+            out = DevCol(
+                "string", widen_for_gather(v.arr), np.asarray(v.dictionary), v.valid
+            )
         elif v.kind == "lit":
             if isinstance(v.value, str):
                 out = DevCol(

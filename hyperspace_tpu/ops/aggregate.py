@@ -754,7 +754,14 @@ def hash_aggregate(table: Table, group_keys, aggs: Sequence[AggTriple]) -> Table
                 # actual values) instead of pulling gid/perm and the column to
                 # the host. The host path below stays the pinned oracle.
                 has_v = col.validity is not None
-                args = (device_array(col.data),)
+                if getattr(col, "is_string", False):
+                    from ..engine.encoded_device import widen_for_gather
+
+                    # Narrow/packed staging is distinctness-preserving; widen
+                    # back so the jitted program keeps ONE int32 compile class.
+                    args = (widen_for_gather(stage_codes(col, "agg_distinct")),)
+                else:
+                    args = (device_array(col.data),)
                 if has_v:
                     args = args + (device_array(col.validity),)
                 vals = np.asarray(

@@ -434,6 +434,177 @@ def probe_ranges(ls, rs, l_len, r_len):
 
 
 # ---------------------------------------------------------------------------
+# Packed code-mode padded reps (sub-byte dictionary codes)
+# ---------------------------------------------------------------------------
+#
+# A single low-cardinality STRING key doesn't need 64-bit hash keys at all:
+# its dictionary codes order-embed the join equality (equal code <=> equal
+# string within the shared dictionary), and below int8 they pack into uint32
+# lane words (`engine/packed_codes.py`). These reps keep the device-resident
+# padded matrices in PACKED form — 8-32x smaller HBM residency than the int64
+# hash rep — and the probe computes on the words directly (Pallas packed
+# kernel) or unpacks once and reuses the generic probe (the widen-then-probe
+# fallback the bench compares against).
+
+
+class PackedCodeBuckets:
+    """Packed-word twin of `PaddedBuckets`: `words` [B, cap/lpw] uint32 rows
+    of sorted BIASED codes (code + 1; pad slots hold the top lane value),
+    `bits` the lane width, `lengths`/`order`/`starts` as in the hash rep."""
+
+    __slots__ = ("words", "bits", "lengths", "order", "starts", "cap")
+
+    def __init__(self, words, bits: int, lengths, order, starts, cap: int):
+        self.words = words
+        self.bits = bits
+        self.lengths = lengths
+        self.order = order
+        self.starts = starts
+        self.cap = cap
+
+    @property
+    def nbytes(self) -> int:
+        total = 0
+        for a in (self.words, self.lengths, self.order, self.starts):
+            total += int(getattr(a, "nbytes", 0) or 0)
+        return total
+
+
+@_observed_jit(label="bucket_join.pad_scatter_codes", static_argnums=(2, 3, 4))
+def _pad_scatter_codes(codes, starts, num_buckets: int, cap: int, bits: int):
+    """`_pad_scatter` for code lanes: scatter raw codes (null = -1) into an
+    UNSORTED padded [B, cap] int32 matrix of BIASED codes (code + 1), pad =
+    2**bits - 1 — the top lane value `probe_bits_for_cardinality` reserves, so
+    pads sort last exactly like the i64-max pad of the hash rep."""
+    n = codes.shape[0]
+    pos = jnp.arange(n)
+    b_of_row = jnp.searchsorted(starts, pos, side="right") - 1
+    slot = pos - starts[b_of_row]
+    padded = jnp.full((num_buckets, cap), (1 << bits) - 1, dtype=jnp.int32)
+    padded = padded.at[b_of_row, slot].set(codes.astype(jnp.int32) + 1)
+    lengths = starts[1:] - starts[:-1]
+    return padded, lengths
+
+
+@_observed_jit(label="bucket_join.pad_and_sort_codes", static_argnums=(2, 3, 4))
+def _pad_and_sort_codes(codes, starts, num_buckets: int, cap: int, bits: int):
+    """XLA fallback twin of `pallas_sort.sort_codes_packed`: scatter + stable
+    argsort on the flat biased matrix. Same (sorted, order, lengths) contract."""
+    padded, lengths = _pad_scatter_codes(codes, starts, num_buckets, cap, bits)
+    order = jnp.argsort(padded, axis=1)
+    return jnp.take_along_axis(padded, order, axis=1), order, lengths
+
+
+@_observed_jit(label="bucket_join.pack_code_rows", static_argnums=(1,))
+def _pack_code_rows(mat, bits: int):
+    from ..engine.packed_codes import pack_rows_traced
+
+    return pack_rows_traced(mat, bits)
+
+
+@_observed_jit(label="bucket_join.unpack_code_rows", static_argnums=(1,))
+def _unpack_code_rows(words, bits: int):
+    from ..engine.packed_codes import unpack_rows_traced
+
+    return unpack_rows_traced(words, bits)
+
+
+def pad_buckets_by_codes(
+    codes, starts_np: np.ndarray, cardinality: int, has_nulls: bool = False
+) -> Optional[PackedCodeBuckets]:
+    """Packed code-mode rep for a single low-cardinality string key. Returns
+    None when the key doesn't qualify (cardinality past the 4-bit compute
+    bound, nulls present — like the value-direct rep, null semantics belong
+    to the hash path — or degenerate bucket layouts). In-bucket sorting rides
+    the Pallas packed-word sort when wanted, else the XLA argsort fallback;
+    either way the RESIDENT matrix is packed words."""
+    from ..engine.packed_codes import (
+        lanes_per_word,
+        probe_bits_for_cardinality,
+    )
+    from .backend import pallas_maybe_wanted
+
+    if has_nulls:
+        return None
+    bits = probe_bits_for_cardinality(int(cardinality))
+    if bits is None:
+        return None
+    B = len(starts_np) - 1
+    lens = np.diff(starts_np)
+    if B == 0 or lens.max(initial=0) == 0:
+        return None
+    cap = max(_cap_pow2(int(lens.max())), lanes_per_word(bits))
+    codes = jnp.asarray(codes)
+    starts = jnp.asarray(starts_np)
+    sorted_codes = order = lengths = None
+    if pallas_maybe_wanted("HYPERSPACE_PALLAS_SORT"):
+        from .pallas_sort import (
+            pallas_packed_sort_wanted,
+            record_sort_failure,
+            sort_codes_packed,
+        )
+
+        if pallas_packed_sort_wanted(B, cap, bits):
+            try:
+                padded, lengths = _pad_scatter_codes(codes, starts, B, cap, bits)
+                sorted_codes, order = sort_codes_packed(
+                    _pack_code_rows(padded, bits), bits
+                )
+            except Exception as e:  # Mosaic lowering/runtime problems
+                record_sort_failure(e)
+                sorted_codes = None
+    if sorted_codes is None:
+        sorted_codes, order, lengths = _pad_and_sort_codes(
+            codes, starts, B, cap, bits
+        )
+    words = _pack_code_rows(sorted_codes, bits)
+    rows = int(starts_np[-1])
+    _devobs.record_pad(
+        "join_buckets", -(-rows * bits // 8), -(-(B * cap - rows) * bits // 8)
+    )
+    return PackedCodeBuckets(
+        words, bits, lengths, np.asarray(order), starts_np, cap
+    )
+
+
+def probe_code_ranges(l: PackedCodeBuckets, r: PackedCodeBuckets):
+    """Probe dispatcher for packed code reps: the Pallas packed-word kernel
+    when wanted (own "packed" latch), else widen-then-probe — one device
+    unpack to flat int32 matrices feeding the generic probe (`_probe`, or the
+    host searchsorted off the device path). Biased codes compare consistently
+    on both sides, so ranges are identical across all three paths."""
+    from .backend import pallas_maybe_wanted, use_device_path
+
+    if l.bits != r.bits:
+        raise ValueError(f"packed rep bits mismatch: {l.bits} != {r.bits}")
+    B = l.words.shape[0]
+    if pallas_maybe_wanted("HYPERSPACE_PALLAS_PROBE"):
+        from .pallas_probe import (
+            pallas_packed_probe_wanted,
+            probe_packed_pallas,
+            record_pallas_failure,
+        )
+
+        if pallas_packed_probe_wanted(l.cap, r.cap, B, l.bits):
+            try:
+                return probe_packed_pallas(
+                    l.words, r.words, l.bits, l.lengths, r.lengths
+                )
+            except Exception as e:  # Mosaic lowering/runtime problems
+                record_pallas_failure(e, kind="packed")
+    ls = _unpack_code_rows(l.words, l.bits)
+    rs = _unpack_code_rows(r.words, r.bits)
+    if not use_device_path():
+        return _probe_host(
+            np.asarray(ls),
+            np.asarray(rs),
+            np.asarray(l.lengths),
+            np.asarray(r.lengths),
+        )
+    return _probe(ls, rs, l.lengths, r.lengths)
+
+
+# ---------------------------------------------------------------------------
 # Size-classed (skew-aware) layout
 # ---------------------------------------------------------------------------
 #

@@ -198,11 +198,9 @@ def column_hash_u32(column: Column, device_data, seed: np.uint32):
 
     ``device_data`` is the column's device representation (codes for strings)."""
     if column.is_string:
-        # Narrow code lanes must widen before the gather: the pow2-padded
-        # table's axis size (e.g. 128) can exceed the narrow index dtype's
-        # range. The cast runs on device — H2D already moved narrow bytes.
-        if device_data.dtype != jnp.int32:
-            device_data = device_data.astype(jnp.int32)
+        from ..engine.encoded_device import widen_for_gather
+
+        device_data = widen_for_gather(device_data)
         return host_hash_dictionary(column.dictionary, int(seed))[device_data]
     return hash_device_values(device_data, seed)
 
@@ -215,12 +213,9 @@ def _lane_trace(seed, dh_slot, cols):
     h = None
     for c in cols:
         if c[0] == "str":
-            codes = c[1]
-            # Narrow code lanes must widen before the gather: the pow2-padded
-            # table's axis size (e.g. 128) can exceed the narrow index
-            # dtype's range. On-device cast; the wire already moved narrow.
-            if codes.dtype != jnp.int32:
-                codes = codes.astype(jnp.int32)
+            from ..engine.encoded_device import widen_for_gather
+
+            codes = widen_for_gather(c[1])
             hc = c[2 + dh_slot][codes]
         else:
             hc = hash_device_values(c[1], seed, force_float=(c[0] == "numf"))

@@ -60,7 +60,8 @@ from ..telemetry.compile_log import observed_jit as _observed_jit
 # formatting or registry lookup on that path (same convention as the engine's
 # cache counters).
 _FALLBACK_METRICS = {
-    k: _metrics.counter(f"pallas.probe.{k}.fallbacks") for k in ("int", "float")
+    k: _metrics.counter(f"pallas.probe.{k}.fallbacks")
+    for k in ("int", "float", "packed")
 }
 
 
@@ -227,6 +228,132 @@ def probe_pallas(ls, rs, l_len, r_len) -> Tuple[jnp.ndarray, jnp.ndarray]:
     return lo, counts
 
 
+# --- probe on PACKED sub-byte code words -------------------------------------
+#
+# Dictionary codes below int8 ship and persist as big-endian uint32 lane words
+# (`engine/packed_codes.py`): the big-endian layout makes unsigned word order
+# equal lexicographic lane order, so a packed padded-bucket rep sorts/probes
+# consistently without ever materializing a flat int matrix in HBM. This
+# kernel reads the WORD matrices (bits-per-code HBM traffic, 8-32x less than
+# the int32 flat probe), unpacks lanes in VMEM with shift/mask (VPU-cheap),
+# and runs the same broadcast-compare reduction as `_probe_kernel` on
+# single-lane int32 operands — no (hi, lo) split, codes are tiny.
+
+
+def _unpack_words_block(w, bits: int):
+    """In-kernel unpack: [TB, W] uint32 words -> [TB, W*lpw] int32 biased
+    lanes (big-endian lane 0 in the TOP bits, matching pack_rows_traced)."""
+    tb, nw = w.shape
+    lpw = 32 // bits
+    k = jax.lax.broadcasted_iota(jnp.uint32, (tb, nw, lpw), 2)
+    shifts = jnp.uint32(32) - jnp.uint32(bits) * (k + jnp.uint32(1))
+    lanes = (w[:, :, None] >> shifts) & jnp.uint32((1 << bits) - 1)
+    return lanes.reshape(tb, nw * lpw).astype(jnp.int32)
+
+
+def _probe_packed_kernel(lw_ref, rw_ref, lo_ref, hi_ref, *, bits, tl, tr):
+    """Packed twin of `_probe_kernel`. Input blocks carry WHOLE word rows
+    (the word axis is far too narrow for (x8, x128) sub-blocks — cap/lpw
+    words; equal-to-dimension is the legal shape), and the per-step tile is
+    carved INSIDE the kernel with a word-granular dynamic slice. The probe
+    tiles are lpw-aligned by construction (`_tiles` sizes are multiples of
+    every lanes-per-word), so the slice start always lands on a word."""
+    lpw = 32 // bits
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    lw = lw_ref[:, pl.dslice(i * (tl // lpw), tl // lpw)]
+    rw = rw_ref[:, pl.dslice(j * (tr // lpw), tr // lpw)]
+    l = _unpack_words_block(lw, bits)[:, :, None]  # [TB, TL, 1]
+    r = _unpack_words_block(rw, bits)[:, None, :]  # [TB, 1, TR]
+    lt_counts = jnp.sum(r < l, axis=2, dtype=jnp.int32)  # [TB, TL]
+    le_counts = lt_counts + jnp.sum(r == l, axis=2, dtype=jnp.int32)
+
+    @pl.when(pl.program_id(2) == 0)
+    def _init():
+        lo_ref[...] = jnp.zeros_like(lo_ref)
+        hi_ref[...] = jnp.zeros_like(hi_ref)
+
+    lo_ref[...] += lt_counts
+    hi_ref[...] += le_counts
+
+
+@_observed_jit(label="pallas.probe_packed", static_argnums=(2, 3))
+def _probe_packed_call(lw, rw, bits: int, interpret: bool):
+    import functools
+
+    B, wl = lw.shape
+    lpw = 32 // bits
+    cap_l, cap_r = wl * lpw, rw.shape[1] * lpw
+    TB = _bucket_tile(B)
+    TL, TR = _tiles(cap_l, cap_r)
+    assert B % TB == 0 and cap_l % TL == 0 and cap_r % TR == 0, (B, cap_l, cap_r)
+    assert TL % lpw == 0 and TR % lpw == 0, (TL, TR, lpw)
+    grid = (B // TB, cap_l // TL, cap_r // TR)
+    word_l = pl.BlockSpec((TB, wl), lambda b, i, j: (b, 0))
+    word_r = pl.BlockSpec((TB, rw.shape[1]), lambda b, i, j: (b, 0))
+    out_spec = pl.BlockSpec((TB, TL), lambda b, i, j: (b, i))
+    kern = functools.partial(_probe_packed_kernel, bits=bits, tl=TL, tr=TR)
+    lo, hi = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[word_l, word_r],
+        out_specs=[out_spec, out_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, cap_l), jnp.int32),
+            jax.ShapeDtypeStruct((B, cap_l), jnp.int32),
+        ],
+        interpret=interpret,
+    )(lw, rw)
+    return lo, hi
+
+
+def probe_packed_pallas(l_words, r_words, bits: int, l_len, r_len):
+    """`probe_pallas` over packed BIASED-code word matrices: (lo, counts)
+    int32. Both sides must share the same bits class and hold sorted biased
+    codes with pad slots at the top lane value (2**bits - 1, which
+    `probe_bits_for_cardinality` reserves above every real biased code, so
+    pads sort last and the r_len clamp excises them)."""
+    lo, hi = _probe_packed_call(
+        jnp.asarray(l_words),
+        jnp.asarray(r_words),
+        bits,
+        jax.default_backend() != "tpu",
+    )
+    r_len_b = jnp.asarray(r_len)[:, None]
+    lo = jnp.minimum(lo, r_len_b).astype(jnp.int32)
+    hi = jnp.minimum(hi, r_len_b)
+    cap_l = lo.shape[1]
+    valid_left = jnp.arange(cap_l)[None, :] < jnp.asarray(l_len)[:, None]
+    counts = jnp.where(valid_left, hi - lo, 0).astype(jnp.int32)
+    return lo, counts
+
+
+def pallas_packed_probe_wanted(
+    cap_l: int, cap_r: int, num_buckets: int, bits: int
+) -> bool:
+    """Dispatch decision for the packed probe: the ordinary probe gate plus
+    whole-word caps. Failures latch under their own "packed" kind — a packed
+    lowering failure can never disable the validated int/float kernels."""
+    if "packed" in _pallas_broken:
+        _fallback_counts["packed"] = _fallback_counts.get("packed", 0) + 1
+        _FALLBACK_METRICS["packed"].inc()
+        return False
+    lpw = 32 // bits
+    if cap_l % lpw or cap_r % lpw:
+        return False
+    mode = _pallas_mode()
+    if mode == "0":
+        return False
+    if not shape_supported(num_buckets, cap_l, cap_r):
+        return False
+    if mode == "1":
+        return True
+    return (
+        jax.default_backend() == "tpu"
+        and num_buckets * cap_l * cap_r <= _AUTO_MAX_OPS
+    )
+
+
 def pallas_probe_wanted(
     cap_l: int, cap_r: int, num_buckets: int, dtype=None
 ) -> bool:
@@ -259,10 +386,10 @@ def pallas_probe_wanted(
     )
 
 
-def record_pallas_failure(exc: BaseException, dtype=None) -> None:
+def record_pallas_failure(exc: BaseException, dtype=None, kind=None) -> None:
     import logging
 
-    kind = _key_kind(dtype)
+    kind = kind or _key_kind(dtype)
     _pallas_broken[kind] = f"{type(exc).__name__}: {exc}"
     _fallback_counts[kind] = _fallback_counts.get(kind, 0) + 1
     _FALLBACK_METRICS[kind].inc()

@@ -172,6 +172,118 @@ def sort_padded_with_order(keys_i64) -> Tuple[jnp.ndarray, jnp.ndarray]:
     return _recombine(sh, sl), order
 
 
+# --- sort on PACKED sub-byte code words --------------------------------------
+#
+# Sub-byte dictionary codes (`engine/packed_codes.py`) don't need the 64-bit
+# (hi, lo, idx) triple: a biased code (< 16) and its slot index (< cap <=
+# 32768) TOGETHER fit one int32 composite, comp = (code << log2 cap) | slot.
+# Comps are UNIQUE (slot bits), so the unstable bitonic reproduces the STABLE
+# argsort of the code matrix exactly — and the network moves one int32 lane
+# instead of three, a third of the VMEM traffic of `_sort_kernel`. The kernel
+# reads the packed WORD matrix from HBM (bits-per-code traffic) and unpacks
+# in VMEM.
+
+
+def _bitonic_body_single(v):
+    """`_bitonic_body` specialised to ONE int32 lane (the composite): same
+    reshape/where network, a third of the exchanged state."""
+    tb, n = v.shape
+    k = 2
+    while k <= n:
+        j = k // 2
+        while j >= 1:
+            m = n // (2 * j)
+            v4 = v.reshape(tb, m, 2, j)
+            a, b = v4[:, :, 0, :], v4[:, :, 1, :]
+            g = jax.lax.broadcasted_iota(jnp.int32, (tb, m, 1, j), 1)
+            desc = ((g * (2 * j)) & k) > 0
+            desc = desc[:, :, 0, :]
+            swap = (a > b) != desc
+            na = jnp.where(swap, b, a)
+            nb = jnp.where(swap, a, b)
+            v = jnp.stack([na, nb], axis=2).reshape(tb, n)
+            j //= 2
+        k *= 2
+    return v
+
+
+def _sort_packed_kernel(w_ref, o_ref, *, bits, log2cap):
+    from .pallas_probe import _unpack_words_block
+
+    lanes = _unpack_words_block(w_ref[...], bits)  # [TB, cap] biased int32
+    slot = jax.lax.broadcasted_iota(jnp.int32, lanes.shape, 1)
+    comp = (lanes << log2cap) | slot
+    o_ref[...] = _bitonic_body_single(comp)
+
+
+@_observed_jit(label="pallas.sort_packed", static_argnums=(1, 2))
+def _sort_packed_call(words, bits: int, interpret: bool):
+    import functools
+
+    B, n_words = words.shape
+    lpw = 32 // bits
+    cap = n_words * lpw
+    assert cap & (cap - 1) == 0, cap
+    TB = _bucket_tile(B)
+    in_spec = pl.BlockSpec((TB, n_words), lambda b: (b, 0))
+    out_spec = pl.BlockSpec((TB, cap), lambda b: (b, 0))
+    kern = functools.partial(
+        _sort_packed_kernel, bits=bits, log2cap=cap.bit_length() - 1
+    )
+    return pl.pallas_call(
+        kern,
+        grid=(B // TB,),
+        in_specs=[in_spec],
+        out_specs=out_spec,
+        out_shape=jax.ShapeDtypeStruct((B, cap), jnp.int32),
+        interpret=interpret,
+    )(words)
+
+
+def sort_codes_packed(words, bits: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Sort each padded bucket row of a packed BIASED-code word matrix:
+    (sorted_biased_codes int32 [B, cap], order int32 [B, cap]) with
+    `sorted[b, s] == codes[b, order[b, s]]`. Requires pad slots packed as the
+    top lane value (2**bits - 1 — `probe_bits_for_cardinality` keeps it above
+    every real biased code), so pads sort last like the int64 path's pad key.
+    Matches `jnp.argsort` EXACTLY including ties (comp uniqueness => stable)."""
+    words = jnp.asarray(words)
+    cap = words.shape[1] * (32 // bits)
+    comp = _sort_packed_call(words, bits, jax.default_backend() != "tpu")
+    return comp >> (cap.bit_length() - 1), comp & (cap - 1)
+
+
+def _sort_comp_kernel(v_ref, o_ref):
+    o_ref[...] = _bitonic_body_single(v_ref[...])
+
+
+@_observed_jit(label="pallas.sort_comp", static_argnums=(1,))
+def sort_comp_padded(v, interpret: bool):
+    """Single-lane int32 bitonic over [B, cap] composite rows (build-side
+    bucket|code|row composites — `partition.pallas_packed_build_sort`). The
+    caller owns the composite encoding; this just sorts rows ascending."""
+    B, cap = v.shape
+    TB = _bucket_tile(B)
+    spec = pl.BlockSpec((TB, cap), lambda b: (b, 0))
+    return pl.pallas_call(
+        _sort_comp_kernel,
+        grid=(B // TB,),
+        in_specs=[spec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((B, cap), jnp.int32),
+        interpret=interpret,
+    )(v)
+
+
+def pallas_packed_sort_wanted(B: int, cap: int, bits: int) -> bool:
+    """Gate for the packed-word sort: the ordinary sort gate plus whole-word
+    rows. Shares the single "sort" latch — both variants lower the same
+    bitonic network, so a Mosaic failure in one predicts the other."""
+    if cap % (32 // bits):
+        return False
+    return pallas_sort_wanted(B, cap)
+
+
 def pallas_sort_wanted(B: int, cap: int) -> bool:
     """Dispatch decision: forced by env (1/0), else auto on TPU within the
     VMEM shape budget. Any lowering failure latches a permanent fallback

@@ -131,11 +131,26 @@ def bucketize_table(
         perm_host = host_sort_perm(b_host, cols, num_buckets)
         sorted_b_host = b_host[perm_host]
     else:
-        perm, sorted_b = _sort_perm(
-            b, tuple(_sortable(a) for a in arrs), table.num_rows
-        )
-        perm_host = np.asarray(perm)
-        sorted_b_host = np.asarray(sorted_b)
+        res = None
+        if (
+            len(cols) == 1
+            and getattr(cols[0], "is_string", False)
+            and cols[0].dictionary is not None
+        ):
+            # Sub-byte code build: (bucket | biased code | row) packs into ONE
+            # int32 composite — same canonical order as the variadic sort,
+            # a quarter of the sorted state. None when out of budget.
+            res = pallas_packed_build_sort(
+                b, arrs[0], len(cols[0].dictionary), table.num_rows, num_buckets
+            )
+        if res is not None:
+            perm_host, sorted_b_host = res
+        else:
+            perm, sorted_b = _sort_perm(
+                b, tuple(_sortable(a) for a in arrs), table.num_rows
+            )
+            perm_host = np.asarray(perm)
+            sorted_b_host = np.asarray(sorted_b)
     starts = bucket_starts(sorted_b_host, num_buckets)
     return table.take(perm_host), starts
 
@@ -216,6 +231,73 @@ def fused_bucketize_sort_perm(
     flat = [c for col in chunk_arrays for c in col]
     perm, sorted_b = fn(jnp.asarray(list(valid_lens), dtype=jnp.int32), *flat)
     return np.asarray(perm)[:n], np.asarray(sorted_b)[:n]
+
+
+@_observed_jit(
+    label="partition.packed_build_comp", static_argnums=(2, 3, 4, 5)
+)
+def _packed_build_comp(b, codes, bits: int, log2np: int, n_pad: int, num_buckets: int):
+    """(bucket | biased code | row) int32 composites, padded to [1, n_pad]
+    with the supremum composite (bucket field = num_buckets exceeds every real
+    bucket, so pads sort last regardless of the remaining bits)."""
+    n = codes.shape[0]
+    biased = codes.astype(jnp.int32) + 1  # null (-1) -> reserved lane 0
+    comp = (
+        ((b.astype(jnp.int32) << bits) | biased) << log2np
+    ) | jnp.arange(n, dtype=jnp.int32)
+    pad_val = jnp.int32((num_buckets << bits) << log2np)
+    return jnp.full((1, n_pad), pad_val, dtype=jnp.int32).at[0, :n].set(comp)
+
+
+def pallas_packed_build_sort(
+    b_dev, codes_dev, cardinality: int, n: int, num_buckets: int
+) -> "Tuple[np.ndarray, np.ndarray] | None":
+    """Sub-byte-key build fast path: for a single dictionary-encoded key whose
+    cardinality fits a packed lane class (`engine/packed_codes.py`), the
+    (bucket, biased code, row) triple bit-packs into ONE int32 composite —
+    sorted by the single-lane Pallas bitonic (`pallas_sort.sort_comp_padded`),
+    a QUARTER of the in-VMEM state of the int64 composite path and 1/3 of the
+    (hi, lo, idx) network's exchanges. Unique row bits => the unstable bitonic
+    reproduces the engine's canonical stable (bucket, code) order exactly
+    (same argument as `_composite_sort_host`), so index files stay
+    byte-identical whichever sort ran. Biased codes (code + 1) keep the null
+    lane (-1 -> 0) ordered first, matching the raw-code variadic sort.
+    Returns None when out of budget (flag off, cardinality past the 4-bit
+    class, int32 headroom, or sort-gate shapes)."""
+    from ..engine.packed_codes import bits_for_cardinality, packed_codes_enabled
+    from .pallas_sort import (
+        pallas_sort_wanted,
+        record_sort_failure,
+        sort_comp_padded,
+    )
+
+    if not packed_codes_enabled():
+        return None
+    bits = bits_for_cardinality(int(cardinality))
+    if bits is None:
+        return None
+    if n == 0:
+        return np.empty(0, np.int32), np.empty(0, np.int32)
+    n_pad = 1 << max(int(n) - 1, 1).bit_length()
+    log2np = n_pad.bit_length() - 1
+    # The pad composite is the largest value the encoding produces: it must
+    # fit signed int32.
+    if (num_buckets << bits) << log2np >= 1 << 31:
+        return None
+    if not pallas_sort_wanted(1, n_pad):
+        return None
+    try:
+        comp = _packed_build_comp(
+            b_dev, codes_dev, bits, log2np, n_pad, num_buckets
+        )
+        sorted_comp = sort_comp_padded(comp, jax.default_backend() != "tpu")
+        head = sorted_comp[0, :n]
+        perm = np.asarray(head & (n_pad - 1)).astype(np.int32)
+        sorted_b = np.asarray(head >> (bits + log2np)).astype(np.int32)
+        return perm, sorted_b
+    except Exception as e:  # Mosaic lowering/runtime problems
+        record_sort_failure(e)
+        return None
 
 
 def pallas_composite_build_sort(

@@ -44,6 +44,8 @@ from .mesh import BUCKET_AXIS, quantize_cap
 from .shim import shard_map
 
 _PAD_SLOT = -1
+#: Packed wire lanes ride uint32 words (`engine/packed_codes.py` layout).
+_WORD_BITS = 32
 
 #: All-to-all traffic accounting (ticked once per exchange on the host):
 #: payload = real row bytes moved, moved = the padded send-matrix bytes the
@@ -141,17 +143,25 @@ def _exchange_program(mesh: Mesh, num_buckets: int, cap: int):
     )
 
 
-def _record_exchange(n_valid: int, n_dev: int, cap: int, lanes) -> None:
+def _record_exchange(n_valid: int, n_dev: int, cap: int, lanes, packed_spec=None) -> None:
     """Host-side traffic accounting for one exchange call (cheap: arithmetic
-    over lane dtypes, no device sync)."""
+    over lane dtypes, no device sync). `packed_spec` (aligned with `lanes`)
+    marks bit-packed wire lanes: a packed lane's interconnect cost is its
+    uint32 WORD matrix — `cap // lanes_per_word` words per destination — and
+    its payload is the true bits-on-the-wire of the valid rows."""
     _EXCHANGES.inc()
     _EXCHANGE_ROWS.inc(int(n_valid))
     payload = 0
     moved = 0
-    for lane in lanes:
-        item = int(jnp.asarray(lane).dtype.itemsize)
-        payload += int(n_valid) * item
-        moved += n_dev * n_dev * cap * item
+    for i, lane in enumerate(lanes):
+        bits = packed_spec[i][0] if packed_spec is not None else 0
+        if bits:
+            payload += -(-int(n_valid) * bits // 8)  # ceil(n_valid*bits/8)
+            moved += n_dev * n_dev * (cap * bits // _WORD_BITS) * 4
+        else:
+            item = int(jnp.asarray(lane).dtype.itemsize)
+            payload += int(n_valid) * item
+            moved += n_dev * n_dev * cap * item
     _EXCHANGE_BYTES_PAYLOAD.inc(payload)
     _EXCHANGE_BYTES_MOVED.inc(moved)
     # The mesh exchange was the ORIGINAL payload-vs-moved honesty split; it
@@ -255,14 +265,51 @@ def exchange_counts_coded(mesh: Mesh, bucket, num_buckets: int) -> np.ndarray:
     return np.asarray(_counts_coded_program(mesh, num_buckets)(bucket))
 
 
+def _pack_wire(send, bits: int):
+    """Pack a scattered [n_dev, cap] send matrix (already biased into its
+    unsigned field range) into [n_dev, cap/lanes_per_word] uint32 words —
+    the shared big-endian layout primitive (`engine/packed_codes.py`)."""
+    from ..engine.packed_codes import pack_rows_traced
+
+    return pack_rows_traced(send, bits)
+
+
+def _unpack_wire(words, bits: int, dtype):
+    """Inverse of `_pack_wire`: [n_dev, words] uint32 → [n_dev, cap] biased
+    field values in the lane's original dtype."""
+    from ..engine.packed_codes import unpack_rows_traced
+
+    return unpack_rows_traced(words, bits).astype(dtype)
+
+
 @lru_cache(maxsize=128)
 def _exchange_coded_program(
-    mesh: Mesh, num_buckets: int, cap: int, sort_from_payload: tuple
+    mesh: Mesh,
+    num_buckets: int,
+    cap: int,
+    sort_from_payload: tuple,
+    packed_spec: tuple = (),
 ):
     """Coded twin of `_exchange_program`: input lanes arrive narrow, and sort
     keys may be REFERENCED from payload lanes (`sort_from_payload` indexes)
-    instead of shipped twice — the k64 of the exchanged join travels once."""
+    instead of shipped twice — the k64 of the exchanged join travels once.
+
+    `packed_spec` (static, folded into the program cache key like `cap`) is a
+    per-lane (bits, bias) tuple aligned with (bucket, valid, *payload, *keys);
+    a (0, 0) entry ships the lane as-is. A packed lane is biased by `bias`
+    (so the null code -1 lands on the reserved field value 0), bit-packed
+    AFTER the destination scatter, crosses the all_to_all as uint32 words,
+    and unpacks back to the identical [n_dev, cap] matrix on the receive
+    side — pad slots scatter as 0, pack as the bias value, and unpack back
+    to 0, so every downstream operand is value-identical to the unpacked
+    program and the receive-side sort permutation cannot move."""
     n_dev = mesh.devices.size
+    for bits, _bias in packed_spec:
+        if bits:
+            assert _WORD_BITS % bits == 0 and cap % (_WORD_BITS // bits) == 0, (
+                bits,
+                cap,
+            )
 
     def fn(bucket_local, valid_local, payload_local, keys_local):
         n_local = bucket_local.shape[0]
@@ -272,17 +319,31 @@ def _exchange_coded_program(
         starts = jnp.searchsorted(dest_s, jnp.arange(n_dev))
         slot = jnp.arange(n_local) - starts[dest_s]
 
-        def scatter(col):
-            send = jnp.zeros((n_dev, cap), dtype=col.dtype)
-            send = send.at[dest_s, slot].set(col[order])
+        def _wire(send):
             return jax.lax.all_to_all(
                 send, BUCKET_AXIS, split_axis=0, concat_axis=0, tiled=False
             )
 
-        valid_recv = scatter(valid_local)
-        bucket_recv = scatter(bucket_local)
-        payload_recv = [scatter(c) for c in payload_local]
-        keys_recv = [scatter(c) for c in keys_local]
+        def _spec(i):
+            return packed_spec[i] if i < len(packed_spec) else (0, 0)
+
+        def scatter(col, spec=(0, 0)):
+            bits, bias = spec
+            send = jnp.zeros((n_dev, cap), dtype=col.dtype)
+            send = send.at[dest_s, slot].set(col[order])
+            if not bits:
+                return _wire(send)
+            biased = (send.astype(jnp.int32) + bias) if bias else send
+            recv = _unpack_wire(_wire(_pack_wire(biased, bits)), bits, jnp.int32)
+            if bias:
+                recv = recv - bias
+            return recv.astype(col.dtype)
+
+        n_pay = len(payload_local)
+        valid_recv = scatter(valid_local, _spec(1))
+        bucket_recv = scatter(bucket_local, _spec(0))
+        payload_recv = [scatter(c, _spec(2 + i)) for i, c in enumerate(payload_local)]
+        keys_recv = [scatter(c, _spec(2 + n_pay + i)) for i, c in enumerate(keys_local)]
 
         # Receive-side widening is free (post-wire); the sort operand VALUES
         # match the flat program's exactly, so the permutation — and with it
@@ -324,19 +385,29 @@ def distributed_bucketize_coded(
     in_valid,
     n_valid: int,
     sort_from_payload: Sequence[int] = (),
+    packed_spec: Sequence[Tuple[int, int]] = (),
 ):
     """Two-pass distributed bucketize over NARROW lanes: `bucket` is the
     pre-computed (h1 % num_buckets) lane in its smallest width, `in_valid` is
     int8, and `sort_from_payload` names payload lanes that double as sort
-    keys (so they are not shipped twice). Output contract (and bytes of the
-    output) match `distributed_bucketize`: int32 bucket ids, int32 validity,
-    payload lanes in their input dtypes."""
+    keys (so they are not shipped twice). `packed_spec` (optional, aligned
+    (bucket, valid, *payload, *sort_keys)) bit-packs the marked lanes across
+    the all_to_all (`HYPERSPACE_PACKED_CODES`). Output contract (and bytes of
+    the output) match `distributed_bucketize`: int32 bucket ids, int32
+    validity, payload lanes in their input dtypes."""
     counts = exchange_counts_coded(mesh, bucket, num_buckets)
     cap = quantize_cap(int(counts.max()) if counts.size else 0)
     n_dev = mesh.devices.size
-    _record_exchange(n_valid, n_dev, cap, [bucket, in_valid, *payload, *sort_keys])
+    spec = tuple(tuple(s) for s in packed_spec)
+    _record_exchange(
+        n_valid,
+        n_dev,
+        cap,
+        [bucket, in_valid, *payload, *sort_keys],
+        packed_spec=spec if spec else None,
+    )
     return _exchange_coded_program(
-        mesh, num_buckets, cap, tuple(sort_from_payload)
+        mesh, num_buckets, cap, tuple(sort_from_payload), spec
     )(bucket, in_valid, list(payload), list(sort_keys))
 
 

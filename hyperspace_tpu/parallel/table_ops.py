@@ -64,14 +64,67 @@ def _coded_rowid_dtype(n_pad_total: int):
     return np.int32 if n_pad_total <= np.iinfo(np.int32).max else np.int64
 
 
-def _record_coded_stage(n_rows: int, flat_lanes, coded_lanes) -> None:
+def _record_coded_stage(n_rows: int, flat_lanes, coded_lanes, packed_spec=None) -> None:
     """Encoded-vs-flat ledger entry for one exchange's wire lanes: what the
-    flat itemsizes would have staged vs what the narrow lanes stage."""
+    flat itemsizes would have staged vs what the narrow lanes stage. Lanes
+    marked in `packed_spec` (aligned with `coded_lanes`) cross bit-packed —
+    their staged cost is true bits-on-the-wire, surfaced in the ledger's
+    `packed` tier."""
     from ..telemetry import device_observatory as _devobs
 
     flat = sum(n_rows * int(np.dtype(d).itemsize) for d in flat_lanes)
-    staged = sum(n_rows * int(a.dtype.itemsize) for a in coded_lanes)
-    _devobs.record_encoded_stage("mesh_exchange", flat, staged)
+    staged = 0
+    packed = 0
+    for i, a in enumerate(coded_lanes):
+        bits = packed_spec[i][0] if packed_spec is not None else 0
+        if bits:
+            lane_bytes = -(-n_rows * bits // 8)  # ceil(n_rows*bits/8)
+            packed += lane_bytes
+        else:
+            lane_bytes = n_rows * int(a.dtype.itemsize)
+        staged += lane_bytes
+    _devobs.record_encoded_stage(
+        "mesh_exchange", flat, staged, packed_bytes=packed if packed else None
+    )
+
+
+def _packed_wire_spec(
+    num_buckets: int, bucket_np, n_pad_total: int, rowid_p, key_pairs=()
+):
+    """Per-lane (bits, bias) mesh-wire spec, ordered (bucket, valid,
+    rowid, *extra payload/keys) — empty when the packed layer is off. A lane
+    packs only when its wire class genuinely beats its narrow itemsize (an
+    int8 bucket lane needing 6 bits stays int8; an int32 row-id lane under
+    65537 padded rows drops to 16 bits). `key_pairs` is (column, staged lane)
+    per sort key; string keys within a sub-byte class pack biased by 1 so the
+    null code -1 lands on the reserved field value 0."""
+    from ..engine.packed_codes import (
+        bits_for_cardinality,
+        packed_codes_enabled,
+        wire_bits_for_range,
+    )
+
+    if not packed_codes_enabled():
+        return ()
+
+    def lane(n_values, arr, bias=0):
+        bits = wire_bits_for_range(n_values)
+        if bits is None or bits >= 8 * int(arr.dtype.itemsize):
+            return (0, 0)
+        return (bits, bias)
+
+    spec = [
+        lane(num_buckets, bucket_np),
+        (1, 0),  # validity: int8 {0, 1} -> 1 bit
+        lane(n_pad_total, rowid_p),
+    ]
+    for col, staged in key_pairs:
+        bits = None
+        if getattr(col, "is_string", False) and col.dictionary is not None:
+            if staged.dtype in (np.int8, np.int16):  # actually narrowed
+                bits = bits_for_cardinality(len(col.dictionary))
+        spec.append((bits, 1) if bits else (0, 0))
+    return tuple(spec)
 
 
 def _pad_rows(arr: np.ndarray, pad: int, fill=0) -> np.ndarray:
@@ -176,10 +229,14 @@ def distributed_bucketize_table(
         flat_keys = [
             np.int32 if c.data.dtype == np.bool_ else c.data.dtype for c in cols
         ]
+        packed_spec = _packed_wire_spec(
+            num_buckets, bucket_np, n_pad_total, rowid_p, list(zip(cols, keys_p))
+        )
         _record_coded_stage(
             n_pad_total,
             [np.uint32, np.int32, np.int64, *flat_keys],
             [bucket_np, valid_p, rowid_p, *keys_p],
+            packed_spec=packed_spec or None,
         )
         bucket, out_valid, (rowid_out,) = distributed_bucketize_coded(
             mesh,
@@ -189,6 +246,7 @@ def distributed_bucketize_table(
             num_buckets,
             in_valid=put(valid_p),
             n_valid=n,
+            packed_spec=packed_spec,
         )
     else:
         valid_p = np.ones(n + pad, np.int32)
@@ -258,10 +316,16 @@ def distributed_exchange_table(
         valid_p = np.ones(n + pad, np.int8)
         valid_p[n:] = 0
         rowid_p = _pad_rows(np.arange(n, dtype=_coded_rowid_dtype(n_pad_total)), pad)
+        # Spec covers (bucket, valid, rowid); the k64 payload lane appends
+        # unpacked — 64-bit hashes have no narrower wire class.
+        packed_spec = _packed_wire_spec(num_partitions, bucket_np, n_pad_total, rowid_p)
+        if packed_spec:
+            packed_spec = packed_spec + ((0, 0),)
         _record_coded_stage(
             n_pad_total,
             [np.uint32, np.int32, np.int64, np.int64, np.int64],
             [bucket_np, valid_p, rowid_p, k64_p],
+            packed_spec=packed_spec or None,
         )
         bucket, out_valid, (rowid_out, k64_out) = distributed_bucketize_coded(
             mesh,
@@ -272,6 +336,7 @@ def distributed_exchange_table(
             in_valid=put(valid_p),
             n_valid=n,
             sort_from_payload=(1,),
+            packed_spec=packed_spec,
         )
     else:
         valid_p = np.ones(n + pad, np.int32)

@@ -82,6 +82,9 @@ _COUNTER_FIELDS = (
     # the encoded-vs-flat split of the transfer/pad ledgers.
     "device_code_bytes_flat",
     "device_code_bytes_staged",
+    # Bit-packed sub-byte tier (engine/packed_codes.py): of the staged bytes,
+    # the slice that crossed as packed uint32 words.
+    "device_code_bytes_packed",
 )
 
 _current: "contextvars.ContextVar[Optional[QueryLedger]]" = contextvars.ContextVar(

@@ -80,6 +80,9 @@ _PAD_PADDED = _metrics.counter("pad.bytes_padded")
 # would have staged vs the narrow code bytes actually staged.
 _ENC_FLAT = _metrics.counter("device.encoded.bytes_flat")
 _ENC_STAGED = _metrics.counter("device.encoded.bytes_staged")
+# Bit-packed tier (engine/packed_codes.py): of the staged bytes, how many
+# crossed as packed sub-byte words — the below-int8 slice of the split.
+_ENC_PACKED = _metrics.counter("device.encoded.bytes_packed")
 _CAPTURES = _metrics.counter("profiler.captures")
 _CAPTURES_SUPPRESSED = _metrics.counter("profiler.captures_suppressed")
 
@@ -268,44 +271,61 @@ def record_pad(site: str, payload_bytes: int, padded_bytes: int) -> None:
     _accounting.add("pad_bytes_padded", padded_bytes)
 
 
-def record_encoded_stage(site: str, flat_bytes: int, staged_bytes: int) -> None:
+def record_encoded_stage(
+    site: str, flat_bytes: int, staged_bytes: int, packed_bytes=None
+) -> None:
     """One encoded (code-space) device staging event at `site`: the flat path
     would have moved `flat_bytes` across the boundary; the narrow code lane
     actually moved `staged_bytes`. The gap is the decoded-bytes tax the
     device half no longer pays — the encoded-vs-flat split `tools/hsreport.py`
-    reports next to the pad tax."""
+    reports next to the pad tax. `packed_bytes` marks the slice of the staged
+    bytes that crossed as BIT-PACKED sub-byte words
+    (`engine/packed_codes.py`) — the below-int8 tier of the split."""
     flat_bytes = int(flat_bytes)
     staged_bytes = int(staged_bytes)
     _ENC_FLAT.inc(flat_bytes)
     _ENC_STAGED.inc(staged_bytes)
     _metrics.counter(f"device.encoded.{site}.bytes_flat").inc(flat_bytes)
     _metrics.counter(f"device.encoded.{site}.bytes_staged").inc(staged_bytes)
+    if packed_bytes is not None:
+        packed_bytes = int(packed_bytes)
+        _ENC_PACKED.inc(packed_bytes)
+        _metrics.counter(f"device.encoded.{site}.bytes_packed").inc(packed_bytes)
     with _lock:
         s = _encoded_sites.get(site)
         if s is None:
-            s = _encoded_sites[site] = [0, 0, 0]
+            s = _encoded_sites[site] = [0, 0, 0, 0]
         s[0] += flat_bytes
         s[1] += staged_bytes
         s[2] += 1
+        if packed_bytes is not None:
+            s[3] += packed_bytes
     from . import accounting as _accounting
 
     _accounting.add("device_code_bytes_flat", flat_bytes)
     _accounting.add("device_code_bytes_staged", staged_bytes)
+    if packed_bytes is not None:
+        _accounting.add("device_code_bytes_packed", packed_bytes)
 
 
 def encoded_stage_summary() -> dict:
     """Per-site encoded-vs-flat staging split: {site: {bytes_flat,
-    bytes_staged, count, saved_ratio}} — saved_ratio is the fraction of the
-    flat bytes that never crossed the boundary (0.0 = no saving)."""
+    bytes_staged, count, saved_ratio[, bytes_packed]}} — saved_ratio is the
+    fraction of the flat bytes that never crossed the boundary (0.0 = no
+    saving); bytes_packed appears when any of the staged bytes crossed as
+    bit-packed sub-byte words."""
     with _lock:
         out = {}
-        for site, (flat, staged, count) in sorted(_encoded_sites.items()):
-            out[site] = {
+        for site, (flat, staged, count, packed) in sorted(_encoded_sites.items()):
+            e = {
                 "bytes_flat": flat,
                 "bytes_staged": staged,
                 "count": count,
                 "saved_ratio": round((flat - staged) / flat, 4) if flat else 0.0,
             }
+            if packed:
+                e["bytes_packed"] = packed
+            out[site] = e
         return out
 
 

@@ -101,9 +101,11 @@ TRACKED_FIELDS = (
     "d2h_bytes",
     "pad_bytes_payload",
     "pad_bytes_padded",
-    # Encoded device staging split (engine/encoded_device.py).
+    # Encoded device staging split (engine/encoded_device.py), with the
+    # bit-packed sub-byte tier (engine/packed_codes.py).
     "device_code_bytes_flat",
     "device_code_bytes_staged",
+    "device_code_bytes_packed",
 )
 
 _RECORDS = _metrics.counter("history.records")

@@ -1,0 +1,599 @@
+"""Bit-packed sub-byte code lanes (ISSUE 16 tentpole).
+
+Contract under test: with ``HYPERSPACE_PACKED_CODES`` on (the auto default,
+riding ``HYPERSPACE_ENCODED_DEVICE``), dictionary codes BELOW int8 cross the
+host→device boundary and the mesh exchange as 1/2/4-bit lanes packed into
+uint32 words — while every result (join rows, index file bytes) stays
+BYTE-IDENTICAL to the ``=0`` narrow fallback, in both
+``HYPERSPACE_DISTRIBUTED`` ambients. The layout is pinned property-style:
+pack/unpack is bijective for every dictionary within the class bound, the
+null code folds into the reserved lane 0, and big-endian lane order makes
+unsigned packed-word compare equal lexicographic lane compare (the
+compute-on-packed soundness lemma the Pallas probe/sort kernels rely on).
+Lane counts pow2-quantize so the jitted pack/unpack programs stay a bounded
+compile-class set — never one shape per cardinality.
+"""
+
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import IndexConfig, IndexConstants
+from hyperspace_tpu.engine import HyperspaceSession, col
+from hyperspace_tpu.engine import packed_codes as pc
+from hyperspace_tpu.engine.table import Column, Table
+from hyperspace_tpu.hyperspace import Hyperspace, enable_hyperspace
+from hyperspace_tpu.telemetry import compile_log, metrics
+
+ENV = pc.ENV_PACKED_CODES
+ENV_ENC = "HYPERSPACE_ENCODED_DEVICE"
+
+# Distinct from every other suite so mesh program shapes are this file's own.
+NUM_BUCKETS = 26
+
+
+def _session(tmp_path, num_buckets=NUM_BUCKETS):
+    s = HyperspaceSession(warehouse=str(tmp_path))
+    s.conf.set(IndexConstants.INDEX_SYSTEM_PATH, str(tmp_path / "indexes"))
+    s.conf.set(IndexConstants.INDEX_NUM_BUCKETS, num_buckets)
+    s.conf.set(IndexConstants.DISTRIBUTED_MIN_ROWS, 0)
+    return s
+
+
+def _clear_caches():
+    from hyperspace_tpu.engine.physical import clear_device_memos
+    from hyperspace_tpu.engine.scan_cache import (
+        global_bucketed_cache,
+        global_concat_cache,
+        global_filtered_cache,
+        global_scan_cache,
+    )
+
+    global_scan_cache().clear()
+    global_concat_cache().clear()
+    global_filtered_cache().clear()
+    global_bucketed_cache().clear()
+    clear_device_memos()
+    pc.clear_packed_memos()
+
+
+def _write_lowcard_pair(s, base, n, card=12, seed=7):
+    """String-key fact/dim pair with `card` ≤ 15 distinct keys — the 4-bit
+    packed lane class."""
+    rng = np.random.RandomState(seed)
+    s.write_parquet(
+        {
+            "sk": np.array([f"k{v:02d}" for v in rng.randint(0, card, n)]),
+            "val": np.arange(n, dtype=np.int64),
+        },
+        os.path.join(base, "fact"),
+    )
+    s.write_parquet(
+        {
+            "dk": np.array([f"k{v:02d}" for v in rng.randint(0, card, n // 4)]),
+            "w": rng.randint(0, 100, n // 4).astype(np.int64),
+        },
+        os.path.join(base, "dim"),
+    )
+
+
+def _dir_hashes(root):
+    return {
+        f: hashlib.sha256(open(os.path.join(root, f), "rb").read()).hexdigest()
+        for f in sorted(os.listdir(root))
+        if f.startswith("part-")
+    }
+
+
+# ---------------------------------------------------------------------------
+# Width policy units
+# ---------------------------------------------------------------------------
+
+
+class TestWidthPolicy:
+    def test_transport_bits_boundaries(self):
+        # card + 1 biased values must fit: the reserved 0 eats one slot.
+        assert pc.bits_for_cardinality(1) == 1
+        assert pc.bits_for_cardinality(2) == 2
+        assert pc.bits_for_cardinality(3) == 2
+        assert pc.bits_for_cardinality(4) == 4
+        assert pc.bits_for_cardinality(15) == 4
+        assert pc.bits_for_cardinality(16) is None  # int8 narrow class takes it
+
+    def test_probe_bits_reserve_pad_slot(self):
+        # The compute path reserves the TOP lane value as pad: one slot fewer.
+        assert pc.probe_bits_for_cardinality(2) == 2
+        assert pc.probe_bits_for_cardinality(14) == 4
+        assert pc.probe_bits_for_cardinality(15) is None
+
+    def test_wire_bits(self):
+        assert pc.wire_bits_for_range(2) == 1
+        assert pc.wire_bits_for_range(26) == 8
+        assert pc.wire_bits_for_range(65536) == 16
+        assert pc.wire_bits_for_range(65537) is None
+
+    def test_mode_parsing_auto_rides_encoded(self, monkeypatch):
+        monkeypatch.delenv(ENV, raising=False)
+        assert pc.packed_codes_mode() == "auto"
+        monkeypatch.setenv(ENV_ENC, "1")
+        assert pc.packed_codes_enabled()
+        monkeypatch.setenv(ENV_ENC, "0")
+        assert not pc.packed_codes_enabled()
+        monkeypatch.setenv(ENV, "0")
+        monkeypatch.setenv(ENV_ENC, "1")
+        assert not pc.packed_codes_enabled()
+        monkeypatch.setenv(ENV, "1")
+        monkeypatch.setenv(ENV_ENC, "0")
+        assert pc.packed_codes_enabled()
+
+    def test_lane_count_is_word_granular_exact(self):
+        """The H2D buffer is EXACT to the word: at most one word of tail
+        padding, so the packed-vs-narrow wire ratio stays the intrinsic
+        8/bits (pow2 padding happens device-side, never on the wire)."""
+        for bits in pc.PACKED_BITS:
+            lpw = pc.lanes_per_word(bits)
+            for n in (1, 2, 3, 5, 31, 32, 33, 1000, 4097, 300000):
+                lanes = pc.packed_lane_count(n, bits)
+                assert lanes >= max(n, 1)
+                assert lanes - max(n, 1) < lpw  # <= one word of tail
+                assert lanes % lpw == 0
+                assert pc.packed_word_count(n, bits) == lanes // lpw
+
+    def test_device_unpack_classes_are_pow2(self, monkeypatch):
+        """Two exact word counts in the same pow2 class share ONE compiled
+        unpack program — the device-side zero-pad bridges exact wire buffers
+        onto the bounded (bits, pow2) grid."""
+        import jax.numpy as jnp
+
+        pc._unpack_programs.clear()
+        for n in (900, 1000):  # both pad to 128 words at 4 bits
+            codes = np.arange(n, dtype=np.int32) % 14
+            words = pc.pack_codes_host(codes, 4)
+            lane = pc.unpack_codes_device(jnp.asarray(words), 4)
+            assert np.array_equal(np.asarray(lane)[:n], codes.astype(np.int8))
+        assert len(pc._unpack_programs) == 1
+
+
+# ---------------------------------------------------------------------------
+# Layout properties: bijectivity, reserved null, the order lemma
+# ---------------------------------------------------------------------------
+
+
+class TestLayoutProperties:
+    def test_roundtrip_bijective_across_cardinalities(self):
+        """Every dict size within each class bound (plus nulls) round-trips
+        exactly, for a spread of lengths including the pow2 edges."""
+        rng = np.random.RandomState(3)
+        for card in (1, 2, 3, 4, 7, 12, 15):
+            bits = pc.bits_for_cardinality(card)
+            assert bits is not None
+            for n in (1, 7, 32, 33, 257, 4096):
+                codes = rng.randint(0, card, n).astype(np.int32)
+                codes[rng.rand(n) < 0.1] = -1  # folded nulls
+                words = pc.pack_codes_host(codes, bits)
+                assert words.dtype == np.uint32
+                assert len(words) == pc.packed_word_count(n, bits)
+                back = pc.unpack_codes_host(words, n, bits)
+                assert np.array_equal(back, codes), (card, n)
+
+    def test_wide_cardinality_has_no_packed_class(self):
+        # 70k distinct: past int16 even — nothing in the packed layer applies.
+        assert pc.bits_for_cardinality(70_000) is None
+        assert pc.probe_bits_for_cardinality(70_000) is None
+
+    def test_null_code_is_reserved_lane_zero(self):
+        words = pc.pack_codes_host(np.array([-1], np.int32), 4)
+        # Big-endian: lane 0 sits in the TOP bits; biased null = 0.
+        assert int(words[0]) >> 28 == 0
+        words = pc.pack_codes_host(np.array([0], np.int32), 4)
+        assert int(words[0]) >> 28 == 1  # biased code 0 -> lane value 1
+
+    def test_packed_word_order_is_lane_order(self):
+        """The compute lemma: unsigned word compare == lexicographic biased
+        lane compare, for random lane tuples in every bits class."""
+        rng = np.random.RandomState(11)
+        for bits in pc.PACKED_BITS:
+            lpw = pc.lanes_per_word(bits)
+            hi = 1 << bits
+            for _ in range(200):
+                a = rng.randint(0, hi, lpw).astype(np.int64)
+                b = rng.randint(0, hi, lpw).astype(np.int64)
+                wa = pc.pack_codes_host((a - 1).astype(np.int32), bits)[0]
+                wb = pc.pack_codes_host((b - 1).astype(np.int32), bits)[0]
+                lex = int(tuple(a) > tuple(b)) - int(tuple(a) < tuple(b))
+                word = int(int(wa) > int(wb)) - int(int(wa) < int(wb))
+                assert lex == word, (bits, a, b)
+
+    def test_device_unpack_matches_host(self, monkeypatch):
+        import jax.numpy as jnp
+
+        for bits in pc.PACKED_BITS:
+            n = 100
+            rng = np.random.RandomState(bits)
+            codes = rng.randint(-1, (1 << bits) - 1, n).astype(np.int32)
+            words = pc.pack_codes_host(codes, bits)
+            lane = pc.unpack_codes_device(jnp.asarray(words), bits)
+            assert lane.dtype == jnp.int8
+            assert np.array_equal(np.asarray(lane)[:n], codes)
+
+    def test_traced_row_pack_roundtrip(self):
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(17)
+        for bits in pc.PACKED_BITS:
+            lpw = pc.lanes_per_word(bits)
+            mat = rng.randint(0, 1 << bits, (6, 4 * lpw))
+            words = pc.pack_rows_traced(jnp.asarray(mat), bits)
+            assert words.shape == (6, 4)
+            back = pc.unpack_rows_traced(words, bits)
+            assert np.array_equal(np.asarray(back), mat)
+
+
+# ---------------------------------------------------------------------------
+# Staging: packed tier bytes + memoization + identical lane values
+# ---------------------------------------------------------------------------
+
+
+class TestPackedStaging:
+    def _lowcard_column(self, n=500, card=12, seed=5, with_nulls=False):
+        rng = np.random.RandomState(seed)
+        dictionary = np.sort(np.array([f"k{i:02d}" for i in range(card)]))
+        codes = rng.randint(0, card, n).astype(np.int32)
+        validity = None
+        if with_nulls:
+            validity = rng.rand(n) > 0.1
+            codes = np.where(validity, codes, 0)
+        c = Column("string", codes, dictionary, validity)
+        c._encoded_read = True
+        return c
+
+    def test_stage_codes_takes_packed_tier(self, monkeypatch):
+        monkeypatch.setenv(ENV_ENC, "1")
+        monkeypatch.delenv(ENV, raising=False)
+        _clear_caches()
+        from hyperspace_tpu.engine.encoded_device import narrow_codes, stage_codes
+
+        c = self._lowcard_column()
+        packed0 = metrics.counter("device.encoded.bytes_packed").value
+        lane = stage_codes(c, "test_packed_site")
+        packed1 = metrics.counter("device.encoded.bytes_packed").value
+        assert packed1 > packed0, "packed tier did not tick"
+        # The device lane is int8 with the EXACT narrow-path values: every
+        # downstream compile class is identical to PR 15.
+        assert lane.dtype == np.int8
+        assert np.array_equal(np.asarray(lane), narrow_codes(c))
+        # Memoized: restaging the same column adds no packed bytes.
+        lane2 = stage_codes(c, "test_packed_site")
+        assert lane2 is lane
+        assert metrics.counter("device.encoded.bytes_packed").value == packed1
+
+    def test_flag_off_stages_narrow_not_packed(self, monkeypatch):
+        monkeypatch.setenv(ENV_ENC, "1")
+        monkeypatch.setenv(ENV, "0")
+        _clear_caches()
+        from hyperspace_tpu.engine.encoded_device import stage_codes
+
+        c = self._lowcard_column(seed=6)
+        packed0 = metrics.counter("device.encoded.bytes_packed").value
+        lane = stage_codes(c, "test_packed_site_off")
+        assert lane.dtype == np.int8  # narrow class still applies
+        assert metrics.counter("device.encoded.bytes_packed").value == packed0
+
+    def test_nulls_fold_into_reserved_lane(self, monkeypatch):
+        monkeypatch.setenv(ENV_ENC, "1")
+        monkeypatch.setenv(ENV, "1")
+        _clear_caches()
+        from hyperspace_tpu.engine.encoded_device import narrow_codes, stage_codes
+
+        c = self._lowcard_column(with_nulls=True, seed=8)
+        lane = stage_codes(c, "test_packed_nulls")
+        assert np.array_equal(np.asarray(lane), narrow_codes(c))
+
+
+# ---------------------------------------------------------------------------
+# Flag oracle: byte-identical index files + results, both mesh ambients
+# ---------------------------------------------------------------------------
+
+
+class TestFlagOracle:
+    @pytest.mark.parametrize("distributed", ["1", "0"])
+    def test_build_byte_identical_across_flag(
+        self, tmp_path, monkeypatch, distributed
+    ):
+        monkeypatch.setenv("HYPERSPACE_DISTRIBUTED", distributed)
+        monkeypatch.setenv(ENV_ENC, "1")
+        s = _session(tmp_path)
+        base = str(tmp_path)
+        _write_lowcard_pair(s, base, 2000, card=12, seed=5)
+        hs = Hyperspace(s)
+        f = s.read.parquet(os.path.join(base, "fact"))
+
+        monkeypatch.setenv(ENV, "1")
+        _clear_caches()
+        hs.create_index(f, IndexConfig("packedIdx", ["sk"], ["val"]))
+        monkeypatch.setenv(ENV, "0")
+        _clear_caches()
+        hs.create_index(f, IndexConfig("narrowIdx", ["sk"], ["val"]))
+        monkeypatch.delenv(ENV, raising=False)
+
+        hp = _dir_hashes(os.path.join(base, "indexes", "packedIdx", "v__=0"))
+        hn = _dir_hashes(os.path.join(base, "indexes", "narrowIdx", "v__=0"))
+        assert len(hp) > 0
+        assert hp == hn
+
+        # And the indexed join answers identically in this ambient.
+        enable_hyperspace(s)
+        d = s.read.parquet(os.path.join(base, "dim"))
+        q = f.join(d, col("sk") == col("dk")).select("val", "w")
+        monkeypatch.setenv(ENV, "1")
+        _clear_caches()
+        rows_on = q.sorted_rows()
+        monkeypatch.setenv(ENV, "0")
+        _clear_caches()
+        rows_off = q.sorted_rows()
+        assert rows_on == rows_off and len(rows_on) > 0
+
+    def test_null_key_join_identical(self, tmp_path, monkeypatch):
+        from hyperspace_tpu.engine import io as engine_io
+
+        monkeypatch.setenv(ENV_ENC, "1")
+        s = _session(tmp_path)
+        base = str(tmp_path)
+        lt = Table.from_pydict(
+            {"k": ["a", "b", None, "c", "a", None], "lv": [1, 2, 3, 4, 5, 6]}
+        )
+        rt = Table.from_pydict({"k": ["b", "a", None, "d"], "rv": [10, 20, 30, 40]})
+        engine_io.write_parquet(lt, os.path.join(base, "nl", "part-00000.parquet"))
+        engine_io.write_parquet(rt, os.path.join(base, "nr", "part-00000.parquet"))
+
+        def q():
+            l = s.read.parquet(os.path.join(base, "nl"))
+            r = s.read.parquet(os.path.join(base, "nr"))
+            return sorted(
+                l.join(r, col("k") == col("k")).select("k", "lv", "rv").collect().rows()
+            )
+
+        monkeypatch.setenv(ENV, "1")
+        _clear_caches()
+        rows_on = q()
+        monkeypatch.setenv(ENV, "0")
+        _clear_caches()
+        rows_off = q()
+        assert rows_on == rows_off
+        assert rows_on == [("a", 1, 20), ("a", 5, 20), ("b", 2, 10)]
+
+
+# ---------------------------------------------------------------------------
+# Mesh wire: packed lanes shrink bytes_moved; compile classes stay bounded
+# ---------------------------------------------------------------------------
+
+
+class TestMeshPackedExchange:
+    def test_exchange_bytes_moved_shrinks(self, tmp_path, monkeypatch):
+        """Sub-byte wire lanes (4-bit biased codes + 1-bit validity + 16-bit
+        row ids) vs the int8 coded exchange (int8 codes/validity + int32 row
+        ids) for the SAME build: ≥1.8× fewer bytes on the wire."""
+        monkeypatch.setenv("HYPERSPACE_DISTRIBUTED", "1")
+        monkeypatch.setenv(ENV_ENC, "1")
+        s = _session(tmp_path)
+        base = str(tmp_path)
+        _write_lowcard_pair(s, base, 3000, card=12, seed=9)
+        hs = Hyperspace(s)
+        f = s.read.parquet(os.path.join(base, "fact"))
+
+        def moved_during(build):
+            before = metrics.counter("parallel.exchange.bytes_moved").value
+            build()
+            return metrics.counter("parallel.exchange.bytes_moved").value - before
+
+        monkeypatch.setenv(ENV, "1")
+        _clear_caches()
+        moved_on = moved_during(
+            lambda: hs.create_index(f, IndexConfig("pkOn", ["sk"], ["val"]))
+        )
+        monkeypatch.setenv(ENV, "0")
+        _clear_caches()
+        moved_off = moved_during(
+            lambda: hs.create_index(f, IndexConfig("pkOff", ["sk"], ["val"]))
+        )
+        monkeypatch.delenv(ENV, raising=False)
+        assert moved_on > 0 and moved_off > 0
+        assert moved_off / moved_on >= 1.8, (moved_off, moved_on)
+
+    def test_no_per_cardinality_compile_classes(self, tmp_path, monkeypatch):
+        """Two cardinalities in the SAME 4-bit class share one compiled
+        exchange AND one compiled unpack: packing mints no new per-cardinality
+        shapes."""
+        monkeypatch.setenv("HYPERSPACE_DISTRIBUTED", "1")
+        monkeypatch.setenv(ENV_ENC, "1")
+        monkeypatch.setenv(ENV, "1")
+        s = _session(tmp_path)
+        base = str(tmp_path)
+        hs = Hyperspace(s)
+        rng = np.random.RandomState(23)
+        for suffix, card in (("a", 6), ("b", 12)):
+            s.write_parquet(
+                {
+                    "sk": np.array(
+                        [f"k{v:02d}" for v in rng.randint(0, card, 2048)]
+                    ),
+                    "val": np.arange(2048, dtype=np.int64),
+                },
+                os.path.join(base, f"fact{suffix}"),
+            )
+
+        def compiles(lbl):
+            return compile_log.program_summary().get(lbl, {}).get("compiles", 0)
+
+        _clear_caches()
+        fa = s.read.parquet(os.path.join(base, "facta"))
+        hs.create_index(fa, IndexConfig("pcA", ["sk"], ["val"]))
+        exchange_first = compiles("parallel.exchange")
+        unpack_first = compiles("packed.unpack")
+        assert exchange_first >= 1
+        assert unpack_first >= 1
+        fb = s.read.parquet(os.path.join(base, "factb"))
+        hs.create_index(fb, IndexConfig("pcB", ["sk"], ["val"]))
+        assert compiles("parallel.exchange") == exchange_first, (
+            "a second cardinality in the same packed class recompiled the "
+            "exchange"
+        )
+        assert compiles("packed.unpack") == unpack_first, (
+            "a second cardinality in the same packed class recompiled the "
+            "unpack program"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Compute on packed words: kernels vs their XLA/numpy oracles (interpret mode)
+# ---------------------------------------------------------------------------
+
+
+class TestComputeOnPacked:
+    def test_packed_sort_matches_stable_argsort(self, monkeypatch):
+        monkeypatch.setenv("HYPERSPACE_PALLAS_SORT", "1")
+        import jax.numpy as jnp
+
+        from hyperspace_tpu.ops.pallas_sort import sort_codes_packed
+
+        rng = np.random.RandomState(2)
+        for bits in pc.PACKED_BITS:
+            B, cap = 8, 512
+            top = (1 << bits) - 1
+            mat = rng.randint(0, top, (B, cap))
+            lens = rng.randint(0, cap + 1, B)
+            for b in range(B):
+                mat[b, lens[b] :] = top  # pads at the reserved top lane value
+            words = pc.pack_rows_traced(jnp.asarray(mat), bits)
+            codes_s, order = sort_codes_packed(words, bits)
+            oracle_order = np.argsort(mat, axis=1, kind="stable")
+            # Composite uniqueness => the unstable bitonic reproduces the
+            # STABLE argsort exactly, ties included.
+            assert np.array_equal(np.asarray(order), oracle_order), bits
+            assert np.array_equal(
+                np.asarray(codes_s),
+                np.take_along_axis(mat, oracle_order, axis=1),
+            ), bits
+
+    def test_packed_probe_matches_searchsorted(self, monkeypatch):
+        monkeypatch.setenv("HYPERSPACE_PALLAS_PROBE", "1")
+        import jax.numpy as jnp
+
+        from hyperspace_tpu.ops.pallas_probe import probe_packed_pallas
+
+        rng = np.random.RandomState(4)
+        bits = 4
+        B, cap_l, cap_r = 8, 256, 512
+        top = (1 << bits) - 1
+        L = rng.randint(0, top, (B, cap_l))
+        R = rng.randint(0, top, (B, cap_r))
+        l_len = rng.randint(0, cap_l + 1, B)
+        r_len = rng.randint(0, cap_r + 1, B)
+        for b in range(B):
+            L[b, l_len[b] :] = top
+            R[b, r_len[b] :] = top
+        L.sort(axis=1)
+        R.sort(axis=1)
+        lw = pc.pack_rows_traced(jnp.asarray(L), bits)
+        rw = pc.pack_rows_traced(jnp.asarray(R), bits)
+        lo, cnt = probe_packed_pallas(
+            lw, rw, bits, jnp.asarray(l_len), jnp.asarray(r_len)
+        )
+        lo, cnt = np.asarray(lo), np.asarray(cnt)
+        for b in range(B):
+            n, m = l_len[b], r_len[b]
+            exp_lo = np.minimum(np.searchsorted(R[b], L[b], "left"), m)
+            exp_hi = np.minimum(np.searchsorted(R[b], L[b], "right"), m)
+            exp_cnt = np.where(np.arange(cap_l) < n, exp_hi - exp_lo, 0)
+            assert np.array_equal(cnt[b], exp_cnt), b
+            assert np.array_equal(lo[b, :n], exp_lo[:n]), b
+
+    def test_code_rep_probe_matches_widen_fallback(self, monkeypatch):
+        """The full code-mode rep path: packed-kernel probe, widen-then-probe
+        fallback, and a per-bucket numpy oracle all agree on (lo, counts)."""
+        monkeypatch.setenv(ENV, "1")
+        monkeypatch.setenv("HYPERSPACE_PALLAS_SORT", "1")
+        from hyperspace_tpu.ops import bucket_join as bj
+
+        rng = np.random.RandomState(6)
+        card = 13  # probe class: card + 2 <= 16
+        B = 16
+        l_lens = rng.randint(0, 120, B)
+        r_lens = rng.randint(0, 200, B)
+        l_starts = np.concatenate([[0], np.cumsum(l_lens)])
+        r_starts = np.concatenate([[0], np.cumsum(r_lens)])
+        l_codes = rng.randint(0, card, l_starts[-1])
+        r_codes = rng.randint(0, card, r_starts[-1])
+        lrep = bj.pad_buckets_by_codes(l_codes, l_starts, card)
+        rrep = bj.pad_buckets_by_codes(r_codes, r_starts, card)
+        assert lrep is not None and rrep is not None
+        assert lrep.bits == 4
+        # Rep `order` maps sorted slots back to storage slots bijectively.
+        for b in range(B):
+            n = l_lens[b]
+            got = np.sort(np.asarray(lrep.order)[b, :n])
+            assert np.array_equal(got, np.arange(n)), b
+
+        monkeypatch.setenv("HYPERSPACE_PALLAS_PROBE", "1")
+        lo_k, cnt_k = bj.probe_code_ranges(lrep, rrep)
+        monkeypatch.setenv("HYPERSPACE_PALLAS_PROBE", "0")
+        lo_w, cnt_w = bj.probe_code_ranges(lrep, rrep)
+        assert np.array_equal(np.asarray(cnt_k), np.asarray(cnt_w))
+        for b in range(B):
+            ls = np.sort(l_codes[l_starts[b] : l_starts[b + 1]])
+            rs = np.sort(r_codes[r_starts[b] : r_starts[b + 1]])
+            exp = np.searchsorted(rs, ls, "right") - np.searchsorted(rs, ls, "left")
+            assert np.array_equal(np.asarray(cnt_k)[b, : len(ls)], exp), b
+
+    def test_rep_requires_probe_class_and_no_nulls(self, monkeypatch):
+        monkeypatch.setenv(ENV, "1")
+        from hyperspace_tpu.ops import bucket_join as bj
+
+        starts = np.array([0, 4])
+        codes = np.array([0, 1, 2, 1])
+        assert bj.pad_buckets_by_codes(codes, starts, 15) is None  # 15+2 > 16
+        assert bj.pad_buckets_by_codes(codes, starts, 13, has_nulls=True) is None
+        assert bj.pad_buckets_by_codes(codes, starts, 13) is not None
+
+    def test_packed_build_sort_matches_sort_perm(self, monkeypatch):
+        """The int32 (bucket|code|row) composite build sort reproduces the
+        device variadic sort's canonical order exactly — nulls included."""
+        monkeypatch.setenv(ENV, "1")
+        monkeypatch.setenv("HYPERSPACE_PALLAS_SORT", "1")
+        import jax.numpy as jnp
+
+        from hyperspace_tpu.engine.table import STRING
+        from hyperspace_tpu.ops.hashing import bucket_id
+        from hyperspace_tpu.ops.partition import _sort_perm, pallas_packed_build_sort
+
+        rng = np.random.RandomState(8)
+        card, n, nb = 12, 3000, NUM_BUCKETS
+        dictionary = np.sort(np.array([f"k{i:02d}" for i in range(card)]))
+        codes = rng.randint(0, card, n).astype(np.int32)
+        codes[::11] = -1  # null lane rides the reserved biased 0
+        valid = codes >= 0
+        c = Column(STRING, np.where(valid, codes, 0), dictionary, valid)
+        lane = jnp.asarray(codes.astype(np.int8))
+        b = bucket_id([c], [lane], nb)
+        res = pallas_packed_build_sort(b, lane, card, n, nb)
+        assert res is not None
+        perm, sorted_b = res
+        operm, osb = _sort_perm(b, (jnp.asarray(codes),), n)
+        assert np.array_equal(perm, np.asarray(operm))
+        assert np.array_equal(sorted_b, np.asarray(osb))
+
+    def test_packed_build_sort_respects_flag_and_budget(self, monkeypatch):
+        import jax.numpy as jnp
+
+        from hyperspace_tpu.ops.partition import pallas_packed_build_sort
+
+        monkeypatch.setenv("HYPERSPACE_PALLAS_SORT", "1")
+        b = jnp.zeros(400, jnp.int32)
+        lane = jnp.zeros(400, jnp.int8)
+        monkeypatch.setenv(ENV, "0")
+        assert pallas_packed_build_sort(b, lane, 12, 400, NUM_BUCKETS) is None
+        monkeypatch.setenv(ENV, "1")
+        # Cardinality past every packed class: no composite encoding exists.
+        assert pallas_packed_build_sort(b, lane, 200, 400, NUM_BUCKETS) is None

@@ -201,15 +201,19 @@ def _transfer_bandwidth(baselines: Dict[str, dict], top: int) -> List[dict]:
         if not moved:
             continue
         wall = s.get("wall_total_s") or 0.0
-        rows.append(
-            {
-                "fingerprint": fp,
-                "names": s.get("names"),
-                "n": s.get("n"),
-                "bytes_moved": moved,
-                "effective_gbps": round(moved / wall / 1e9, 4) if wall else None,
-            }
-        )
+        row = {
+            "fingerprint": fp,
+            "names": s.get("names"),
+            "n": s.get("n"),
+            "bytes_moved": moved,
+            "effective_gbps": round(moved / wall / 1e9, 4) if wall else None,
+        }
+        packed = s.get("device_code_bytes_packed", 0)
+        if packed:
+            # The slice of the moved bytes that crossed as bit-packed
+            # sub-byte words (HYPERSPACE_PACKED_CODES).
+            row["bytes_packed"] = packed
+        rows.append(row)
     rows.sort(key=lambda r: -r["bytes_moved"])
     return rows[:top]
 
@@ -220,23 +224,33 @@ def _code_staging(baselines: Dict[str, dict], top: int) -> List[dict]:
     (``device_code_bytes_flat`` / ``device_code_bytes_staged``, recorded by
     the encoded-staging ledger under ``HYPERSPACE_ENCODED_DEVICE``). A class
     with no rows here staged nothing in code space — flat fallback or
-    numeric-only keys."""
+    numeric-only keys. ``code_bytes_packed`` is the BIT-PACKED sub-byte tier
+    of the staged bytes (``HYPERSPACE_PACKED_CODES``): for it the report adds
+    the average bits charged per code and the pack ratio vs the int8 narrow
+    floor (int8 would charge flat/4 bytes — one byte per code)."""
     rows = []
     for fp, s in baselines.items():
         flat = s.get("device_code_bytes_flat", 0)
         staged = s.get("device_code_bytes_staged", 0)
         if not (flat or staged):
             continue
-        rows.append(
-            {
-                "fingerprint": fp,
-                "names": s.get("names"),
-                "n": s.get("n"),
-                "code_bytes_flat": flat,
-                "code_bytes_staged": staged,
-                "saved_ratio": round(1.0 - staged / flat, 4) if flat else None,
-            }
-        )
+        row = {
+            "fingerprint": fp,
+            "names": s.get("names"),
+            "n": s.get("n"),
+            "code_bytes_flat": flat,
+            "code_bytes_staged": staged,
+            "saved_ratio": round(1.0 - staged / flat, 4) if flat else None,
+        }
+        packed = s.get("device_code_bytes_packed", 0)
+        if packed:
+            n_codes = flat // 4  # flat charges int32 — 4 bytes per code
+            row["code_bytes_packed"] = packed
+            row["bits_per_code"] = (
+                round(packed * 8 / n_codes, 2) if n_codes else None
+            )
+            row["packed_vs_int8_x"] = round(n_codes / packed, 2) if packed else None
+        rows.append(row)
     rows.sort(key=lambda r: -(r["code_bytes_flat"] - r["code_bytes_staged"]))
     return rows[:top]
 
@@ -323,8 +337,11 @@ def render(report: dict) -> str:
         lines += ["", "effective transfer bandwidth (h2d+d2h over class wall):"]
         for h in report["transfer_bandwidth"]:
             gbps = h.get("effective_gbps")
+            packed = (
+                f" packed={h['bytes_packed']}B" if h.get("bytes_packed") else ""
+            )
             lines.append(
-                f"  {h['fingerprint']}  moved={h['bytes_moved']}B"
+                f"  {h['fingerprint']}  moved={h['bytes_moved']}B{packed}"
                 f"  {gbps if gbps is not None else '-'} GB/s"
                 f"  [{','.join(h.get('names') or [])}]"
             )
@@ -333,9 +350,16 @@ def render(report: dict) -> str:
         for h in report["code_staging"]:
             saved = h.get("saved_ratio")
             saved_str = f" saved={saved:.0%}" if saved is not None else ""
+            packed_str = ""
+            if h.get("code_bytes_packed"):
+                packed_str = (
+                    f" packed={h['code_bytes_packed']}B"
+                    f" ({h['bits_per_code']}b/code,"
+                    f" {h['packed_vs_int8_x']}x vs int8)"
+                )
             lines.append(
                 f"  {h['fingerprint']}  flat={h['code_bytes_flat']}B"
-                f" staged={h['code_bytes_staged']}B{saved_str}"
+                f" staged={h['code_bytes_staged']}B{saved_str}{packed_str}"
                 f"  [{','.join(h.get('names') or [])}]"
             )
     return "\n".join(lines)
