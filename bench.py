@@ -513,6 +513,9 @@ def run_bench(deadline: float = None) -> dict:
             "packed_codes",
             lambda: d.update(_packed_codes_section(s, base, col, runs, hs)),
         )
+        # -- adaptive planner: every ambient knob UNSET (planner deciding)
+        #    vs the best hand-picked pinned configuration per workload
+        ph.run("planner", lambda: d.update(_planner_section(s, base, col, runs, hs)))
         # -- multi-tenant serving: N clients × mixed Q1/Q3/Q14/point workload
         #    through the QueryServer (throughput, per-class p50/p99, dedup
         #    counters, cold-scan single-flight probe)
@@ -1224,6 +1227,113 @@ def _packed_codes_section(s, base, col, runs, hs) -> dict:
             else:
                 os.environ[k] = v
     return {"packed_codes": out}
+
+
+def _planner_section(s, base, col, runs, hs) -> dict:
+    """Adaptive cost-based planner (`HYPERSPACE_PLANNER`): the acceptance
+    bar is that a run with EVERY governed ambient flag unset (the planner
+    deciding each knob per query) matches or beats the best hand-picked
+    pinned configuration per workload:
+
+    - ``planner_{agg,join}_p50_s``: the planner leg (all knobs unset);
+    - ``planner_{agg,join}_best_pinned_p50_s``: min p50 across a pinned
+      sweep (`HYPERSPACE_PLANNER=0` with defaults, streaming off, encoded
+      off, and hash-quantize forced each way — the knobs whose wrong arm
+      is the documented regression case);
+    - ``planner_{agg,join}_vs_best_x``: planner over best-pinned (≈1.0 or
+      below is the win condition; the standard noise bands apply);
+    - ``planner_agg_arms``: the arms the model actually chose, for the
+      artifact record.
+
+    `tools/bench_compare.py --keys 'planner*'` gates these (self-gating:
+    keys absent from both artifacts pass)."""
+    from hyperspace_tpu.engine import io as _eio
+    from hyperspace_tpu.engine.table import Table as _T
+    from hyperspace_tpu.plananalysis import costmodel as _cm
+    from hyperspace_tpu.plananalysis import planner as _planner
+
+    n = int(os.environ.get("BENCH_PLANNER_ROWS", 400_000))
+    n_dim = max(n // 8, 1000)
+    fact_dir = os.path.join(base, "fact_planner")
+    dim_dir = os.path.join(base, "dim_planner")
+    rng = np.random.RandomState(53)
+    keys = np.asarray([f"pk#{i:03d}" for i in range(64)])
+    _eio.write_parquet(
+        _T.from_pydict(
+            {
+                "k": keys[rng.randint(0, 64, n)].tolist(),
+                "grp": keys[rng.randint(0, 16, n)].tolist(),
+                "v": rng.randint(0, 1000, n).astype(np.int64).tolist(),
+            }
+        ),
+        os.path.join(fact_dir, "part-00000.parquet"),
+    )
+    _eio.write_parquet(
+        _T.from_pydict(
+            {
+                "k": keys[rng.randint(0, 64, n_dim)].tolist(),
+                "w": rng.randint(0, 100, n_dim).astype(np.int64).tolist(),
+            }
+        ),
+        os.path.join(dim_dir, "part-00000.parquet"),
+    )
+
+    def q_agg():
+        return s.read.parquet(fact_dir).group_by("grp").agg(total=("v", "sum"))
+
+    def q_join():
+        return s.read.parquet(fact_dir).join(
+            s.read.parquet(dim_dir), col("k") == col("k")
+        )
+
+    governed = list(_cm.KNOB_ENV.values()) + [_planner.ENV_PLANNER]
+    saved = {k: os.environ.pop(k) for k in governed if k in os.environ}
+    out: dict = {}
+    try:
+        _planner.reset()
+        # Warm the scan caches + compiles once so every leg (planner first,
+        # pinned sweep after) times the same steady state.
+        q_agg().collect()
+        q_join().collect()
+        out["planner_agg_p50_s"] = round(timed_p50(lambda: q_agg().collect(), runs), 4)
+        out["planner_join_p50_s"] = round(timed_p50(lambda: q_join().collect(), runs), 4)
+        pd = _planner.decide(q_agg().physical_plan(), None)
+        if pd is not None:
+            out["planner_agg_arms"] = {k: d.arm for k, d in pd.decisions.items()}
+
+        os.environ[_planner.ENV_PLANNER] = "0"
+        pinned: dict = {}
+        configs = {
+            "defaults": {},
+            "stream_off": {"HYPERSPACE_QUERY_STREAMING": "0"},
+            "encoded_off": {"HYPERSPACE_ENCODED_EXEC": "0"},
+            "quantize_on": {"HYPERSPACE_HASH_QUANTIZE": "1"},
+            "quantize_off": {"HYPERSPACE_HASH_QUANTIZE": "0"},
+        }
+        for name, env in configs.items():
+            for k_, v_ in env.items():
+                os.environ[k_] = v_
+            try:
+                pinned[name] = {
+                    "agg_p50_s": round(timed_p50(lambda: q_agg().collect(), runs), 4),
+                    "join_p50_s": round(timed_p50(lambda: q_join().collect(), runs), 4),
+                }
+            finally:
+                for k_ in env:
+                    os.environ.pop(k_, None)
+        out["planner_pinned"] = pinned
+        best_agg = min(v["agg_p50_s"] for v in pinned.values())
+        best_join = min(v["join_p50_s"] for v in pinned.values())
+        out["planner_agg_best_pinned_p50_s"] = best_agg
+        out["planner_join_best_pinned_p50_s"] = best_join
+        if best_agg:
+            out["planner_agg_vs_best_x"] = round(out["planner_agg_p50_s"] / best_agg, 3)
+        if best_join:
+            out["planner_join_vs_best_x"] = round(out["planner_join_p50_s"] / best_join, 3)
+    finally:
+        os.environ.pop(_planner.ENV_PLANNER, None)
+        os.environ.update(saved)
+    return out
 
 
 def _serving_section(s, base, col, runs, hs) -> dict:

@@ -61,7 +61,10 @@ def encoded_device_mode() -> str:
 
 def encoded_device_enabled() -> bool:
     """Is the device-resident code path on at all? Auto defers to the master
-    encoded-exec switch (`HYPERSPACE_ENCODED_EXEC`)."""
+    encoded-exec switch (`HYPERSPACE_ENCODED_EXEC`) — which, when unset,
+    is itself decided per query by the adaptive planner
+    (`plananalysis.planner`): one `encoded_exec` decision governs the host
+    encoded layer, this device lane, and (transitively) packed code lanes."""
     mode = encoded_device_mode()
     if mode == "off":
         return False

@@ -66,8 +66,18 @@ _DEFAULT_ENCODED_DICT_MAX = 1 << 20
 
 def encoded_exec_enabled() -> bool:
     """Default ON; ``HYPERSPACE_ENCODED_EXEC=0`` is the byte-identical
-    decoded fallback (pinned by tests/test_encoded_exec.py)."""
-    return os.environ.get(ENV_ENCODED_EXEC, "") != "0"
+    decoded fallback (pinned by tests/test_encoded_exec.py). Unset defers
+    to the adaptive planner's per-query decision when one is ambient —
+    explicit flags always win (`docs/planner.md`). The encoded-DEVICE
+    lane (`encoded_device.py`) rides this gate transitively in its auto
+    mode, so one planner decision governs both layers."""
+    raw = os.environ.get(ENV_ENCODED_EXEC, "")
+    if raw != "":
+        return raw != "0"
+    from ..plananalysis.planner import decided_value
+
+    decided = decided_value("encoded_exec")
+    return True if decided is None else bool(decided)
 
 
 def encoded_dict_max() -> int:

@@ -72,6 +72,11 @@ def packed_codes_enabled() -> bool:
         return False
     if mode == "force":
         return True
+    from ..plananalysis.planner import decided_value
+
+    decided = decided_value("packed_codes")
+    if decided is not None:
+        return bool(decided)
     from .encoded_device import encoded_device_enabled
 
     return encoded_device_enabled()

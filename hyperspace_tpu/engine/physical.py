@@ -60,6 +60,12 @@ _BUCKET_FILE_RE = re.compile(r"part-(\d+)")
 class ExecContext:
     def __init__(self, session=None):
         self.session = session
+        # The adaptive planner's decisions for this query (None when the
+        # planner is off or nothing was decided) — captured at construction
+        # so physical operators hold the same object the ambient gates read.
+        from ..plananalysis.planner import current_decisions
+
+        self.plan_decisions = current_decisions()
 
 
 _footer_count_cache: Dict[tuple, int] = {}

@@ -42,8 +42,16 @@ ENV_SCAN_PUSHDOWN = "HYPERSPACE_SCAN_PUSHDOWN"
 
 def pushdown_enabled() -> bool:
     """Default ON; ``HYPERSPACE_SCAN_PUSHDOWN=0`` disables every row-group
-    pruning decision (whole files decode exactly as before the pushdown)."""
-    return os.environ.get(ENV_SCAN_PUSHDOWN, "") != "0"
+    pruning decision (whole files decode exactly as before the pushdown).
+    Unset defers to the adaptive planner's per-query decision when one is
+    ambient — explicit flags always win (`docs/planner.md`)."""
+    raw = os.environ.get(ENV_SCAN_PUSHDOWN, "")
+    if raw != "":
+        return raw != "0"
+    from ..plananalysis.planner import decided_value
+
+    decided = decided_value("pushdown")
+    return True if decided is None else bool(decided)
 
 
 _FLIPPED = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "==": "==", "!=": "!="}

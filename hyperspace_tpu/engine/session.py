@@ -178,18 +178,28 @@ class DataFrame:
         retry executes against the source data and the result stays correct.
         Bounded by construction: every round quarantines a NEW index
         (`quarantine.mark` returns False on a repeat, which propagates)."""
+        import time as _time
         import warnings
 
         from ..exceptions import CorruptIndexError
         from ..index import quarantine
+        from ..plananalysis import planner as _planner
         from ..telemetry import tracing
 
         while True:
             with tracing.span("plan"):
                 phys = self.physical_plan()
-            self._attach_fingerprint(phys)
+            fp = self._attach_fingerprint(phys)
+            decisions = _planner.decide(phys, fp)
             try:
-                return runner(phys)
+                with _planner.decisions_scope(decisions):
+                    t0 = _time.monotonic()
+                    out = runner(phys)
+                # Feed the measured wall back (outcome store; no-op without a
+                # persistent home) — only on success: a quarantine retry's
+                # partial wall would poison the arm stats.
+                _planner.observe(decisions, _time.monotonic() - t0)
+                return out
             except CorruptIndexError as e:
                 if not quarantine.mark(e.index_name, reason=str(e), path=e.path):
                     raise
@@ -202,26 +212,32 @@ class DataFrame:
                     stacklevel=3,
                 )
 
-    def _attach_fingerprint(self, phys: PhysicalNode) -> None:
+    def _attach_fingerprint(self, phys: PhysicalNode):
         """Stamp the optimized plan's execution-class fingerprint
         (`plananalysis.fingerprint`) onto the ambient root span and ledger —
-        the key the workload history store lands this query under. Computed
-        only when a consumer exists (history enabled / ledger open / span
-        recording); with everything off this is one env read + one
-        contextvar read, the zero-cost-off contract."""
+        the key the workload history store lands this query under — and
+        return it (the adaptive planner's outcome store keys on the same
+        class; only an OBSERVING planner — one with a persistent outcome
+        home — counts as a consumer). Computed only when a consumer exists
+        (history enabled / ledger open / span recording / planner learning);
+        with everything off this is one env read + one contextvar read, the
+        zero-cost-off contract."""
         from ..plananalysis import fingerprint as _fp
+        from ..plananalysis import planner as _planner
         from ..telemetry import accounting, tracing
 
         try:
             if not _fp.fingerprint_wanted():
-                return
+                if not (_planner.planner_enabled() and _planner.outcome_dir()):
+                    return None
             fp = _fp.plan_fingerprint(phys)
         except Exception:
-            return  # fingerprinting must never fail the query
+            return None  # fingerprinting must never fail the query
         accounting.set_value("plan_fingerprint", fp)
         sp = tracing.current_span()
         if sp is not None:
             sp.set_attr("plan_fingerprint", fp)
+        return fp
 
     def collect(self) -> Table:
         from .. import resilience

@@ -67,18 +67,31 @@ _DEFAULT_JOIN_CHUNK_ROWS = 2_000_000
 
 def streaming_enabled() -> bool:
     """Default ON; ``HYPERSPACE_QUERY_STREAMING=0`` is the materialized
-    fallback (preserves the pre-streaming execution exactly)."""
-    return os.environ.get(ENV_QUERY_STREAMING, "") != "0"
+    fallback (preserves the pre-streaming execution exactly). Unset hands
+    the knob to the adaptive planner when one decided this query — an
+    explicit flag always wins (`docs/planner.md`)."""
+    raw = os.environ.get(ENV_QUERY_STREAMING, "")
+    if raw != "":
+        return raw != "0"
+    from ..plananalysis.planner import decided_value
+
+    decided = decided_value("streaming")
+    return True if decided is None else bool(decided)
 
 
 def query_chunk_rows() -> int:
-    return max(
-        1,
-        int(
-            os.environ.get(ENV_QUERY_CHUNK_ROWS, _DEFAULT_QUERY_CHUNK_ROWS)
-            or _DEFAULT_QUERY_CHUNK_ROWS
-        ),
-    )
+    raw = os.environ.get(ENV_QUERY_CHUNK_ROWS, "")
+    if raw != "":
+        try:
+            return max(1, int(raw))
+        except ValueError:
+            return _DEFAULT_QUERY_CHUNK_ROWS
+    from ..plananalysis.planner import decided_value
+
+    decided = decided_value("chunk_rows")
+    if decided is not None:
+        return max(1, int(decided))
+    return _DEFAULT_QUERY_CHUNK_ROWS
 
 
 def join_chunk_rows() -> int:

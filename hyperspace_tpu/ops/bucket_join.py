@@ -44,7 +44,15 @@ _MAX_CLASSES = 8
 
 
 def size_classes_enabled() -> bool:
-    return os.environ.get(ENV_SIZE_CLASSES, "") != "0"
+    """Default ON; unset defers to the adaptive planner's per-query decision
+    when one is ambient — explicit flags always win (`docs/planner.md`)."""
+    raw = os.environ.get(ENV_SIZE_CLASSES, "")
+    if raw != "":
+        return raw != "0"
+    from ..plananalysis.planner import decided_value
+
+    decided = decided_value("join_size_classes")
+    return True if decided is None else bool(decided)
 
 
 def _outlier_factor() -> float:

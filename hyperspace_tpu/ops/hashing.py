@@ -67,6 +67,15 @@ def _hash_quantize_enabled() -> bool:
     env = os.environ.get(ENV_HASH_QUANTIZE)
     if env is not None and env != "":
         return env != "0"
+    # Unset: the adaptive planner's calibrated decision replaces the raw
+    # device-only heuristic (same prior, but both arms are priced and the
+    # per-class outcome store can overturn a wrong guess — the measured 45%
+    # CPU regression case lands on the span either way).
+    from ..plananalysis.planner import decided_value
+
+    decided = decided_value("hash_quantize")
+    if decided is not None:
+        return bool(decided)
     from .backend import use_device_path
 
     return use_device_path()

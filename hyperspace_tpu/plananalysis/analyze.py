@@ -127,18 +127,31 @@ def _stage_lines(span, children_of, indent: int) -> List[str]:
 
 def explain_analyze_string(df) -> str:
     """Execute `df` once under a trace and render the annotated plan tree."""
+    import time as _time
+
+    from .. import resilience
     from ..engine.physical import ExecContext
     from ..telemetry import accounting, metrics, tracing
+    from . import planner as _planner
 
     session = df.session
     snap0 = metrics.snapshot()
     with tracing.capture() as cap:
-        with tracing.query_span("query:explain_analyze") as root:
-            with tracing.span("plan"):
-                phys = df.physical_plan()
-            result = phys.execute(ExecContext(session))
-            root.set_attr("rows_out", int(result.num_rows))
-            accounting.set_value("rows_produced", int(result.num_rows))
+        # The resilience scope mirrors collect(): the planner's decisions
+        # ride the QueryScope into pool workers here exactly as they do on
+        # the production path, so the analyzed run IS the decided run.
+        with resilience.query_scope("query:explain_analyze"):
+            with tracing.query_span("query:explain_analyze") as root:
+                with tracing.span("plan"):
+                    phys = df.physical_plan()
+                fp = df._attach_fingerprint(phys)
+                pd = _planner.decide(phys, fp)
+                with _planner.decisions_scope(pd):
+                    t0 = _time.monotonic()
+                    result = phys.execute(ExecContext(session))
+                _planner.observe(pd, _time.monotonic() - t0)
+                root.set_attr("rows_out", int(result.num_rows))
+                accounting.set_value("rows_produced", int(result.num_rows))
     snap1 = metrics.snapshot()
     trace = cap.trace
     if trace is None:  # defensive: capture always receives the root above
@@ -209,6 +222,43 @@ def explain_analyze_string(df) -> str:
             lines.append(f"  {d.get('rule')}: {verdict}{suffix}")
     else:
         lines.append("  (none recorded — no optimizer rules fired on this plan)")
+
+    # Planner: the adaptive cost-based decisions this run executed under,
+    # each knob's chosen arm with BOTH arms' predicted attributable cost and
+    # the predicted-vs-actual drift ratio (`docs/planner.md`). Pinned knobs
+    # (explicit env flags) are reported too — the planner never overrides
+    # them, it only prices what the pin costs.
+    lines.append("")
+    lines.append("Planner:")
+    if pd is None:
+        lines.append(
+            "  (off: HYPERSPACE_PLANNER=0 — env-flag defaults in force)"
+        )
+    else:
+        from .costmodel import KNOBS as _knobs
+
+        wall_actual = root_span.duration_s or 0.0
+        lines.append(
+            f"  calibration={pd.calibration_source}  "
+            f"fingerprint={pd.fingerprint or '(not computed)'}"
+        )
+        for knob in _knobs:
+            d = pd.decisions.get(knob)
+            if d is None:
+                continue
+            drift = ""
+            if d.predicted_s and d.predicted_s > 0:
+                drift = f"  drift_x={wall_actual / d.predicted_s:.2f}"
+            lines.append(
+                f"  {knob}: {d.arm} [{d.source}]  "
+                f"predicted={_fmt_seconds(d.predicted_s)}  "
+                f"(alt {_planner.arm_label(d.alt)}: "
+                f"{_fmt_seconds(d.predicted_alt_s)}){drift}"
+            )
+        lines.append(
+            f"  actual wall={_fmt_seconds(wall_actual)}  "
+            "(drift_x = wall / the arm's predicted attributable cost)"
+        )
 
     # Resource ledger: what THIS query spent (exact per-query attribution —
     # the contextvar-scoped ledger, not the process-wide counters below).

@@ -1,0 +1,344 @@
+"""Plan-time cost model: bytes-moved estimates per candidate knob setting.
+
+The engine's behavior knobs (streaming, encoded exec, packed codes, hash
+quantize, pushdown, chunk rows, join size classes) each trade one kind of
+byte movement for another: a materialized aggregate pays an extra full-table
+concat, flat string staging pays decoded value bytes where codes would do,
+pow2 hash quantization pays padded copies to save per-shape recompiles. In
+the "cost = bytes moved" framing (JSPIM's external-memory join accounting;
+FractalSortCPU's bandwidth-first model where compressed width x rows is the
+dominant term), every one of those trades prices in seconds as
+
+    predicted_s = bytes_moved / measured_bandwidth  (+ per-event constants)
+
+This module is the pure pricing half of the adaptive planner
+(`plananalysis.planner`): given one physical plan it gathers `PlanStats`
+from footer-cache column stats the scan layer already holds (row counts,
+row-group byte sizes, per-column dictionary-encoding facts —
+`engine.io.FileFooterMeta`, WARM cache peeks only: the model never opens a
+file), reads `Calibration` constants from the device observatory's measured
+ledgers (transfer GB/s when probes have run; honest defaults otherwise), and
+prices BOTH arms of every governed knob. Choosing, pinning, learning, and
+self-correction live in `planner.py`.
+
+Model posture: the chosen arm equals today's default unless the stats give a
+decisive, warm-footer-backed margin — the priors reproduce the existing
+heuristics (hash quantize keys off `use_device_path`, exactly the gate it
+replaces, but now with BOTH arms priced so the planner's outcome store can
+overturn a wrong guess from measurements). Predictions are attributable
+marginal costs, not wall-clock forecasts: the planner compares a knob's
+predicted_s against measured walls only for drift *ratios*, never absolutes.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Set, Tuple
+
+#: Every knob the planner governs, in exploration-priority order (the
+#: outcome store explores at most one knob per query; the HASH_QUANTIZE
+#: auto-gate is the motivating mis-guess, so it goes first).
+KNOBS = (
+    "hash_quantize",
+    "streaming",
+    "chunk_rows",
+    "encoded_exec",
+    "packed_codes",
+    "pushdown",
+    "join_size_classes",
+)
+
+#: knob -> the env flag that PINS it (set flag = pinned, unset = planner).
+KNOB_ENV = {
+    "hash_quantize": "HYPERSPACE_HASH_QUANTIZE",
+    "streaming": "HYPERSPACE_QUERY_STREAMING",
+    "chunk_rows": "HYPERSPACE_QUERY_CHUNK_ROWS",
+    "encoded_exec": "HYPERSPACE_ENCODED_EXEC",
+    "packed_codes": "HYPERSPACE_PACKED_CODES",
+    "pushdown": "HYPERSPACE_SCAN_PUSHDOWN",
+    "join_size_classes": "HYPERSPACE_JOIN_SIZE_CLASSES",
+}
+
+INT_KNOBS = ("chunk_rows",)
+
+#: Calibration override/injection: bench measures the host memcpy peak
+#: (`bench.py` memcpy_peak_gbps) and can hand it to the planner; tests pin it.
+ENV_MEMCPY_GBPS = "HYPERSPACE_PLANNER_MEMCPY_GBPS"
+
+_DEFAULT_MEMCPY_GBPS = 8.0  # conservative host copy ceiling
+_DEFAULT_DECODE_GBPS = 1.5  # parquet decode throughput (uncompressed bytes)
+_HOST_COMPILE_S = 0.03  # one XLA-CPU program build
+_DEVICE_COMPILE_S = 0.5  # one accelerator program build (lowering + compile)
+_CHUNK_OVERHEAD_S = 0.0008  # per-chunk dispatch/carry overhead
+_TARGET_CHUNK_BYTES = 64 << 20  # streamed working set the chunk shaper aims at
+_DEFAULT_CHUNK_ROWS = 4_000_000  # streaming._DEFAULT_QUERY_CHUNK_ROWS
+_MIN_CHUNK_ROWS = 65_536
+#: Below this many (warm-footer-counted) rows the chunk shaper keeps the
+#: default: re-shaping tiny tables moves nothing and would churn chunk-count
+#: expectations for no win.
+_CHUNK_SHAPE_MIN_ROWS = 1_000_000
+_EST_ROW_BYTES = 50  # cold fallback: rows from file bytes
+_EST_DECODE_RATIO = 3.0  # cold fallback: uncompressed from compressed bytes
+_CODE_BYTES_PER_ROW = 1.25  # narrow codes + validity, per dictionary column
+
+
+@dataclass
+class Calibration:
+    """Measured (or default) constants the pricing uses. `source` says where
+    the bandwidth came from ("env" / "measured" / "default") so explain and
+    hsreport can show whether a decision was calibrated or guessed."""
+
+    memcpy_gbps: float = _DEFAULT_MEMCPY_GBPS
+    decode_gbps: float = _DEFAULT_DECODE_GBPS
+    compile_s: float = _HOST_COMPILE_S
+    chunk_overhead_s: float = _CHUNK_OVERHEAD_S
+    device: bool = False
+    source: str = "default"
+
+
+def current_calibration() -> Calibration:
+    """Calibration from the device observatory's measured ledgers: the h2d
+    transfer GB/s when timing probes have recorded any (the honest measured
+    number for 'what does moving a byte cost here'), env-pinned when
+    `HYPERSPACE_PLANNER_MEMCPY_GBPS` is set, defaults otherwise. Never
+    raises and never touches a device."""
+    cal = Calibration()
+    env = os.environ.get(ENV_MEMCPY_GBPS, "")
+    if env:
+        try:
+            cal.memcpy_gbps = max(0.01, float(env))
+            cal.source = "env"
+        except ValueError:
+            pass
+    else:
+        try:
+            from ..telemetry import device_observatory as _devobs
+
+            h2d = _devobs.transfer_summary().get("h2d", {})
+            gbps = h2d.get("gb_per_s")
+            if gbps:
+                cal.memcpy_gbps = max(0.01, float(gbps))
+                cal.source = "measured"
+        except Exception:
+            pass
+    try:
+        from ..ops.backend import use_device_path
+
+        cal.device = bool(use_device_path())
+    except Exception:
+        cal.device = False
+    cal.compile_s = _DEVICE_COMPILE_S if cal.device else _HOST_COMPILE_S
+    return cal
+
+
+@dataclass
+class PlanStats:
+    """What the plan walk gathered: plan shape plus per-scan footer facts
+    from WARM cache entries only (`scan_cache.get_meta` peeks — a cold file
+    contributes its `FileStatus.size` and nothing else; the model never
+    performs I/O or parses a footer)."""
+
+    n_scans: int = 0
+    n_files: int = 0
+    warm_files: int = 0
+    file_bytes: int = 0  # compressed on-disk bytes (every file)
+    rows: int = 0  # footer row counts (warm files only)
+    decoded_bytes: int = 0  # uncompressed row-group bytes (warm files only)
+    dict_cols: int = 0  # distinct dictionary-encoded columns seen
+    dict_col_bytes: int = 0  # uncompressed bytes of those columns
+    row_shapes: Set[int] = field(default_factory=set)  # per-file row counts
+    has_agg: bool = False
+    has_join: bool = False
+    has_filter: bool = False
+
+    def est_rows(self) -> int:
+        if self.rows:
+            return self.rows
+        return max(1, self.file_bytes // _EST_ROW_BYTES)
+
+    def est_decoded_bytes(self) -> int:
+        if self.decoded_bytes:
+            return self.decoded_bytes
+        return int(self.file_bytes * _EST_DECODE_RATIO)
+
+    def fully_warm(self) -> bool:
+        return self.n_files > 0 and self.warm_files == self.n_files
+
+
+def collect_stats(phys) -> PlanStats:
+    """Walk one physical plan; peek the footer cache for every scanned file.
+    Cheap by construction: dict lookups against the already-resident scan
+    cache, no env reads, no file opens."""
+    from ..engine.scan_cache import global_scan_cache
+
+    cache = global_scan_cache()
+    st = PlanStats()
+    dict_col_names: Set[str] = set()
+    for node in phys.collect_nodes():
+        kind = type(node).__name__
+        if kind == "HashAggregateExec":
+            st.has_agg = True
+        elif kind == "SortMergeJoinExec":
+            st.has_join = True
+        elif kind == "FilterExec":
+            st.has_filter = True
+        rel = getattr(node, "relation", None)
+        if rel is None:
+            continue
+        st.n_scans += 1
+        for f in getattr(rel, "files", None) or ():
+            st.n_files += 1
+            st.file_bytes += int(getattr(f, "size", 0) or 0)
+            try:
+                meta = cache.get_meta(f.path)
+            except Exception:
+                meta = None
+            if meta is None:
+                continue
+            st.warm_files += 1
+            st.rows += int(meta.num_rows or 0)
+            st.row_shapes.add(int(meta.num_rows or 0))
+            for rg in meta.row_groups:
+                st.decoded_bytes += int(rg.total_bytes or 0)
+                for c, b in rg.col_bytes.items():
+                    if meta.dict_cols.get(c):
+                        st.dict_col_bytes += int(b or 0)
+                        dict_col_names.add(c)
+    st.dict_cols = len(dict_col_names)
+    return st
+
+
+def _pow2(n: int) -> int:
+    return 1 << max(1, int(n) - 1).bit_length()
+
+
+def _copy_s(nbytes: float, cal: Calibration) -> float:
+    return float(nbytes) / (cal.memcpy_gbps * 1e9)
+
+
+def _decode_s(nbytes: float, cal: Calibration) -> float:
+    return float(nbytes) / (cal.decode_gbps * 1e9)
+
+
+def estimate(stats: PlanStats, cal: Calibration) -> Dict[str, Tuple[object, object, float, float]]:
+    """Price both arms of every governed knob for this plan:
+    ``{knob: (model_value, alt_value, predicted_s_model, predicted_s_alt)}``.
+    model_value is the arm the model picks (bool for on/off knobs, int for
+    chunk_rows); alt_value is the single alternative the planner A/Bs it
+    against. Predictions are marginal attributable seconds — two arms with
+    equal predictions mean "this plan doesn't exercise the knob"."""
+    out: Dict[str, Tuple[object, object, float, float]] = {}
+    rows = stats.est_rows()
+    decoded = stats.est_decoded_bytes()
+
+    # streamed-vs-materialized: both arms decode the same bytes; the
+    # materialized arm additionally assembles the full concat on the host
+    # before any reduction starts (bench r05's 500 MB-materialized-then-
+    # reduced case). No aggregate in the plan -> the gate is not exercised.
+    stream_s = _decode_s(decoded, cal)
+    if stats.has_agg:
+        out["streaming"] = (True, False, round(stream_s, 9), round(stream_s + _copy_s(decoded, cal), 9))
+    else:
+        out["streaming"] = (True, False, round(stream_s, 9), round(stream_s, 9))
+
+    # chunk rows: per-chunk dispatch overhead vs working-set spill. Only
+    # re-shaped on large, fully-warm scans (cold or small stats keep the
+    # default — the conservative posture tier-1 pins).
+    chunk = _DEFAULT_CHUNK_ROWS
+    if (
+        stats.has_agg
+        and stats.fully_warm()
+        and rows >= _CHUNK_SHAPE_MIN_ROWS
+        and decoded > 0
+    ):
+        row_bytes = max(1.0, decoded / max(1, rows))
+        chunk = int(_TARGET_CHUNK_BYTES / row_bytes)
+        chunk = max(_MIN_CHUNK_ROWS, min(_DEFAULT_CHUNK_ROWS, _pow2(chunk)))
+
+    def _chunk_cost(v: int) -> float:
+        n_chunks = max(1, -(-rows // v))
+        row_bytes = max(1.0, decoded / max(1, rows))
+        spill = max(0.0, v * row_bytes - _TARGET_CHUNK_BYTES) * n_chunks
+        return n_chunks * cal.chunk_overhead_s + _copy_s(spill, cal)
+
+    out["chunk_rows"] = (
+        chunk,
+        _DEFAULT_CHUNK_ROWS,
+        round(_chunk_cost(chunk), 9),
+        round(_chunk_cost(_DEFAULT_CHUNK_ROWS), 9),
+    )
+
+    # encoded-vs-flat: codes + dictionary vs decoded value bytes for every
+    # dictionary-encoded column the footers report. No dictionary columns ->
+    # neutral (the gate costs nothing either way).
+    code_bytes = rows * stats.dict_cols * _CODE_BYTES_PER_ROW
+    out["encoded_exec"] = (
+        True,
+        False,
+        round(_copy_s(code_bytes, cal), 9),
+        round(_copy_s(max(stats.dict_col_bytes, code_bytes), cal), 9),
+    )
+
+    # packed-vs-narrow: sub-byte lanes below the int8 narrow floor. The
+    # dictionary cardinality is not in the footer, so the prior prices the
+    # 4-bit midpoint of the packed class set against one byte per code; the
+    # outcome store corrects classes where packing does not apply or the
+    # pack/unpack overhead loses.
+    narrow_bytes = rows * stats.dict_cols  # one byte per code (int8 floor)
+    out["packed_codes"] = (
+        True,
+        False,
+        round(_copy_s(narrow_bytes / 2.0, cal), 9),
+        round(_copy_s(narrow_bytes, cal), 9),
+    )
+
+    # pushdown row-group selection: zone evaluation is ~free; the win is
+    # every pruned row group's decode. Selectivity is unknown at plan time,
+    # so the prior charges the pruning arm a representative half-prune when
+    # a filter exists over warm zone maps — a prior the per-class outcome
+    # store sharpens from measurements.
+    if stats.has_filter and stats.warm_files:
+        out["pushdown"] = (
+            True,
+            False,
+            round(_decode_s(decoded * 0.5, cal), 9),
+            round(_decode_s(decoded, cal), 9),
+        )
+    else:
+        out["pushdown"] = (True, False, round(_decode_s(decoded, cal), 9), round(_decode_s(decoded, cal), 9))
+
+    # hash-quantize on/off: ON pays pow2 padded copies of every hashed batch
+    # (the pad-tax ledger's "hash_quantize" site); OFF pays one program
+    # build per distinct row shape. On the host path there are no device
+    # hash programs to recompile, so OFF is free — which is exactly the
+    # measured 45% CPU regression the device-only heuristic hid. Both arms
+    # priced; the planner's per-class outcome store settles the rest.
+    if stats.has_agg or stats.has_join:
+        hashed_rows = min(rows, chunk)
+        n_batches = max(1, -(-rows // max(1, hashed_rows)))
+        pad_excess = (_pow2(hashed_rows) - hashed_rows) * 8.0 * n_batches
+        quant_on = _copy_s(pad_excess, cal)
+        shapes = max(1, len(stats.row_shapes) or 1)
+        quant_off = (shapes * cal.compile_s) if cal.device else 0.0
+        out["hash_quantize"] = (cal.device, not cal.device, *(
+            (round(quant_on, 9), round(quant_off, 9))
+            if cal.device
+            else (round(quant_off, 9), round(quant_on, 9))
+        ))
+    else:
+        out["hash_quantize"] = (cal.device, not cal.device, 0.0, 0.0)
+
+    # join size-class count: the classed layout (<=8 pow2 capacity classes)
+    # pays per-class dispatch to avoid the global-max pad blowup under skew;
+    # skew is unknown at plan time, so the prior keeps the classed default
+    # and charges the dense arm a representative pad-risk term only when
+    # the plan actually joins.
+    if stats.has_join:
+        classed_s = 8 * cal.chunk_overhead_s
+        dense_s = _copy_s(decoded * 0.25, cal)
+        out["join_size_classes"] = (True, False, round(classed_s, 9), round(classed_s + dense_s, 9))
+    else:
+        out["join_size_classes"] = (True, False, 0.0, 0.0)
+
+    return out

@@ -59,6 +59,7 @@ FLAG_KEYS = (
     "HYPERSPACE_MESH_ROW_QUANTUM",
     "HYPERSPACE_PALLAS_PROBE",
     "HYPERSPACE_PALLAS_SORT",
+    "HYPERSPACE_PLANNER",
     "HYPERSPACE_PRED_FUSE_MAX_CLASSES",
     "HYPERSPACE_PRED_FUSE_MIN_ROWS",
     "HYPERSPACE_QUERY_CHUNK_ROWS",

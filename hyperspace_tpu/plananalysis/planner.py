@@ -1,0 +1,553 @@
+"""Adaptive cost-based planner: choose every governed knob per plan, learn
+from predicted-vs-actual, self-correct per plan class.
+
+ROADMAP item 2, closing the loop the observability PRs opened: PR 11's
+per-fingerprint baselines and PR 14's transfer/pad ledgers measure exactly
+what each knob choice costs, but the knobs themselves were static env flags
+or crude auto-gates. This module decides them at plan time:
+
+1. **Pin-vs-auto** — an env flag that is SET (non-empty) pins its knob
+   exactly as before: the planner never overrides an explicit operator
+   choice, and pinned values stay part of the fingerprint posture
+   (`fingerprint.FLAG_KEYS`). An UNSET flag hands the knob to the planner.
+2. **Model** — `costmodel.estimate` prices both arms of every governed knob
+   from footer-cache column stats + device-observatory calibration. The
+   model's priors reproduce today's defaults; it deviates only on decisive,
+   warm-stats-backed margins.
+3. **Self-correction** — each decided query feeds a per-(fingerprint, knob,
+   arm) outcome store (the PR 12 pred-fuse mint-count gate generalized):
+   when a class's measured wall drifts past `HYPERSPACE_PLANNER_DRIFT_X`
+   times the chosen arm's prediction, the planner explores the alternative
+   arm (one knob at a time — every arm is byte-identical by the standing
+   flag contracts, so exploration can never change results); once both arms
+   hold `HYPERSPACE_PLANNER_MIN_SAMPLES` measurements the better-measured
+   arm wins deterministically — recomputed from folded stats on every
+   decide, so a flip survives process restarts (the store re-folds its
+   JSONL segments from disk, exactly like the history store's baselines).
+
+**Threading**: `decide()` runs in the session right after physical planning;
+the returned `PlanDecisions` is set as an ambient contextvar AND stamped on
+the `resilience.QueryScope`, so every pool worker that adopts the scope
+(`use_scope` — the established propagation channel) sees the same decisions.
+Gates (`streaming.streaming_enabled`, `encoding.encoded_exec_enabled`,
+`pushdown.pushdown_enabled`, `packed_codes.packed_codes_enabled`,
+`ops.hashing._hash_quantize_enabled`, `ops.bucket_join.size_classes_enabled`)
+consult `decided_value(knob)` only AFTER their env flag came back unset —
+explicit flags always win, and with the planner off the whole surface is one
+contextvar read returning None (the `HYPERSPACE_PLANNER=0` zero-cost-off
+oracle, pinned by tests/test_planner.py).
+
+**Ledger loop**: decisions + per-arm predictions land on the query span and
+ledger at decide time (`accounting.set_value("planner", ...)`); at ledger
+close `annotate_close` joins predicted-vs-actual and the per-fingerprint
+history baseline onto the same dict, so history records, hsreport's planner
+drift table, and `explain(analyze=True)`'s Planner section all read one
+source of truth.
+
+**Persistence**: outcome records are JSONL segments (one per writer process,
+crash-tolerant appends) in ``HYPERSPACE_PLANNER_DIR``, defaulting to
+``<history_dir>/planner`` when ``HYPERSPACE_HISTORY=1``. Without a
+persistent home the planner stays pure-model: learning requires the same
+operator opt-in as the baselines it corrects against. Appends are bounded:
+an arm stops persisting after `_PERSIST_CAP` samples (stats saturate), so
+the store is bounded by class cardinality, not query volume.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import os
+import socket
+import threading
+import time
+import uuid
+from typing import Dict, Iterator, Optional
+
+from .costmodel import INT_KNOBS, KNOB_ENV, KNOBS
+
+ENV_PLANNER = "HYPERSPACE_PLANNER"
+ENV_PLANNER_DIR = "HYPERSPACE_PLANNER_DIR"
+ENV_MIN_SAMPLES = "HYPERSPACE_PLANNER_MIN_SAMPLES"
+ENV_DRIFT_X = "HYPERSPACE_PLANNER_DRIFT_X"
+
+_DEFAULT_MIN_SAMPLES = 4
+_DEFAULT_DRIFT_X = 1.5
+#: An arm keeps persisting outcome records until it holds this many samples;
+#: beyond it the folded stats are saturated and further appends only grow
+#: the store (bounded-by-construction — no compaction machinery needed).
+_PERSIST_CAP = 64
+#: Measured must beat measured by this margin to FLIP away from the model
+#: arm (hysteresis: a 2% timing wobble must not oscillate a class).
+_FLIP_MARGIN = 0.9
+#: Drift exploration needs a prediction of at least this much attributable
+#: cost — a 50 us prediction drowning in constant overhead is not evidence.
+_MIN_PRED_S = 0.005
+
+
+def planner_enabled() -> bool:
+    """Default ON; ``HYPERSPACE_PLANNER=0`` restores the pure env-flag
+    defaults with zero planner work anywhere past this one read."""
+    return os.environ.get(ENV_PLANNER, "") != "0"
+
+
+def _min_samples() -> int:
+    raw = os.environ.get(ENV_MIN_SAMPLES, "")
+    try:
+        return max(1, int(raw)) if raw else _DEFAULT_MIN_SAMPLES
+    except ValueError:
+        return _DEFAULT_MIN_SAMPLES
+
+
+def _drift_x() -> float:
+    raw = os.environ.get(ENV_DRIFT_X, "")
+    try:
+        return max(1.0, float(raw)) if raw else _DEFAULT_DRIFT_X
+    except ValueError:
+        return _DEFAULT_DRIFT_X
+
+
+def arm_label(value) -> str:
+    """Canonical arm name: bools -> on/off, ints -> their decimal value."""
+    if isinstance(value, bool):
+        return "on" if value else "off"
+    return str(int(value))
+
+
+class Decision:
+    """One knob's resolution for one plan: the chosen value, the single
+    alternative it is A/B'd against, both arms' predicted attributable
+    seconds, and where the choice came from — "model" (cost model),
+    "measured" (outcome-store flip), "explore" (gathering alternative-arm
+    samples after drift), or "pinned" (explicit env flag; the planner only
+    reports it, gates never consult it)."""
+
+    __slots__ = ("knob", "value", "alt", "predicted_s", "predicted_alt_s", "source")
+
+    def __init__(self, knob, value, alt, predicted_s, predicted_alt_s, source):
+        self.knob = knob
+        self.value = value
+        self.alt = alt
+        self.predicted_s = predicted_s
+        self.predicted_alt_s = predicted_alt_s
+        self.source = source
+
+    @property
+    def arm(self) -> str:
+        return arm_label(self.value)
+
+
+class PlanDecisions:
+    """The one decisions object threaded through a query's execution. Gates
+    read `value(knob)`; pinned knobs answer None there (the env flag already
+    decided at the gate — the planner must never even appear to override)."""
+
+    __slots__ = ("fingerprint", "decisions", "calibration_source")
+
+    def __init__(self, fingerprint: Optional[str], decisions: Dict[str, Decision], calibration_source: str = "default"):
+        self.fingerprint = fingerprint
+        self.decisions = decisions
+        self.calibration_source = calibration_source
+
+    def value(self, knob: str):
+        d = self.decisions.get(knob)
+        if d is None or d.source == "pinned":
+            return None
+        return d.value
+
+    def to_ledger(self) -> dict:
+        """The compact dict that rides the query ledger/span (and from there
+        history records and hsreport)."""
+        out = {}
+        for knob, d in self.decisions.items():
+            out[knob] = {
+                "arm": d.arm,
+                "alt": arm_label(d.alt),
+                "predicted_s": d.predicted_s,
+                "predicted_alt_s": d.predicted_alt_s,
+                "source": d.source,
+            }
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Ambient threading: contextvar on the deciding thread + the QueryScope slot
+# for pool workers (use_scope adoption — the use_ledger twin).
+# ---------------------------------------------------------------------------
+
+_ambient: "contextvars.ContextVar[Optional[PlanDecisions]]" = contextvars.ContextVar(
+    "hyperspace_plan_decisions", default=None
+)
+
+
+def current_decisions() -> Optional[PlanDecisions]:
+    """The ambient `PlanDecisions`, or None (planner off / nothing decided).
+    Checks this thread's contextvar first, then the adopted QueryScope —
+    zero env reads, zero stat reads either way (the hot-path contract)."""
+    pd = _ambient.get()
+    if pd is not None:
+        return pd
+    from .. import resilience as _resilience
+
+    sc = _resilience.current_scope()
+    if sc is not None:
+        return sc.plan_decisions
+    return None
+
+
+def decided_value(knob: str):
+    """What the planner decided for `knob`, or None (undecided / pinned /
+    planner off). THE gate helper: call only after the knob's own env flag
+    came back unset."""
+    pd = current_decisions()
+    if pd is None:
+        return None
+    return pd.value(knob)
+
+
+@contextlib.contextmanager
+def decisions_scope(pd: Optional[PlanDecisions]) -> Iterator[None]:
+    """Install `pd` as the ambient decisions for the duration: contextvar on
+    this thread plus the current QueryScope's slot (pool propagation)."""
+    if pd is None:
+        yield
+        return
+    from .. import resilience as _resilience
+
+    token = _ambient.set(pd)
+    sc = _resilience.current_scope()
+    prev = None
+    if sc is not None:
+        prev = sc.plan_decisions
+        sc.plan_decisions = pd
+    try:
+        yield
+    finally:
+        _ambient.reset(token)
+        if sc is not None:
+            sc.plan_decisions = prev
+
+
+# ---------------------------------------------------------------------------
+# Outcome store: per-(fingerprint, knob, arm) measured walls, JSONL-persisted
+# ---------------------------------------------------------------------------
+
+
+class _ArmStat:
+    __slots__ = ("n", "wall_sum", "pred_sum")
+
+    def __init__(self):
+        self.n = 0
+        self.wall_sum = 0.0
+        self.pred_sum = 0.0
+
+    def fold(self, wall_s: float, predicted_s: float) -> None:
+        self.n += 1
+        self.wall_sum += float(wall_s)
+        self.pred_sum += float(predicted_s)
+
+    def mean_wall(self) -> float:
+        return self.wall_sum / self.n if self.n else 0.0
+
+    def mean_pred(self) -> float:
+        return self.pred_sum / self.n if self.n else 0.0
+
+
+class OutcomeStore:
+    """Folded per-(fingerprint, knob, arm) outcome stats + the JSONL append
+    log they fold from. One segment per writer process (crash-safe append +
+    flush, torn final lines skipped on read — the history store's landing
+    idiom); re-folding every ``planner-*.jsonl`` at open is what makes a
+    learned flip survive a restart."""
+
+    def __init__(self, dir_path: str):
+        self.dir = dir_path
+        self._lock = threading.Lock()
+        self._stats: Dict[tuple, _ArmStat] = {}
+        self._fh = None
+        os.makedirs(dir_path, exist_ok=True)
+        self._load()
+
+    def _load(self) -> None:
+        from ..telemetry import history as _history
+
+        try:
+            names = sorted(os.listdir(self.dir))
+        except OSError:
+            names = []
+        for n in names:
+            if not (n.startswith("planner-") and n.endswith(".jsonl")):
+                continue
+            for rec in _history.iter_file_records(os.path.join(self.dir, n)):
+                if rec.get("kind") != "planner_outcome":
+                    continue  # forward compat: unknown kinds skip
+                fp = rec.get("fingerprint")
+                outcomes = rec.get("outcomes")
+                if not fp or not isinstance(outcomes, dict):
+                    continue
+                for knob, o in outcomes.items():
+                    if not isinstance(o, dict):
+                        continue
+                    try:
+                        self._fold(fp, knob, str(o["arm"]), float(o["wall_s"]), float(o.get("predicted_s", 0.0)))
+                    except (KeyError, TypeError, ValueError):
+                        continue
+
+    def _fold(self, fp, knob, arm, wall_s, predicted_s) -> _ArmStat:
+        st = self._stats.get((fp, knob, arm))
+        if st is None:
+            st = self._stats[(fp, knob, arm)] = _ArmStat()
+        st.fold(wall_s, predicted_s)
+        return st
+
+    def stat(self, fp: str, knob: str, arm: str) -> _ArmStat:
+        with self._lock:
+            return self._stats.get((fp, knob, arm)) or _ArmStat()
+
+    def observe(self, fp: str, outcomes: Dict[str, dict]) -> None:
+        """Fold one query's measured outcomes and append the record —
+        skipping persistence for arms already holding `_PERSIST_CAP` samples
+        (the boundedness rule)."""
+        persist = {}
+        with self._lock:
+            for knob, o in outcomes.items():
+                st = self._fold(fp, knob, o["arm"], o["wall_s"], o.get("predicted_s", 0.0))
+                if st.n <= _PERSIST_CAP:
+                    persist[knob] = o
+            if not persist:
+                return
+            rec = {
+                "schema_version": 1,
+                "kind": "planner_outcome",
+                "ts": round(time.time(), 6),
+                "fingerprint": fp,
+                "outcomes": persist,
+            }
+            try:
+                if self._fh is None:
+                    self._fh = open(
+                        os.path.join(
+                            self.dir,
+                            f"planner-{socket.gethostname()}-{os.getpid()}"
+                            f"-{uuid.uuid4().hex[:8]}.jsonl",
+                        ),
+                        "a",
+                    )
+                self._fh.write(json.dumps(rec, default=str) + "\n")
+                self._fh.flush()
+            except OSError:
+                pass  # learning must never fail the query it observed
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+
+    def summary(self) -> Dict[tuple, dict]:
+        with self._lock:
+            return {
+                key: {
+                    "n": st.n,
+                    "mean_wall_s": round(st.mean_wall(), 6),
+                    "mean_predicted_s": round(st.mean_pred(), 6),
+                }
+                for key, st in self._stats.items()
+            }
+
+
+_stores: Dict[str, OutcomeStore] = {}
+_stores_lock = threading.Lock()
+
+
+def outcome_dir() -> Optional[str]:
+    """Where outcome records persist: ``HYPERSPACE_PLANNER_DIR``, else the
+    history store's ``planner/`` sidecar when history is on, else None —
+    no persistent home means no learning (pure-model planner)."""
+    env = os.environ.get(ENV_PLANNER_DIR, "")
+    if env:
+        return env
+    from ..telemetry import history as _history
+
+    if _history.enabled():
+        return os.path.join(_history.history_dir(), "planner")
+    return None
+
+
+def _outcome_store() -> Optional[OutcomeStore]:
+    d = outcome_dir()
+    if d is None:
+        return None
+    d = os.path.abspath(d)
+    with _stores_lock:
+        st = _stores.get(d)
+        if st is None:
+            try:
+                st = _stores[d] = OutcomeStore(d)
+            except OSError:
+                return None
+        return st
+
+
+def reset() -> None:
+    """Close and forget every outcome store (tests; the history
+    `reset_stores` twin). On-disk segments survive — the next store open
+    re-folds them, which is exactly the restart contract under test."""
+    with _stores_lock:
+        for st in _stores.values():
+            st.close()
+        _stores.clear()
+
+
+# ---------------------------------------------------------------------------
+# decide / observe / annotate_close — the planner loop
+# ---------------------------------------------------------------------------
+
+
+def _pinned_value(knob: str, raw: str):
+    if knob in INT_KNOBS:
+        try:
+            return int(raw)
+        except ValueError:
+            return raw
+    return raw != "0"
+
+
+def decide(phys, fingerprint: Optional[str]) -> Optional[PlanDecisions]:
+    """Resolve every governed knob for one physical plan. None when the
+    planner is off. Never raises — a broken model must never break a query
+    (the quarantine-fallback posture)."""
+    if not planner_enabled():
+        return None
+    try:
+        return _decide(phys, fingerprint)
+    except Exception:
+        return None
+
+
+def _decide(phys, fingerprint: Optional[str]) -> PlanDecisions:
+    from . import costmodel
+
+    stats = costmodel.collect_stats(phys)
+    cal = costmodel.current_calibration()
+    est = costmodel.estimate(stats, cal)
+    store = _outcome_store()
+    min_n = _min_samples()
+    drift_x = _drift_x()
+
+    decisions: Dict[str, Decision] = {}
+    explore_claimed = False
+    for knob in KNOBS:
+        raw = os.environ.get(KNOB_ENV[knob], "")
+        model_v, alt_v, pred_m, pred_a = est.get(knob, (True, False, 0.0, 0.0))
+        if raw != "":
+            decisions[knob] = Decision(knob, _pinned_value(knob, raw), alt_v, pred_m, pred_a, "pinned")
+            continue
+        value, source = model_v, "model"
+        if store is not None and fingerprint:
+            sm = store.stat(fingerprint, knob, arm_label(model_v))
+            sa = store.stat(fingerprint, knob, arm_label(alt_v))
+            if sm.n >= min_n and sa.n >= min_n:
+                # Both arms measured: the better-measured arm wins, with
+                # hysteresis — flipping away from the model needs a margin.
+                if sa.mean_wall() < sm.mean_wall() * _FLIP_MARGIN:
+                    value, source = alt_v, "measured"
+            elif (
+                not explore_claimed
+                and sm.n >= min_n
+                and sa.n < min_n
+                and sm.mean_pred() >= _MIN_PRED_S
+                and sm.mean_wall() > drift_x * sm.mean_pred()
+            ):
+                # Predicted-vs-actual drift on the chosen arm: the model is
+                # provably mispricing this class. Gather alternative-arm
+                # samples (one exploring knob per query keeps the whole-wall
+                # A/B attribution sound).
+                value, source, explore_claimed = alt_v, "explore", True
+        decisions[knob] = Decision(knob, value, alt_v if value == model_v else model_v, pred_m if value == model_v else pred_a, pred_a if value == model_v else pred_m, source)
+
+    pd = PlanDecisions(fingerprint, decisions, cal.source)
+    _record(pd)
+    return pd
+
+
+def _record(pd: PlanDecisions) -> None:
+    """Land decisions + predictions on the ambient ledger and root span at
+    decide time (satellite: the chosen hash-quantize arm must be visible on
+    the span next to its measured wall)."""
+    try:
+        from ..telemetry import accounting, tracing
+
+        d = pd.to_ledger()
+        accounting.set_value("planner", d)
+        sp = tracing.current_span()
+        if sp is not None:
+            sp.set_attr("planner", d)
+    except Exception:
+        pass
+
+
+def observe(pd: Optional[PlanDecisions], wall_s: float) -> None:
+    """Feed one executed query's measured wall into the outcome store: the
+    whole wall lands on every non-pinned knob's chosen arm (sound per class
+    because the class — the fingerprint — holds everything else fixed, and
+    only one knob explores at a time). Called by the session with its own
+    monotonic measurement, so learning works with every telemetry sink off."""
+    if pd is None or pd.fingerprint is None:
+        return
+    try:
+        store = _outcome_store()
+        if store is None:
+            return
+        outcomes = {}
+        for knob, d in pd.decisions.items():
+            if d.source == "pinned":
+                continue
+            outcomes[knob] = {
+                "arm": d.arm,
+                "wall_s": round(float(wall_s), 6),
+                "predicted_s": d.predicted_s,
+            }
+        if outcomes:
+            store.observe(pd.fingerprint, outcomes)
+    except Exception:
+        pass
+
+
+def annotate_close(led, wall_s: float) -> None:
+    """Ledger-close join: stamp the measured wall next to the recorded
+    decisions and, when the history store holds a baseline for this class,
+    the predicted-vs-actual and vs-baseline ratios — the fields hsreport's
+    planner drift table and `explain(analyze=True)` render. Mutates the
+    ledger's "planner" dict in place (before `to_dict` snapshots it)."""
+    p = led.get("planner")
+    if not isinstance(p, dict):
+        return
+    p["actual_wall_s"] = round(float(wall_s), 6)
+    for knob, d in p.items():
+        if isinstance(d, dict) and isinstance(d.get("predicted_s"), (int, float)):
+            pred = d["predicted_s"]
+            d["drift_x"] = round(wall_s / pred, 3) if pred and pred > 0 else None
+    try:
+        from ..telemetry import history as _history
+
+        fp = led.get("plan_fingerprint")
+        if fp and _history.enabled():
+            bl = _history.get_store().baseline_for(fp)
+            if bl is not None and bl.count:
+                mean, _ = bl.mean_std()
+                p["baseline_mean_s"] = round(mean, 6)
+                p["vs_baseline_x"] = round(wall_s / mean, 3) if mean else None
+    except Exception:
+        pass
+
+
+def outcome_summary() -> Dict[tuple, dict]:
+    """Folded (fingerprint, knob, arm) stats of the active store (empty when
+    learning has no persistent home) — what tests and hsreport read."""
+    store = _outcome_store()
+    return store.summary() if store is not None else {}

@@ -116,6 +116,7 @@ class QueryScope:
         "_lock",
         "retries",
         "lane",
+        "plan_decisions",
     )
 
     def __init__(self, name: str, timeout_s: Optional[float]):
@@ -128,6 +129,11 @@ class QueryScope:
         self._lock = threading.Lock()
         self.retries = 0
         self.lane = _lane.get()
+        # The adaptive planner's PlanDecisions for the running query (None
+        # until `planner.decisions_scope` stamps it). Rides the scope for the
+        # same reason `lane` does: pool workers adopt the scope, so gates on
+        # every thread working for this query see one decisions object.
+        self.plan_decisions = None
 
     def charge_retry(self) -> int:
         with self._lock:

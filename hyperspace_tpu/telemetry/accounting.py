@@ -419,6 +419,17 @@ def ledger_scope(query_id: str, name: str, root=None) -> Iterator[QueryLedger]:
             v = led.get(field)
             if v:
                 _metrics.counter(f"accounting.{field}").inc(v)
+        # Planner predicted-vs-actual join: runs only when the adaptive
+        # planner recorded decisions on this ledger (a dict lookup when it
+        # didn't), BEFORE to_dict snapshots — so history records, spans, and
+        # hsreport all carry the annotated decisions.
+        if led.get("planner"):
+            try:
+                from ..plananalysis import planner as _planner
+
+                _planner.annotate_close(led, wall)
+            except Exception:
+                pass
         _bank_tenant(led)
         d = led.to_dict()
         if root is not None:

@@ -416,7 +416,7 @@ class TestSingleFlight:
         release.set()
         t1.join(10)
 
-    def test_selection_keys_never_alias(self, tmp_path):
+    def test_selection_keys_never_alias(self, tmp_path, monkeypatch):
         """Dedup across pushdown-selection-keyed entries: concurrent reads of
         DISTINCT row-group selections of one file both decode (no aliasing);
         concurrent reads of the SAME selection decode once."""
@@ -448,6 +448,23 @@ class TestSingleFlight:
         assert d.get("io.decode.files", 0) == 2, d  # distinct selections: no dedup
         assert d.get("serve.singleflight.dedup_hits", 0) == 0, d
 
+        # Same-selection leg: the dedup assertion needs both threads inside
+        # the flight window. A fast leader decode can finish before the
+        # follower's cache probe (the follower then takes a plain cache hit —
+        # decode.files is still 1 but no dedup is recorded), so hold the
+        # leader's decode until a follower has actually joined its flight.
+        real_read = eio._read_row_groups_one
+
+        def read_after_follower_joins(*args, **kwargs):
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                with sf._lock:
+                    if any(fl.waiters > 0 for fl in sf._flights.values()):
+                        break
+                time.sleep(0.001)
+            return real_read(*args, **kwargs)
+
+        monkeypatch.setattr(eio, "_read_row_groups_one", read_after_follower_joins)
         before = _counters()
         barrier = threading.Barrier(2)
         t3 = threading.Thread(target=read, args=((2, 3), "c"))

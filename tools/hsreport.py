@@ -141,6 +141,7 @@ def build_report(dir_path: str, top: int, recent_k: int) -> dict:
         "pad_tax": _pad_tax(baselines, top),
         "transfer_bandwidth": _transfer_bandwidth(baselines, top),
         "code_staging": _code_staging(baselines, top),
+        "planner": _planner_table(raw, dir_path, top),
     }
     return report
 
@@ -255,6 +256,68 @@ def _code_staging(baselines: Dict[str, dict], top: int) -> List[dict]:
     return rows[:top]
 
 
+def _planner_table(raw, dir_path: str, top: int) -> list:
+    """Adaptive-planner decision drift: per (fingerprint, knob, arm) — how
+    many decided queries ran under it, mean measured wall, mean predicted
+    attributable cost, and the drift ratio between them. Joined from two
+    feeds that cover each other's gaps: the ``planner`` dicts accounted
+    ledger records carry (annotated at ledger close) and the planner outcome
+    store's own sidecar segments (``<dir>/planner/*.jsonl`` — present even
+    for queries that ran without accounting). Worst drift first: the top
+    rows are the classes where the cost model most misprices reality."""
+    agg: dict = {}
+
+    def fold(fp, knob, arm, wall, pred, source):
+        st = agg.setdefault((fp, knob, str(arm)), [0, 0.0, 0.0, set()])
+        st[0] += 1
+        st[1] += float(wall or 0.0)
+        st[2] += float(pred or 0.0)
+        if source:
+            st[3].add(source)
+
+    for fp, recs in raw.items():
+        for r in recs:
+            led = r.get("ledger") or {}
+            p = led.get("planner")
+            if not isinstance(p, dict):
+                continue
+            wall = p.get("actual_wall_s") or led.get("wall_s") or 0.0
+            for knob, d in p.items():
+                if isinstance(d, dict) and "arm" in d:
+                    fold(fp, knob, d["arm"], wall, d.get("predicted_s"), d.get("source"))
+    pdir = os.path.join(dir_path, "planner")
+    if os.path.isdir(pdir):
+        for name in sorted(os.listdir(pdir)):
+            if not name.endswith(".jsonl"):
+                continue
+            for rec in _history.iter_file_records(os.path.join(pdir, name)):
+                if rec.get("kind") != "planner_outcome":
+                    continue
+                fp, outs = rec.get("fingerprint"), rec.get("outcomes")
+                if not fp or not isinstance(outs, dict):
+                    continue
+                for knob, o in outs.items():
+                    if isinstance(o, dict) and "arm" in o:
+                        fold(fp, knob, o["arm"], o.get("wall_s"), o.get("predicted_s"), "store")
+    rows = []
+    for (fp, knob, arm), (n, ws, ps, sources) in agg.items():
+        mean_w, mean_p = (ws / n, ps / n) if n else (0.0, 0.0)
+        rows.append(
+            {
+                "fingerprint": fp,
+                "knob": knob,
+                "arm": arm,
+                "n": n,
+                "mean_wall_s": round(mean_w, 6),
+                "mean_predicted_s": round(mean_p, 6),
+                "drift_x": round(mean_w / mean_p, 2) if mean_p > 0 else None,
+                "sources": sorted(sources),
+            }
+        )
+    rows.sort(key=lambda r: -(r["drift_x"] or 0.0))
+    return rows[:top]
+
+
 def _fmt_s(v: Optional[float]) -> str:
     if v is None:
         return "-"
@@ -361,6 +424,20 @@ def render(report: dict) -> str:
                 f"  {h['fingerprint']}  flat={h['code_bytes_flat']}B"
                 f" staged={h['code_bytes_staged']}B{saved_str}{packed_str}"
                 f"  [{','.join(h.get('names') or [])}]"
+            )
+    if report.get("planner"):
+        lines += [
+            "",
+            "planner decisions (per class/knob/arm — worst predicted-vs-actual drift first):",
+        ]
+        for h in report["planner"]:
+            drift = h.get("drift_x")
+            lines.append(
+                f"  {h['fingerprint']}  {h['knob']}={h['arm']}  n={h['n']}"
+                f"  wall={_fmt_s(h['mean_wall_s'])}"
+                f"  predicted={_fmt_s(h['mean_predicted_s'])}"
+                f"  drift_x={drift if drift is not None else '-'}"
+                f"  [{','.join(h.get('sources') or [])}]"
             )
     return "\n".join(lines)
 
