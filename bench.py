@@ -488,6 +488,13 @@ def run_bench(deadline: float = None) -> dict:
         # -- streamed bucketed-join→aggregate (classed probe + chunked gather)
         ph.run("join_stream", lambda: d.update(_join_stream_section(s, base, col, runs)))
 
+        # -- multiway star join: 1 fact + 3 covered dims in ONE streamed
+        #    pass vs the cascaded binary joins (cold/warm p50 + per-dim
+        #    probe/verify stage walls); gated by bench_compare --keys 'star*'
+        ph.run("star_join", lambda: d.__setitem__(
+            "star_join", _star_section(s, base, col, runs, hs)
+        ))
+
         # -- workload variants (string join / filter / data skipping / hybrid)
         ph.run("variants", lambda: d.__setitem__(
             "variants", _variant_section(s, base, col, runs, hs)
@@ -645,6 +652,116 @@ def _join_stream_section(s, base, col, runs) -> dict:
             os.environ.pop(env_key, None)
         else:
             os.environ[env_key] = saved
+    return out
+
+
+def _star_section(s, base, col, runs, hs) -> dict:
+    """Multiway star-join execution (the ISSUE-18 headline): one skewed-FK
+    fact (written as FOUR parquet parts, so the concat identity keeps the
+    per-dimension pair memos warm across queries) joined to THREE covered
+    dimensions under a grouped aggregate — measured COLD (caches + memos
+    cleared) and warm-p50 with ``HYPERSPACE_MULTIWAY`` on (one streamed
+    pass probing every dimension per fact chunk) vs off (the cascaded
+    binary joins, whose intermediate fact materializes once per extra
+    dimension). ``star_stages`` records the multiway cold run's per-
+    dimension pad/probe/verify walls and memo states (`star_dims`)."""
+    from hyperspace_tpu import IndexConfig
+    from hyperspace_tpu.engine import io as _eio
+    from hyperspace_tpu.engine.physical import clear_device_memos
+    from hyperspace_tpu.engine.scan_cache import (
+        global_bucketed_cache,
+        global_concat_cache,
+        global_scan_cache,
+    )
+    from hyperspace_tpu.engine.table import Table as _T
+    from hyperspace_tpu.hyperspace import enable_hyperspace
+    from hyperspace_tpu.telemetry.profiling import last_join_stages
+
+    n = int(os.environ.get("BENCH_STAR_ROWS", 500_000))
+    rng = np.random.RandomState(31)
+    dims = (("star_dim1", "d1", "g1", 1000), ("star_dim2", "d2", "g2", 200),
+            ("star_dim3", "d3", "g3", 50))
+    fk = {
+        "k1": rng.randint(0, 1000, n).astype(np.int64),
+        "k2": rng.randint(0, 200, n).astype(np.int64),
+        "k3": rng.randint(0, 50, n).astype(np.int64),
+        "amount": rng.randint(0, 10_000, n).astype(np.int64),
+    }
+    fk["k1"][: n // 3] = 17  # hot key: the skew the classed layout absorbs
+    fact_dir = os.path.join(base, "star_fact")
+    parts, per = 4, n // 4
+    for i in range(parts):
+        sl = {k: v[i * per: n if i == parts - 1 else (i + 1) * per]
+              for k, v in fk.items()}
+        _eio.write_parquet(
+            _T.from_pydict(sl), os.path.join(fact_dir, f"part-{i:05d}.parquet")
+        )
+    for name, key, payload, card in dims:
+        s.write_parquet(
+            {
+                key: np.arange(card, dtype=np.int64),
+                payload: rng.randint(0, 25, card).astype(np.int64),
+            },
+            os.path.join(base, name),
+        )
+        hs.create_index(
+            s.read.parquet(os.path.join(base, name)),
+            IndexConfig(f"bench_{name}", [key], [payload]),
+        )
+    enable_hyperspace(s)
+
+    def q_star():
+        f = s.read.parquet(fact_dir)
+        t = f
+        for name, key, _payload, _card in dims:
+            d = s.read.parquet(os.path.join(base, name))
+            t = t.join(d, col(f"k{name[-1]}") == col(key))
+        return t.group_by("g1").agg(
+            rev=("amount", "sum"), n=("amount", "count")
+        )
+
+    env_mw, env_stream = "HYPERSPACE_MULTIWAY", "HYPERSPACE_QUERY_STREAMING"
+    saved = {k: os.environ.get(k) for k in (env_mw, env_stream)}
+
+    def run_cold(multiway: bool) -> float:
+        clear_device_memos()
+        global_scan_cache().clear()
+        global_concat_cache().clear()
+        global_bucketed_cache().clear()
+        os.environ[env_mw] = "1" if multiway else "0"
+        t0 = _now()
+        q_star().collect()
+        return round(_now() - t0, 3)
+
+    out = {}
+    try:
+        os.environ[env_stream] = "1"
+        out["star_multiway_cold_s"] = run_cold(True)
+        out["star_stages"] = last_join_stages()
+        out["star_cascade_cold_s"] = run_cold(False)
+
+        os.environ[env_mw] = "1"
+        clear_device_memos()
+        q_star().collect()  # warm the per-dimension pair memos
+        out["star_multiway_warm_p50_s"] = round(
+            timed_p50(lambda: q_star().collect(), runs), 3
+        )
+        os.environ[env_mw] = "0"
+        clear_device_memos()
+        q_star().collect()  # warm the cascade's own pair memos
+        out["star_cascade_warm_p50_s"] = round(
+            timed_p50(lambda: q_star().collect(), runs), 3
+        )
+        if out["star_multiway_warm_p50_s"]:
+            out["star_speedup"] = round(
+                out["star_cascade_warm_p50_s"] / out["star_multiway_warm_p50_s"], 3
+            )
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
     return out
 
 

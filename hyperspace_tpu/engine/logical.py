@@ -360,6 +360,61 @@ class JoinNode(LogicalPlan):
         return f"Join {self.how} on {self.condition!r}"
 
 
+@dataclass
+class StarDimension:
+    """One dimension of a recognized star join: a self-contained covering-
+    index subplan (`plan` — an index ScanNode, possibly under a lineage
+    delete-prune FilterNode, built exactly like `JoinIndexRule.substitute`'s
+    output) plus the oriented key mapping fact→dimension and the column sets
+    the query needs from each side. `plan` is intentionally NOT a child of
+    the StarJoinNode: later rules must not rewrite it, and the cascade
+    fallback never executes it."""
+
+    plan: "LogicalPlan"
+    fact_keys: List[str]
+    dim_keys: List[str]
+    dim_required: List[str]
+    index_name: Optional[str]
+    num_buckets: int
+
+
+class StarJoinNode(LogicalPlan):
+    """N-way star join (one fact, 2+ dimensions, all inner equi-joins on
+    fact FKs) recognized by `JoinIndexRule` over a left-deep cascade of
+    binary joins. `cascade` is the UNTOUCHED cascaded plan — it is the only
+    child, so later rules (filter index, data skipping) keep rewriting it
+    exactly as they would without the wrapper, and it stays the byte-
+    identical fallback for every non-streamed consumer. The physical planner
+    re-derives the fact subplan by walking the (possibly rule-rewritten)
+    cascade's left spine; `dims` (innermost join first — the cascade's fold
+    order) carries each dimension's covering-index subplan. Output schema
+    and row semantics are exactly the cascade's."""
+
+    def __init__(
+        self,
+        cascade: LogicalPlan,
+        dims: Sequence[StarDimension],
+        fact_required: Sequence[str],
+    ):
+        self.cascade = cascade
+        self.dims = list(dims)
+        self.fact_required = list(fact_required)
+
+    def children(self):
+        return (self.cascade,)
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.cascade.output_schema
+
+    def with_children(self, children):
+        return StarJoinNode(children[0], self.dims, self.fact_required)
+
+    def simple_string(self):
+        names = ", ".join(d.index_name or "?" for d in self.dims)
+        return f"StarJoin ({len(self.dims)} dims: {names})"
+
+
 def infer_expr_dtype(e: Expr, schema: Schema) -> str:
     """Static result type of an expression against a schema (comparisons/boolean/
     null-tests → bool; '/' → float64; +,-,* promote numerically; bare columns and

@@ -48,6 +48,7 @@ from .logical import (
     ProjectNode,
     ScanNode,
     SourceRelation,
+    StarJoinNode,
     UnionNode,
     WithColumnNode,
 )
@@ -1235,6 +1236,9 @@ class HashAggregateExec(PhysicalNode):
     def execute(self, ctx) -> Table:
         from ..ops.aggregate import hash_aggregate
 
+        out = self._try_stream_star_agg(ctx)
+        if out is not None:
+            return out
         out = self._try_fused_join_agg(ctx)
         if out is not None:
             return out
@@ -1245,6 +1249,42 @@ class HashAggregateExec(PhysicalNode):
         if out is not None:
             return out
         return hash_aggregate(self.child.execute(ctx), self.group_keys, self.aggs)
+
+    def _try_stream_star_agg(self, ctx) -> Optional[Table]:
+        """Streamed multiway star-join→aggregate: when this aggregate sits on
+        a chain of WithColumn/Project operators over a recognized
+        `MultiwayJoinExec`, every dimension's covering index is probed per
+        fact chunk and survivor compositions fold straight into the
+        chunk-carry `StreamAggregator` — the intermediate fact of the
+        cascaded plan never materializes (`engine.streaming.
+        stream_star_aggregate`). Returns None whenever the shape doesn't
+        apply or the multiway/streaming gates are off — the MultiwayJoinExec
+        then executes its byte-identical cascade. Shape problems fall back;
+        execution errors propagate (and leave no partial pair memo)."""
+        from ..ops.aggregate import streaming_agg_supported
+        from ..ops.bucket_join import size_classes_enabled
+        from .streaming import (
+            multiway_enabled,
+            stream_star_aggregate,
+            streaming_enabled,
+        )
+
+        if not multiway_enabled():
+            return None
+        if not streaming_enabled() or not size_classes_enabled():
+            return None
+        if not self.group_keys or not streaming_agg_supported(
+            self.group_keys, self.aggs
+        ):
+            return None
+        chain: List[PhysicalNode] = []
+        node = self.child
+        while isinstance(node, (WithColumnExec, ProjectExec)):
+            chain.append(node)
+            node = node.child
+        if not isinstance(node, MultiwayJoinExec):
+            return None
+        return stream_star_aggregate(self, node, chain, ctx)
 
     def _try_stream_join_agg(self, ctx) -> Optional[Table]:
         """Streamed bucketed-join→aggregate: when this aggregate sits on a
@@ -2922,6 +2962,43 @@ class SortMergeJoinExec(PhysicalNode):
         return f"SortMergeJoin{how} [{pairs}]{mode}"
 
 
+class MultiwayJoinExec(PhysicalNode):
+    """N-way star join (one fact, 2+ dimensions, all inner equi-joins on fact
+    FKs) planned from a recognized `StarJoinNode`. Carries BOTH executions:
+    `cascade` is the fully-planned cascaded binary-join tree, and
+    `execute`/`execute_count` delegate to it — so any consumer that is not
+    the streamed star→aggregate path (materializing queries, counts, the
+    `HYPERSPACE_MULTIWAY` runtime gate off, planner picking the cascade arm)
+    gets byte-identical cascaded results with no extra machinery. The
+    streamed path (`streaming.stream_star_aggregate`, entered from
+    `HashAggregateExec`) is the only consumer of `fact` and `dims`: per fact
+    chunk it probes every dimension's covering index and folds straight into
+    the aggregator, never materializing the intermediate fact."""
+
+    name = "MultiwayJoin"
+
+    def __init__(self, fact: PhysicalNode, dims, cascade: PhysicalNode):
+        self.fact = fact
+        # One (dim_exec, fact_keys, dim_keys, index_name, num_buckets) per
+        # dimension, innermost join first — the cascade's fold order, which
+        # fixes output column naming and the odometer's digit order.
+        self.dims = list(dims)
+        self.cascade = cascade
+
+    def children(self):
+        return (self.fact,) + tuple(d[0] for d in self.dims) + (self.cascade,)
+
+    def execute(self, ctx) -> Table:
+        return self.cascade.execute(ctx)
+
+    def execute_count(self, ctx) -> int:
+        return self.cascade.execute_count(ctx)
+
+    def simple_string(self):
+        names = ", ".join(d[3] or "?" for d in self.dims)
+        return f"MultiwayJoin ({len(self.dims)} dims: {names})"
+
+
 # ---------------------------------------------------------------------------
 # Planner: logical → physical
 # ---------------------------------------------------------------------------
@@ -3177,5 +3254,49 @@ def plan_physical(
         lside = SortExec(lkeys, ShuffleExchangeExec(lkeys, lphys))
         rside = SortExec(rkeys, ShuffleExchangeExec(rkeys, rphys))
         return SortMergeJoinExec(lside, rside, lkeys, rkeys, bucketed=False, how=how)
+
+    if isinstance(logical, StarJoinNode):
+        # The cascade is planned exactly as if the wrapper did not exist —
+        # it is the byte-identical execution for every non-streamed consumer
+        # and the fallback whenever the star side plan cannot be completed.
+        cascade = plan_physical(logical.cascade, required, case_sensitive)
+        try:
+            chain: List[JoinNode] = []
+            cur: LogicalPlan = logical.cascade
+            while isinstance(cur, JoinNode):
+                chain.append(cur)
+                cur = cur.left
+            if len(chain) != len(logical.dims) or any(
+                j.how != "inner" for j in chain
+            ):
+                return cascade
+            fact = plan_physical(
+                cur, list(logical.fact_required), case_sensitive
+            )
+            dims = []
+            for d in logical.dims:
+                dim_phys = plan_physical(
+                    d.plan, list(d.dim_required), case_sensitive
+                )
+                probe = dim_phys
+                if isinstance(probe, FilterExec):
+                    probe = probe.child
+                if not isinstance(probe, BucketedIndexScanExec):
+                    # The dimension's covering index lost its bucketed scan
+                    # shape (e.g. a later rule rewrote it): the per-bucket
+                    # probe has no layout to work with — run the cascade.
+                    return cascade
+                dims.append(
+                    (
+                        dim_phys,
+                        list(d.fact_keys),
+                        list(d.dim_keys),
+                        d.index_name,
+                        int(d.num_buckets),
+                    )
+                )
+            return MultiwayJoinExec(fact, dims, cascade)
+        except HyperspaceException:
+            return cascade
 
     raise HyperspaceException(f"Cannot plan logical node: {logical.simple_string()}")

@@ -193,12 +193,19 @@ class DataFrame:
             decisions = _planner.decide(phys, fp)
             try:
                 with _planner.decisions_scope(decisions):
+                    pr0 = _planner.prune_counters()
                     t0 = _time.monotonic()
                     out = runner(phys)
                 # Feed the measured wall back (outcome store; no-op without a
                 # persistent home) — only on success: a quarantine retry's
-                # partial wall would poison the arm stats.
-                _planner.observe(decisions, _time.monotonic() - t0)
+                # partial wall would poison the arm stats. The row-group
+                # pruning counter delta rides along so the class's pushdown
+                # selectivity prior is learned, not guessed.
+                _planner.observe(
+                    decisions,
+                    _time.monotonic() - t0,
+                    pruning=_planner.prune_counters(pr0) if pr0 is not None else None,
+                )
                 return out
             except CorruptIndexError as e:
                 if not quarantine.mark(e.index_name, reason=str(e), path=e.path):

@@ -63,6 +63,24 @@ _DEFAULT_QUERY_CHUNK_ROWS = 4_000_000
 #: every payload column of both sides for its pair slice.
 ENV_JOIN_CHUNK_ROWS = "HYPERSPACE_JOIN_CHUNK_ROWS"
 _DEFAULT_JOIN_CHUNK_ROWS = 2_000_000
+#: Multiway star-join gate: ``HYPERSPACE_MULTIWAY=0`` keeps recognized star
+#: joins on the cascaded binary execution byte-for-byte (recognition itself
+#: is also suppressed at rule time, so the plan class does not change).
+ENV_MULTIWAY = "HYPERSPACE_MULTIWAY"
+
+
+def multiway_enabled() -> bool:
+    """Default ON; ``HYPERSPACE_MULTIWAY=0`` is the cascaded fallback
+    (preserves pre-star execution exactly). Unset hands the knob to the
+    adaptive planner when one decided this query — an explicit flag always
+    wins (`docs/planner.md`)."""
+    raw = os.environ.get(ENV_MULTIWAY, "")
+    if raw != "":
+        return raw != "0"
+    from ..plananalysis.planner import decided_value
+
+    decided = decided_value("multiway")
+    return True if decided is None else bool(decided)
 
 
 def streaming_enabled() -> bool:
@@ -215,20 +233,15 @@ def stream_aggregate(agg_exec, ctx) -> Optional[Table]:
 # ---------------------------------------------------------------------------
 
 
-def _resolve_source_columns(left: Table, right: Table, chain, names):
-    """Resolve aggregate names over the join's output naming (left wins the
-    unsuffixed name; colliding right columns answer to `<name>_r`, exactly
-    `_assemble_join`'s rule) to SOURCE Column objects. None when any name is
-    shadowed by a withColumn in the chain (computed — no source column) or
-    does not resolve uniquely."""
+def _resolve_named_columns(out_names, chain, names):
+    """Resolve aggregate names over a join output's name→Column mapping to
+    SOURCE Column objects. None when any name is shadowed by a withColumn in
+    the chain (computed — no source column) or does not resolve uniquely."""
     from .physical import WithColumnExec
 
     shadowed = {
         op.col_name.lower() for op in chain if isinstance(op, WithColumnExec)
     }
-    out_names = dict(left.columns)
-    for n, c in right.columns.items():
-        out_names[n if n not in out_names else f"{n}_r"] = c
     cols = []
     for name in names:
         if name.lower() in shadowed:
@@ -241,6 +254,31 @@ def _resolve_source_columns(left: Table, right: Table, chain, names):
             c = out_names[ci[0]]
         cols.append(c)
     return cols
+
+
+def _resolve_source_columns(left: Table, right: Table, chain, names):
+    """Resolve aggregate names over the join's output naming (left wins the
+    unsuffixed name; colliding right columns answer to `<name>_r`, exactly
+    `_assemble_join`'s rule) to SOURCE Column objects."""
+    out_names = dict(left.columns)
+    for n, c in right.columns.items():
+        out_names[n if n not in out_names else f"{n}_r"] = c
+    return _resolve_named_columns(out_names, chain, names)
+
+
+def star_output_columns(fact: Table, dim_tables):
+    """Column-name → source Column mapping of a star join's output: the
+    cascade applies `_assemble_join`'s naming fold-wise (the left side of
+    join k is the fact already joined with dims 0..k-1), so a colliding name
+    takes `<name>_r` — and a THIRD table colliding on the same name
+    OVERWRITES the existing `_r` entry, exactly as the cascaded execution
+    does. The streamed star path must replicate that quirk verbatim to stay
+    byte-identical."""
+    out_names = dict(fact.columns)
+    for dt in dim_tables:
+        for n, c in dt.columns.items():
+            out_names[n if n not in out_names else f"{n}_r"] = c
+    return out_names
 
 
 def _agg_input_dtype(name: str, left: Table, right: Table, chain):
@@ -478,6 +516,315 @@ def stream_join_aggregate(agg_exec, join_exec, chain, ctx) -> Optional[Table]:
             "classes": None if plan is None else len(plan.segments),
             "outliers": None if plan is None else int(len(plan.outlier_ids)),
             "join_mode": None if plan is None else plan.mode,
+        }
+    )
+    record_join_stages(summary)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Streamed multiway star-join → aggregate: probe EVERY dimension's covering
+# index per fact chunk and fold survivor compositions straight into the
+# aggregator — the cascaded plan's intermediate fact never materializes
+# ---------------------------------------------------------------------------
+
+
+def _star_agg_input_dtype(name: str, out_names, chain):
+    """Declared dtype of one aggregate input over the star output: the
+    shadowing withColumn's DECLARED dtype when the chain computes it, else
+    the source column's dtype; None when unresolvable."""
+    from .physical import WithColumnExec
+
+    for op in chain:
+        if isinstance(op, WithColumnExec) and op.col_name.lower() == name.lower():
+            return op.dtype
+    cols = _resolve_named_columns(out_names, (), [name])
+    return cols[0].dtype if cols is not None else None
+
+
+def _star_float_fold_free(agg_exec, out_names, chain) -> bool:
+    """Star twin of `_float_fold_free`: every sum/avg input provably
+    non-float — integer partial states accumulate exactly, so the chunked
+    fold equals the one-pass fold bitwise regardless of chunk boundaries.
+    Float sums stream only through the direct-cells hint (same admission and
+    same documented rounding contract as the binary streamed join)."""
+    for _out, fn, cname in agg_exec.aggs:
+        if fn in ("sum", "avg") and cname is not None:
+            dtype = _star_agg_input_dtype(cname, out_names, chain)
+            if dtype is None or dtype in ("float32", "float64"):
+                return False
+    return True
+
+
+def stream_star_aggregate(agg_exec, star_exec, chain, ctx) -> Optional[Table]:
+    """Run a `HashAggregateExec` over a recognized N-way star join as a
+    chunk-carry stream. Per DIMENSION (once, up front): hash the fact's FK
+    columns into that dimension's bucket space, lay the fact out in bucket
+    order on the fly (`bucket_join.fact_bucket_layout`), build the joint
+    size-classed plan against the dimension's covering-index concat
+    (`build_classed_plan` — padding classes and outlier handling intact),
+    probe, expand and exactly verify — yielding the dimension's match list
+    in fact-major order. Per FACT CHUNK: compose every dimension's match
+    counts into the output row count (the product), enumerate compositions
+    with a per-row odometer, gather fact + all dimension payloads, evaluate
+    the WithColumn/Project chain, and fold into `StreamAggregator` — with
+    the direct-address cells fast path when the source group keys qualify.
+    The intermediate fact of the cascaded plan never materializes.
+
+    Per-dimension verified matches ride the engine pair memos keyed
+    ``("star",) + pair_subkey`` — inserted ONLY after every chunk streamed
+    successfully, so a mid-stream fault caches nothing partial. Returns None
+    when the shape doesn't apply (the caller falls through and the
+    `MultiwayJoinExec` executes its byte-identical cascade)."""
+    import time
+
+    import numpy as np
+
+    from ..exceptions import HyperspaceException
+    from ..ops import bucket_join as bj
+    from ..ops.aggregate import StreamAggregator, _empty_result, direct_stream_hint
+    from ..ops.backend import use_device_path
+    from ..ops.hashing import bucket_id
+    from ..telemetry.profiling import StageTimings, record_join_stages
+    from . import physical as phys
+    from .encoded_device import stage_codes
+
+    try:
+        fact = star_exec.fact.execute(ctx)
+        dim_sides = []
+        for dim_exec, fkeys, dkeys, index_name, num_buckets in star_exec.dims:
+            dt, d_starts = dim_exec.execute_concat(ctx)
+            dim_sides.append(
+                (dim_exec, fkeys, dkeys, index_name, num_buckets, dt, d_starts)
+            )
+    except HyperspaceException:
+        return None
+    if fact.num_rows == 0 or any(s[5].num_rows == 0 for s in dim_sides):
+        return None  # the cascaded fallback is trivially cheap here
+    total_rows = fact.num_rows + sum(s[5].num_rows for s in dim_sides)
+    if ctx.session is not None and ctx.session.mesh_for(total_rows) is not None:
+        return None  # the sharded probe owns mesh-scale execution
+
+    group_keys = agg_exec.group_keys
+    out_names = star_output_columns(fact, [s[5] for s in dim_sides])
+    src_keys = _resolve_named_columns(out_names, chain, group_keys)
+    hint = (
+        direct_stream_hint(src_keys, agg_exec.aggs) if src_keys is not None else None
+    )
+    if hint is None and not _star_float_fold_free(agg_exec, out_names, chain):
+        # Same admission as the binary streamed join: without the
+        # direct-cells hint, a float fold through the record-merge carry
+        # would reorder — those shapes stay on the cascade (byte-identical).
+        return None
+
+    stages = StageTimings(mode="star-stream")
+    n_fact = fact.num_rows
+    per_dim = []  # (dim_table, counts, match_starts, ri_fact_major) per dim
+    dim_stats: List[dict] = []
+    memo_todo: List[tuple] = []
+
+    for dim_exec, fkeys, dkeys, index_name, num_buckets, dt, d_starts in dim_sides:
+        t0 = time.monotonic()
+        subkey = ("star",) + phys._pair_subkey(
+            list(fkeys), list(dkeys), star_exec.fact, dim_exec, fact, dt
+        )
+        rows_key = phys._pair_rows_key(star_exec.fact, dim_exec, ctx)
+        hit, cached = phys._peek_two_table("pairs", fact, dt, subkey, rows_key)
+        if hit:
+            li, ri = cached
+            stat = {
+                "index": index_name,
+                "buckets": int(num_buckets),
+                "pairs": int(len(li)),
+                "memo": "hit",
+            }
+        else:
+            with stages.timed("pad"):
+                # The fact was never bucket-partitioned on THIS dimension's
+                # keys: hash its FK columns into the dimension's bucket
+                # space (the exact build-time hash — narrow string codes
+                # hash via dh_table[codes], so values agree) and lay it out
+                # in bucket order on the fly.
+                fk_cols = [fact.column(k) for k in fkeys]
+                bid = np.asarray(
+                    bucket_id(
+                        fk_cols,
+                        [stage_codes(c, "star_probe") for c in fk_cols],
+                        num_buckets,
+                    )
+                )
+                perm, f_starts = bj.fact_bucket_layout(bid, num_buckets)
+                l_flags, r_flags = phys._joint_float_flags(
+                    fact, dt, list(fkeys), list(dkeys)
+                )
+                l_vals = np.asarray(
+                    phys._table_key64(fact, list(fkeys), l_flags)
+                )[perm]
+                r_vals = np.asarray(phys._table_key64(dt, list(dkeys), r_flags))
+                plan = bj.build_classed_plan(
+                    l_vals,
+                    r_vals,
+                    f_starts,
+                    np.asarray(d_starts, np.int64),
+                    "hash",
+                    device=use_device_path(),
+                )
+            pad_s = time.monotonic() - t0
+            t1 = time.monotonic()
+            with stages.timed("probe"):
+                ranges = bj.probe_classed(plan)
+            with stages.timed("expand"):
+                pli, ri = bj.classed_pairs(plan, ranges)
+            li = perm[pli]  # bucket-layout positions → original fact rows
+            probe_s = time.monotonic() - t1
+            t2 = time.monotonic()
+            with stages.timed("verify"):
+                li, ri = phys._verify_pairs(
+                    fact, dt, list(fkeys), list(dkeys), li, ri
+                )
+            # Fact-major order (stable: within one fact row, matches keep
+            # the deterministic bucket-major probe order) — the layout the
+            # per-chunk odometer composes from.
+            order = np.argsort(li, kind="stable")
+            li, ri = li[order], ri[order]
+            verify_s = time.monotonic() - t2
+            memo_todo.append((dt, subkey, rows_key, li, ri))
+            stat = {
+                "index": index_name,
+                "buckets": int(num_buckets),
+                "pairs": int(len(li)),
+                "memo": "miss",
+                "pad_s": round(pad_s, 5),
+                "probe_s": round(probe_s, 5),
+                "verify_s": round(verify_s, 5),
+            }
+        counts = np.bincount(li, minlength=n_fact).astype(np.int64)
+        mstarts = np.zeros(n_fact + 1, np.int64)
+        np.cumsum(counts, out=mstarts[1:])
+        per_dim.append((dt, counts, mstarts, ri))
+        dim_stats.append(stat)
+
+    ndims = len(per_dim)
+    # Output rows per fact row = the product of its per-dimension match
+    # counts (the star's survivor composition); chunk boundaries slice FACT
+    # rows so each chunk's output stays near the join chunk budget.
+    K = per_dim[0][1].copy()
+    for _dt, counts, _ms, _ri in per_dim[1:]:
+        K = K * counts
+    out_starts = np.zeros(n_fact + 1, np.int64)
+    np.cumsum(K, out=out_starts[1:])
+    total_pairs = int(out_starts[-1])
+    chunk_rows = join_chunk_rows()
+    bounds = [0]
+    while bounds[-1] < n_fact:
+        lo = bounds[-1]
+        hi = (
+            int(
+                np.searchsorted(
+                    out_starts, out_starts[lo] + chunk_rows, side="right"
+                )
+            )
+            - 1
+        )
+        bounds.append(min(max(hi, lo + 1), n_fact))
+
+    agg = StreamAggregator(
+        group_keys, agg_exec.aggs, stages=stages, direct_hint=hint
+    )
+
+    def build_chunk(lo: int, hi: int) -> Table:
+        from .physical import WithColumnExec
+
+        Kc = K[lo:hi]
+        nz = np.nonzero(Kc)[0]
+        rows = nz + lo
+        Kr = Kc[nz]
+        tot = int(Kr.sum())
+        with stages.timed("gather"):
+            if tot == 0:
+                fact_idx = np.empty(0, np.int64)
+                sels = [np.empty(0, np.int64)] * ndims
+            else:
+                fact_idx = np.repeat(rows, Kr)
+                ends = np.cumsum(Kr)
+                off = np.arange(tot, dtype=np.int64) - np.repeat(ends - Kr, Kr)
+                # Per-row odometer over the dimensions (last dim varies
+                # fastest): composition j of fact row i selects match
+                # (j // stride_d) % count_d from each dimension's list.
+                strides: List = [None] * ndims
+                stride = np.ones(len(rows), np.int64)
+                for d in range(ndims - 1, -1, -1):
+                    strides[d] = stride
+                    stride = stride * per_dim[d][1][rows]
+                sels = []
+                for d in range(ndims):
+                    st_e = np.repeat(strides[d], Kr)
+                    cnt_e = np.repeat(per_dim[d][1][rows], Kr)
+                    sels.append((off // st_e) % cnt_e)
+            parts = [fact.take(fact_idx)]
+            for d in range(ndims):
+                dt_d, _counts, mstarts_d, ri_d = per_dim[d]
+                if tot == 0:
+                    dim_idx = np.empty(0, np.int64)
+                else:
+                    dim_idx = ri_d[np.repeat(mstarts_d[rows], Kr) + sels[d]]
+                parts.append(dt_d.take(dim_idx))
+        with stages.timed("eval"):
+            cols = {}
+            for p in parts:
+                for n, c in p.columns.items():
+                    cols[n if n not in cols else f"{n}_r"] = c
+            t = Table(cols)
+            for op in reversed(chain):  # innermost (closest to the join) first
+                t = (
+                    op._apply(t)
+                    if isinstance(op, WithColumnExec)
+                    else t.select(op.column_names)
+                )
+        return t
+
+    from .. import resilience
+
+    template: Optional[Table] = None
+    none_idx = np.empty(0, np.int64)
+    n_chunks = 0
+    for lo, hi in zip(bounds, bounds[1:]):
+        # Chunk-boundary cancellation: a mid-stream deadline (like a
+        # mid-stream fault) propagates cleanly — the memos below are
+        # populated only after EVERY chunk streamed successfully.
+        resilience.check_deadline("query.star_stream")
+        t = build_chunk(lo, hi)
+        if template is None:
+            template = t.take(none_idx)
+        n_chunks += 1
+        agg.add_chunk(t)
+
+    # EVERY chunk streamed successfully: NOW (and only now) populate the
+    # per-dimension pair memos, so warm star queries start at composition.
+    for dt_m, subkey, rows_key, li_v, ri_v in memo_todo:
+        phys._cached_two_table(
+            "pairs",
+            fact,
+            dt_m,
+            subkey,
+            lambda li_v=li_v, ri_v=ri_v: (li_v, ri_v),
+            rows_key=rows_key,
+        )
+
+    out = agg.finalize()
+    if out is None:
+        if template is None:
+            return None
+        out = _empty_result(template, group_keys, agg_exec.aggs)
+    summary = stages.summary()
+    summary.update(
+        {
+            "chunks": n_chunks,
+            "pairs": total_pairs,
+            "groups": out.num_rows,
+            "direct_cells": hint is not None,
+            "join_mode": "star",
+            "star_dims": dim_stats,
         }
     )
     record_join_stages(summary)

@@ -869,6 +869,25 @@ def _build_side(
     return _ClassSide(keys, lens, order, gstarts, cap)
 
 
+def fact_bucket_layout(
+    bucket_ids: np.ndarray, num_buckets: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Bucket-order layout of a PROBE-side table for one star dimension:
+    `perm` stably reorders rows into ascending-bucket order and `starts`
+    (length num_buckets+1) delimits each bucket's slice — the same
+    (bucket-ordered rows, starts) contract `build_classed_plan` expects of a
+    bucketed index concat, computed on the fly for a fact table that was
+    never bucket-partitioned on this dimension's keys. Stability keeps the
+    within-bucket order deterministic (table order), so repeated probes and
+    the pair memos agree."""
+    bid = np.asarray(bucket_ids, np.int64)
+    perm = np.argsort(bid, kind="stable")
+    counts = np.bincount(bid, minlength=num_buckets)
+    starts = np.zeros(num_buckets + 1, np.int64)
+    np.cumsum(counts, out=starts[1:])
+    return perm, starts
+
+
 def build_classed_plan(
     l_vals: np.ndarray,
     r_vals: np.ndarray,

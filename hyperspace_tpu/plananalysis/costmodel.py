@@ -47,6 +47,7 @@ KNOBS = (
     "packed_codes",
     "pushdown",
     "join_size_classes",
+    "multiway",
 )
 
 #: knob -> the env flag that PINS it (set flag = pinned, unset = planner).
@@ -58,6 +59,7 @@ KNOB_ENV = {
     "packed_codes": "HYPERSPACE_PACKED_CODES",
     "pushdown": "HYPERSPACE_SCAN_PUSHDOWN",
     "join_size_classes": "HYPERSPACE_JOIN_SIZE_CLASSES",
+    "multiway": "HYPERSPACE_MULTIWAY",
 }
 
 INT_KNOBS = ("chunk_rows",)
@@ -151,6 +153,7 @@ class PlanStats:
     has_agg: bool = False
     has_join: bool = False
     has_filter: bool = False
+    star_dims: int = 0  # dimension count of the plan's MultiwayJoinExec (0 = none)
 
     def est_rows(self) -> int:
         if self.rows:
@@ -175,17 +178,27 @@ def collect_stats(phys) -> PlanStats:
     cache = global_scan_cache()
     st = PlanStats()
     dict_col_names: Set[str] = set()
+    seen_rels: Set[int] = set()
     for node in phys.collect_nodes():
         kind = type(node).__name__
         if kind == "HashAggregateExec":
             st.has_agg = True
         elif kind == "SortMergeJoinExec":
             st.has_join = True
+        elif kind == "MultiwayJoinExec":
+            st.has_join = True
+            st.star_dims = max(st.star_dims, len(node.dims))
         elif kind == "FilterExec":
             st.has_filter = True
         rel = getattr(node, "relation", None)
         if rel is None:
             continue
+        # A MultiwayJoinExec carries its fallback cascade as a child, so the
+        # same relation object reaches this walk twice (star scan + cascade
+        # scan). Counting it once keeps byte totals honest.
+        if id(rel) in seen_rels:
+            continue
+        seen_rels.add(id(rel))
         st.n_scans += 1
         for f in getattr(rel, "files", None) or ():
             st.n_files += 1
@@ -221,13 +234,22 @@ def _decode_s(nbytes: float, cal: Calibration) -> float:
     return float(nbytes) / (cal.decode_gbps * 1e9)
 
 
-def estimate(stats: PlanStats, cal: Calibration) -> Dict[str, Tuple[object, object, float, float]]:
+def estimate(
+    stats: PlanStats,
+    cal: Calibration,
+    prune_selectivity: Optional[float] = None,
+) -> Dict[str, Tuple[object, object, float, float]]:
     """Price both arms of every governed knob for this plan:
     ``{knob: (model_value, alt_value, predicted_s_model, predicted_s_alt)}``.
     model_value is the arm the model picks (bool for on/off knobs, int for
     chunk_rows); alt_value is the single alternative the planner A/Bs it
     against. Predictions are marginal attributable seconds — two arms with
-    equal predictions mean "this plan doesn't exercise the knob"."""
+    equal predictions mean "this plan doesn't exercise the knob".
+
+    `prune_selectivity` is the per-class learned fraction of row groups the
+    pushdown gate actually scanned (scanned / (scanned + skipped), from the
+    outcome store's recorded ``io.pruning`` counters); None keeps the static
+    half-prune prior."""
     out: Dict[str, Tuple[object, object, float, float]] = {}
     rows = stats.est_rows()
     decoded = stats.est_decoded_bytes()
@@ -294,15 +316,22 @@ def estimate(stats: PlanStats, cal: Calibration) -> Dict[str, Tuple[object, obje
     )
 
     # pushdown row-group selection: zone evaluation is ~free; the win is
-    # every pruned row group's decode. Selectivity is unknown at plan time,
-    # so the prior charges the pruning arm a representative half-prune when
-    # a filter exists over warm zone maps — a prior the per-class outcome
-    # store sharpens from measurements.
+    # every pruned row group's decode. Selectivity starts as a half-prune
+    # prior when a filter exists over warm zone maps; once this class has
+    # recorded ``io.pruning`` counters, the measured scanned fraction
+    # replaces the guess (satellite of the multiway PR: learned priors over
+    # static ones wherever the engine already counts the truth).
     if stats.has_filter and stats.warm_files:
+        sel = 0.5
+        if prune_selectivity is not None:
+            try:
+                sel = min(1.0, max(0.0, float(prune_selectivity)))
+            except (TypeError, ValueError):
+                sel = 0.5
         out["pushdown"] = (
             True,
             False,
-            round(_decode_s(decoded * 0.5, cal), 9),
+            round(_decode_s(decoded * sel, cal), 9),
             round(_decode_s(decoded, cal), 9),
         )
     else:
@@ -340,5 +369,24 @@ def estimate(stats: PlanStats, cal: Calibration) -> Dict[str, Tuple[object, obje
         out["join_size_classes"] = (True, False, round(classed_s, 9), round(classed_s + dense_s, 9))
     else:
         out["join_size_classes"] = (True, False, 0.0, 0.0)
+
+    # multiway star execution vs cascaded binary joins: the cascade
+    # materializes an intermediate fact table per extra dimension (rows x
+    # row-width copied once per non-final join — the exact bytes the star
+    # pass never assembles); the star arm instead pays one 8-byte key64
+    # probe per row per dimension. Plans without a recognized star shape
+    # leave the knob neutral (nothing to trade).
+    if stats.star_dims >= 2:
+        row_bytes = max(1.0, decoded / max(1, rows))
+        inter_bytes = rows * row_bytes * (stats.star_dims - 1)
+        probe_bytes = rows * 8.0 * stats.star_dims
+        out["multiway"] = (
+            True,
+            False,
+            round(_copy_s(probe_bytes, cal), 9),
+            round(_copy_s(inter_bytes, cal), 9),
+        )
+    else:
+        out["multiway"] = (True, False, 0.0, 0.0)
 
     return out

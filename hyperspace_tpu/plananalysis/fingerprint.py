@@ -57,6 +57,7 @@ FLAG_KEYS = (
     "HYPERSPACE_JOIN_OUTLIER_FACTOR",
     "HYPERSPACE_JOIN_SIZE_CLASSES",
     "HYPERSPACE_MESH_ROW_QUANTUM",
+    "HYPERSPACE_MULTIWAY",
     "HYPERSPACE_PALLAS_PROBE",
     "HYPERSPACE_PALLAS_SORT",
     "HYPERSPACE_PLANNER",
@@ -157,6 +158,15 @@ def node_signature(node) -> list:
     elif isinstance(node, _phys.SortMergeJoinExec):
         sig.append([node.how, bool(node.bucketed),
                     list(node.left_keys), list(node.right_keys)])
+    elif isinstance(node, _phys.MultiwayJoinExec):
+        # Each star SHAPE is its own class: the dimension count, key
+        # mappings, and covering indexes (name + bucket count) all change
+        # what executes. The cascade child recurses below, so the fallback
+        # structure is part of the class too.
+        sig.append(["star", [
+            [list(fk), list(dk), name, int(nb)]
+            for _exec, fk, dk, name, nb in node.dims
+        ]])
     elif isinstance(node, (_phys.SortExec, _phys.ShuffleExchangeExec)):
         sig.append(list(getattr(node, "keys", ())))
     elif isinstance(node, _phys.OrderByExec):

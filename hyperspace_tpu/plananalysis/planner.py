@@ -259,12 +259,18 @@ class OutcomeStore:
     log they fold from. One segment per writer process (crash-safe append +
     flush, torn final lines skipped on read — the history store's landing
     idiom); re-folding every ``planner-*.jsonl`` at open is what makes a
-    learned flip survive a restart."""
+    learned flip survive a restart.
+
+    Besides arm walls, the store folds each class's observed ``io.pruning``
+    row-group counters (`[scanned, skipped]` per fingerprint) so the cost
+    model's pushdown arm prices this class's MEASURED prune selectivity
+    instead of the static half-prune prior."""
 
     def __init__(self, dir_path: str):
         self.dir = dir_path
         self._lock = threading.Lock()
         self._stats: Dict[tuple, _ArmStat] = {}
+        self._prune: Dict[str, list] = {}  # fp -> [scanned, skipped]
         self._fh = None
         os.makedirs(dir_path, exist_ok=True)
         self._load()
@@ -286,6 +292,12 @@ class OutcomeStore:
                 outcomes = rec.get("outcomes")
                 if not fp or not isinstance(outcomes, dict):
                     continue
+                pr = rec.get("pruning")
+                if isinstance(pr, (list, tuple)) and len(pr) == 2:
+                    try:
+                        self._fold_prune(fp, int(pr[0]), int(pr[1]))
+                    except (TypeError, ValueError):
+                        pass
                 for knob, o in outcomes.items():
                     if not isinstance(o, dict):
                         continue
@@ -301,16 +313,45 @@ class OutcomeStore:
         st.fold(wall_s, predicted_s)
         return st
 
+    def _fold_prune(self, fp: str, scanned: int, skipped: int) -> None:
+        p = self._prune.get(fp)
+        if p is None:
+            p = self._prune[fp] = [0, 0]
+        p[0] += max(0, scanned)
+        p[1] += max(0, skipped)
+
     def stat(self, fp: str, knob: str, arm: str) -> _ArmStat:
         with self._lock:
             return self._stats.get((fp, knob, arm)) or _ArmStat()
 
-    def observe(self, fp: str, outcomes: Dict[str, dict]) -> None:
+    def prune_selectivity(self, fp: str) -> Optional[float]:
+        """This class's measured scanned fraction, or None before any query
+        of the class has pushed a zone map through the pruning counters."""
+        with self._lock:
+            p = self._prune.get(fp)
+        if not p:
+            return None
+        total = p[0] + p[1]
+        if total <= 0:
+            return None
+        return p[0] / total
+
+    def observe(self, fp: str, outcomes: Dict[str, dict], pruning=None) -> None:
         """Fold one query's measured outcomes and append the record —
         skipping persistence for arms already holding `_PERSIST_CAP` samples
-        (the boundedness rule)."""
+        (the boundedness rule). `pruning` is the query's `(scanned, skipped)`
+        row-group counter delta; it folds into the per-class selectivity even
+        when every arm has saturated persistence (selectivity keeps tracking
+        the live workload either way — only the APPEND is capped)."""
         persist = {}
         with self._lock:
+            if isinstance(pruning, (list, tuple)) and len(pruning) == 2:
+                try:
+                    self._fold_prune(fp, int(pruning[0]), int(pruning[1]))
+                except (TypeError, ValueError):
+                    pruning = None
+            else:
+                pruning = None
             for knob, o in outcomes.items():
                 st = self._fold(fp, knob, o["arm"], o["wall_s"], o.get("predicted_s", 0.0))
                 if st.n <= _PERSIST_CAP:
@@ -324,6 +365,8 @@ class OutcomeStore:
                 "fingerprint": fp,
                 "outcomes": persist,
             }
+            if pruning is not None:
+                rec["pruning"] = [int(pruning[0]), int(pruning[1])]
             try:
                 if self._fh is None:
                     self._fh = open(
@@ -434,8 +477,17 @@ def _decide(phys, fingerprint: Optional[str]) -> PlanDecisions:
 
     stats = costmodel.collect_stats(phys)
     cal = costmodel.current_calibration()
-    est = costmodel.estimate(stats, cal)
     store = _outcome_store()
+    sel = None
+    if store is not None and fingerprint:
+        sel = store.prune_selectivity(fingerprint)
+    if sel is None:
+        est = costmodel.estimate(stats, cal)
+    else:
+        try:
+            est = costmodel.estimate(stats, cal, prune_selectivity=sel)
+        except TypeError:  # tests substitute two-arg estimators
+            est = costmodel.estimate(stats, cal)
     min_n = _min_samples()
     drift_x = _drift_x()
 
@@ -491,12 +543,36 @@ def _record(pd: PlanDecisions) -> None:
         pass
 
 
-def observe(pd: Optional[PlanDecisions], wall_s: float) -> None:
+def prune_counters(base=None):
+    """The ``io.pruning`` row-group counters as ``(scanned, skipped)`` —
+    absolute when `base` is None, else the clamped delta since `base` (the
+    per-query attribution the session captures around a run). None when the
+    metrics registry is unavailable; never raises."""
+    try:
+        from ..telemetry import metrics as _metrics
+
+        s = int(_metrics.counter("io.pruning.row_groups_scanned").value)
+        k = int(_metrics.counter("io.pruning.row_groups_skipped").value)
+    except Exception:
+        return None
+    if base is not None:
+        try:
+            s = max(0, s - int(base[0]))
+            k = max(0, k - int(base[1]))
+        except (TypeError, ValueError, IndexError):
+            return None
+    return (s, k)
+
+
+def observe(pd: Optional[PlanDecisions], wall_s: float, pruning=None) -> None:
     """Feed one executed query's measured wall into the outcome store: the
     whole wall lands on every non-pinned knob's chosen arm (sound per class
     because the class — the fingerprint — holds everything else fixed, and
     only one knob explores at a time). Called by the session with its own
-    monotonic measurement, so learning works with every telemetry sink off."""
+    monotonic measurement, so learning works with every telemetry sink off.
+    `pruning` is the query's `(scanned, skipped)` row-group counter delta
+    (from `prune_counters`), folded into the class's pushdown selectivity
+    prior."""
     if pd is None or pd.fingerprint is None:
         return
     try:
@@ -513,7 +589,7 @@ def observe(pd: Optional[PlanDecisions], wall_s: float) -> None:
                 "predicted_s": d.predicted_s,
             }
         if outcomes:
-            store.observe(pd.fingerprint, outcomes)
+            store.observe(pd.fingerprint, outcomes, pruning=pruning)
     except Exception:
         pass
 

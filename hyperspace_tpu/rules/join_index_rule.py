@@ -18,6 +18,7 @@ Parity: reference `index/rules/JoinIndexRule.scala:54-564`:
 
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Optional, Tuple
 
 from ..engine.expr import Expr, extract_equi_join_keys
@@ -150,6 +151,252 @@ def rank_join_pairs(pairs):
         )
 
     return sorted(pairs, key=key)
+
+
+#: Star recognition gate: ``HYPERSPACE_MULTIWAY=0`` keeps every star query
+#: on the cascaded binary joins byte-for-byte (the wrapper is never emitted,
+#: so the plan — and its fingerprint class — is exactly the pre-star one).
+ENV_MULTIWAY = "HYPERSPACE_MULTIWAY"
+
+
+def _rank_single(cands):
+    """Single-side covering-index ranking for a star dimension: the
+    JoinIndexRanker key restricted to one side — exact-match indexes first
+    (no hybrid-append merge, no lineage prune), then higher bucket counts."""
+    return sorted(
+        cands,
+        key=lambda c: (len(c.appended) + len(c.deleted), -c.entry.num_buckets),
+    )
+
+
+def _only_scan_filter(plan: LogicalPlan) -> bool:
+    """True when a star side is just a relation under (optional) row
+    filters: filters preserve per-row identity, so the side's table equals
+    what the cascaded join would see. Projections/computed columns on a side
+    are conservatively left to the cascade."""
+    from ..engine.logical import FilterNode
+
+    return all(
+        isinstance(n, (FilterNode, ScanNode)) for n in plan.collect_nodes()
+    )
+
+
+_KIND = {"float32": "f", "float64": "f", "string": "s"}
+
+
+def _key_kind(schema, name: str, cs: bool) -> Optional[str]:
+    """Hash-kind of one join key ('i'/'f'/'s') — bucket assignment hashed
+    each column in its OWN kind at build time, so a fact FK must hash in the
+    dimension's kind to land in the dimension's buckets (the same guard as
+    the physical planner's bucketed-path kinds check)."""
+    for f in schema.fields:
+        if _nkey(f.name, cs) == _nkey(name, cs):
+            return _KIND.get(f.dtype, "i")
+    return None
+
+
+def _wrap_star(plan: LogicalPlan, root_refs, session, index_manager, cs: bool):
+    """Recognize the star shape on the (possibly join-rewritten) plan and
+    wrap its top join chain in a `StarJoinNode`. Any non-star shape returns
+    the plan untouched — recognition is additive-only."""
+    spine: List[LogicalPlan] = []
+    node = plan
+    while not isinstance(node, JoinNode):
+        kids = node.children()
+        if len(kids) != 1:
+            return plan
+        spine.append(node)
+        node = kids[0]
+    star = _try_star(node, root_refs, session, index_manager, cs)
+    if star is None:
+        return plan
+    out: LogicalPlan = star
+    for op in reversed(spine):
+        out = op.with_children([out])
+    return out
+
+
+def _try_star(top: JoinNode, root_refs, session, index_manager, cs: bool):
+    """Build a `StarJoinNode` over the left-deep inner equi-join chain
+    rooted at `top`, or None when the shape/coverage rules don't hold:
+
+    - >= 2 inner joins, left-deep, each with an equi-only condition;
+    - the fact and every dimension side resolve to a single relation under
+      only row filters;
+    - every join's keys split exclusively fact-side vs THAT dimension (a
+      name present on two sides would make cascaded resolution ambiguous);
+    - key kinds match per pair (int/float/string — the bucket-hash space);
+    - every dimension has a covering bucketed index on exactly its keys
+      (the innermost dimension may already be index-substituted by the
+      binary rewrite — reused as-is when it covers)."""
+    from ..engine.logical import (
+        FilterNode,
+        HybridAppend,
+        StarDimension,
+        StarJoinNode,
+    )
+    from .filter_index_rule import _index_relation
+    from .rule_utils import lineage_prune_condition
+
+    chain: List[JoinNode] = []
+    cur: LogicalPlan = top
+    while isinstance(cur, JoinNode):
+        if cur.how != "inner":
+            return None
+        chain.append(cur)
+        cur = cur.left
+    if len(chain) < 2:
+        return None
+    fact_plan = cur
+    fact_scan = find_single_relation(fact_plan)
+    if fact_scan is None or not _only_scan_filter(fact_plan):
+        return None
+    fact_names = fact_scan.output_schema.names
+    fact_set = set(_norm(fact_names, cs))
+
+    # Innermost join first — the cascade's fold order, which fixes the
+    # star output's column naming (collision suffixes) and dim ordering.
+    dims_raw = []
+    for join in reversed(chain):
+        dscan = find_single_relation(join.right)
+        if dscan is None or not _only_scan_filter(join.right):
+            return None
+        dims_raw.append((join, dscan))
+    dim_name_sets = [
+        set(_norm(d.output_schema.names, cs)) for _, d in dims_raw
+    ]
+
+    hybrid = session.hs_conf.hybrid_scan_enabled
+    dims: List[StarDimension] = []
+    for i, (join, dscan) in enumerate(dims_raw):
+        pairs = extract_equi_join_keys(join.condition)
+        if not pairs:
+            return None
+        dnames = dscan.output_schema.names
+        oriented = _orient_pairs(pairs, fact_names, dnames, cs)
+        if oriented is None:
+            return None
+        f_to_d = _one_to_one(oriented, cs)
+        if f_to_d is None:
+            return None
+        fkeys = list(dict.fromkeys(f for f, _ in oriented))
+        dkeys = [f_to_d[_nkey(k, cs)] for k in fkeys]
+        # Whole-star exclusivity: a fact key named in any dimension (or a
+        # dim key named in the fact / another dimension) would resolve
+        # differently — or collision-suffixed — in the cascade. Stay there.
+        for k in fkeys:
+            if any(_nkey(k, cs) in s for s in dim_name_sets):
+                return None
+        for k in dkeys:
+            nk = _nkey(k, cs)
+            if nk in fact_set or any(
+                nk in s for j, s in enumerate(dim_name_sets) if j != i
+            ):
+                return None
+        for fk, dk in zip(fkeys, dkeys):
+            if _key_kind(fact_scan.output_schema, fk, cs) != _key_kind(
+                dscan.output_schema, dk, cs
+            ):
+                return None
+        dim_required = list(
+            dict.fromkeys(
+                [
+                    n
+                    for n in dnames
+                    if _nkey(n, cs) in root_refs
+                    or _nkey(n + "_r", cs) in root_refs
+                ]
+                + dkeys
+            )
+        )
+
+        rel = dscan.relation
+        if rel.index_name:
+            # Already substituted by the binary rewrite (the innermost
+            # join): reuse when it is bucketed on exactly this dimension's
+            # keys and covers the required columns.
+            spec = rel.bucket_spec
+            if spec is None:
+                return None
+            if set(_norm(list(spec.bucket_columns), cs)) != set(
+                _norm(dkeys, cs)
+            ):
+                return None
+            if not set(_norm(dim_required, cs)) <= set(
+                _norm(rel.schema.names, cs)
+            ):
+                return None
+            dim_plan: LogicalPlan = join.right
+            index_name, num_buckets = rel.index_name, spec.num_buckets
+        else:
+            cands = get_candidate_indexes(
+                index_manager, dscan, hybrid, rule_name="JoinIndexRule"
+            )
+            usable = _usable_indexes(cands, dkeys, dim_required, cs)
+            if not usable:
+                return None
+            cand = _rank_single(usable)[0]
+            new_rel = _index_relation(cand.entry, with_bucket_spec=True)
+            if cand.appended:
+                new_rel.hybrid_append = HybridAppend(
+                    files=cand.appended,
+                    file_format=dscan.relation.file_format,
+                    schema=dscan.relation.schema,
+                    root_paths=list(dscan.relation.root_paths),
+                    partition_spec=dscan.relation.partition_spec,
+                )
+
+            def replace(n, _scan=dscan, _rel=new_rel, _deleted=cand.deleted):
+                if n is _scan or (
+                    isinstance(n, ScanNode) and n.relation is _scan.relation
+                ):
+                    new_scan: LogicalPlan = ScanNode(_rel)
+                    if _deleted:
+                        new_scan = FilterNode(
+                            lineage_prune_condition(_deleted), new_scan
+                        )
+                    return new_scan
+                return n
+
+            dim_plan = join.right.transform_up(replace)
+            index_name, num_buckets = cand.entry.name, cand.entry.num_buckets
+
+        dims.append(
+            StarDimension(
+                plan=dim_plan,
+                fact_keys=fkeys,
+                dim_keys=dkeys,
+                dim_required=dim_required,
+                index_name=index_name,
+                num_buckets=num_buckets,
+            )
+        )
+
+    fact_required = list(
+        dict.fromkeys(
+            [n for n in fact_names if _nkey(n, cs) in root_refs]
+            + [k for d in dims for k in d.fact_keys]
+        )
+    )
+    star = StarJoinNode(top, dims, fact_required)
+    record_rule_decision(
+        "JoinIndexRule",
+        True,
+        star_dims=len(dims),
+        indexes=[d.index_name for d in dims],
+        buckets=[d.num_buckets for d in dims],
+    )
+    EventLoggerFactory.get_logger(
+        session.hs_conf.event_logger_class
+    ).log_event(
+        HyperspaceIndexUsageEvent(
+            index_names=[d.index_name for d in dims],
+            plan_before=top.tree_string(),
+            plan_after=star.tree_string(),
+            message="Multiway star-join recognized.",
+        )
+    )
+    return star
 
 
 class JoinIndexRule:
@@ -305,7 +552,16 @@ class JoinIndexRule:
                 )
                 return new_plan
 
-            return plan.transform_up(rewrite)
+            new_plan = plan.transform_up(rewrite)
+            if os.environ.get(ENV_MULTIWAY, "") != "0":
+                root_refs = set(
+                    _norm(plan.output_schema.names, cs)
+                    + _norm(_collect_expr_refs(plan), cs)
+                )
+                new_plan = _wrap_star(
+                    new_plan, root_refs, session, index_manager, cs
+                )
+            return new_plan
         except Exception as e:
             log_rule_failure(session, "JoinIndexRule", e)
             return plan

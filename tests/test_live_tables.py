@@ -81,7 +81,12 @@ def _write_src(tmp_path, name="t"):
 
 
 def _append(src, name, keys, vals):
-    eio.write_parquet(Table.from_pydict({"k": keys, "v": vals}), os.path.join(src, name))
+    # Write-then-rename: the TestRaces readers list this dir concurrently, and
+    # the scan's extension filter hides the .tmp name until the atomic replace
+    # — an in-place write lets a reader open a half-written footer.
+    tmp = os.path.join(src, name + ".tmp")
+    eio.write_parquet(Table.from_pydict({"k": keys, "v": vals}), tmp)
+    os.replace(tmp, os.path.join(src, name))
 
 
 def _entry(hs, name):

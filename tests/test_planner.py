@@ -499,3 +499,184 @@ class TestCostModel:
         assert set(est) == set(costmodel.KNOBS)
         for model_v, alt_v, pm, pa in est.values():
             assert pm >= 0.0 and pa >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# Satellite (ISSUE 18): learned pushdown prune selectivity + the multiway knob
+# ---------------------------------------------------------------------------
+
+
+class TestPruneSelectivity:
+    def _stats(self):
+        return costmodel.PlanStats(
+            has_agg=True,
+            has_filter=True,
+            n_files=1,
+            warm_files=1,
+            rows=1000,
+            decoded_bytes=1 << 20,
+        )
+
+    def test_learned_selectivity_replaces_half_prune_prior(self):
+        cal = costmodel.Calibration()
+        static = costmodel.estimate(self._stats(), cal)["pushdown"]
+        sharp = costmodel.estimate(
+            self._stats(), cal, prune_selectivity=0.1
+        )["pushdown"]
+        blunt = costmodel.estimate(
+            self._stats(), cal, prune_selectivity=1.0
+        )["pushdown"]
+        # ON-arm prediction scales with the measured scanned fraction; the
+        # OFF arm (decode everything) never moves.
+        assert sharp[2] < static[2] < blunt[2]
+        assert sharp[3] == static[3] == blunt[3]
+        assert blunt[2] == pytest.approx(blunt[3])  # never prunes -> no win
+        # Out-of-range values clamp instead of corrupting the price.
+        clamped = costmodel.estimate(
+            self._stats(), cal, prune_selectivity=7.5
+        )["pushdown"]
+        assert clamped[2] == blunt[2]
+
+    def test_store_folds_and_refolds_pruning_counters(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(planner.ENV_PLANNER_DIR, str(tmp_path / "ps"))
+        store = planner._outcome_store()
+        assert store.prune_selectivity("fp-sel") is None
+        outcomes = {"pushdown": {"arm": "on", "wall_s": 0.01, "predicted_s": 0.01}}
+        store.observe("fp-sel", outcomes, pruning=(2, 8))
+        store.observe("fp-sel", outcomes, pruning=(0, 10))
+        assert store.prune_selectivity("fp-sel") == pytest.approx(0.1)
+        # Restart: the selectivity re-folds from the persisted JSONL records.
+        planner.reset()
+        store2 = planner._outcome_store()
+        assert store2.prune_selectivity("fp-sel") == pytest.approx(0.1)
+        # Malformed pruning payloads are ignored, never fatal.
+        store2.observe("fp-sel", outcomes, pruning=("x", None))
+        assert store2.prune_selectivity("fp-sel") == pytest.approx(0.1)
+
+    def test_prune_counters_delta_clamped(self):
+        base = planner.prune_counters()
+        assert base is not None and len(base) == 2
+        delta = planner.prune_counters(base)
+        assert delta == (0, 0)
+        assert planner.prune_counters((10**12, 10**12)) == (0, 0)
+
+    def test_decided_query_records_pruning_delta(
+        self, tmp_path, monkeypatch, session
+    ):
+        """End to end: a decided filtered query lands its row-group counter
+        delta in the store, and the next decide prices pushdown from it."""
+        monkeypatch.setenv(planner.ENV_PLANNER_DIR, str(tmp_path / "pe"))
+        src = os.path.join(str(tmp_path), "pruned")
+        # Bounded row groups + a selective range filter: the zone maps skip
+        # most groups, so the io.pruning counters really move.
+        session.write_parquet(
+            {
+                "k": [i % 7 for i in range(600)],
+                "v": [float(i) for i in range(600)],
+            },
+            src,
+            row_group_rows=100,
+        )
+        from hyperspace_tpu.engine import col
+
+        def q():
+            return (
+                session.read.parquet(src)
+                .filter(col("v") < 150.0)
+                .group_by("k")
+                .agg(t=("v", "sum"))
+            )
+
+        q().collect()  # cold: warms footers; may or may not prune yet
+        q().collect()  # warm zone maps: pruning counters tick
+        store = planner._outcome_store()
+        fps = {fp for (fp, _k, _a) in store.summary()}
+        sels = [store.prune_selectivity(fp) for fp in fps]
+        learned = [s for s in sels if s is not None]
+        assert learned and all(0.0 < s < 1.0 for s in learned)
+
+
+class TestMultiwayKnob:
+    def test_estimate_prices_star_plans(self):
+        cal = costmodel.Calibration()
+        flat = costmodel.estimate(costmodel.PlanStats(has_join=True), cal)
+        assert flat["multiway"] == (True, False, 0.0, 0.0)
+        st = costmodel.PlanStats(
+            has_join=True, rows=1_000_000, decoded_bytes=50_000_000, star_dims=3
+        )
+        star = costmodel.estimate(st, cal)["multiway"]
+        assert star[0] is True and star[2] > 0.0 and star[3] > 0.0
+        # The cascade arm carries the intermediate-fact bytes: pricier than
+        # the star arm's key64 probes at realistic row widths.
+        assert star[3] > star[2]
+
+    def test_collect_stats_sees_star_and_dedupes_relations(
+        self, tmp_path, monkeypatch
+    ):
+        import numpy as np
+
+        from hyperspace_tpu import IndexConfig, IndexConstants
+        from hyperspace_tpu.engine import col
+        from hyperspace_tpu.engine import physical as phys
+        from hyperspace_tpu.hyperspace import Hyperspace, enable_hyperspace
+
+        phys.clear_device_memos()
+        s = HyperspaceSession(warehouse=str(tmp_path))
+        s.conf.set(IndexConstants.INDEX_SYSTEM_PATH, str(tmp_path / "idx"))
+        s.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
+        hs = Hyperspace(s)
+        rng = np.random.RandomState(23)
+        s.write_parquet(
+            {
+                "k1": rng.randint(0, 20, 400).astype(np.int64),
+                "k2": rng.randint(0, 10, 400).astype(np.int64),
+                "v": rng.randint(0, 9, 400).astype(np.int64),
+            },
+            str(tmp_path / "fact"),
+        )
+        s.write_parquet(
+            {"d1": np.arange(20, dtype=np.int64), "g1": np.arange(20, dtype=np.int64)},
+            str(tmp_path / "dim1"),
+        )
+        s.write_parquet(
+            {"d2": np.arange(10, dtype=np.int64), "g2": np.arange(10, dtype=np.int64)},
+            str(tmp_path / "dim2"),
+        )
+        hs.create_index(
+            s.read.parquet(str(tmp_path / "dim1")), IndexConfig("mk1", ["d1"], ["g1"])
+        )
+        hs.create_index(
+            s.read.parquet(str(tmp_path / "dim2")), IndexConfig("mk2", ["d2"], ["g2"])
+        )
+        enable_hyperspace(s)
+        f = s.read.parquet(str(tmp_path / "fact"))
+        d1 = s.read.parquet(str(tmp_path / "dim1"))
+        d2 = s.read.parquet(str(tmp_path / "dim2"))
+        plan = (
+            f.join(d1, col("k1") == col("d1"))
+            .join(d2, col("k2") == col("d2"))
+            .group_by("g1")
+            .agg(t=("v", "sum"))
+        ).physical_plan()
+        assert any(
+            isinstance(n, phys.MultiwayJoinExec) for n in plan.collect_nodes()
+        )
+        st = costmodel.collect_stats(plan)
+        assert st.has_join and st.star_dims == 2
+        # The star exec's fact/dim children share relations with its cascade
+        # child: the byte totals must count each relation once.
+        n_rels = len(
+            {
+                id(n.relation)
+                for n in plan.collect_nodes()
+                if getattr(n, "relation", None) is not None
+            }
+        )
+        assert st.n_scans == n_rels
+
+    def test_multiway_env_pin_reported_not_decided(self, monkeypatch, session, tmp_path):
+        src = _write_source(session, tmp_path)
+        monkeypatch.setenv("HYPERSPACE_MULTIWAY", "0")
+        pd = planner.decide(_agg(session, src).physical_plan(), "fp-mw")
+        assert pd.decisions["multiway"].source == "pinned"
+        assert pd.value("multiway") is None  # gates re-read the env flag

@@ -1,0 +1,447 @@
+"""Multiway star-join execution (ISSUE 18 tentpole).
+
+The contract under test: a recognized star shape (one fact, >=2 covered
+dimensions, all inner equi-joins on fact FKs) plans a `MultiwayJoinExec`
+and — when a grouped aggregate sits on top under streaming — executes as
+ONE pass that probes every dimension's covering index per fact chunk and
+folds straight into `StreamAggregator`, never materializing the cascaded
+intermediate. Byte-identity is the law: the star stream must equal the
+``HYPERSPACE_MULTIWAY=0`` cascaded execution rows()-for-rows() (group
+order included) across int/string/null keys, hot-key skew, shared payload
+names (the ``_r`` collision suffix), and every encoded/packed flag
+ambient; a mid-stream fault fails the query cleanly with NO partial pair
+memo; unrecognized shapes (single join, outer join, key-name overlap)
+never wrap; and a multi-file fact's second star query starts from the
+per-dimension pair memos.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import IndexConfig, IndexConstants
+from hyperspace_tpu.engine import HyperspaceSession, Table, col
+from hyperspace_tpu.engine import io as engine_io
+from hyperspace_tpu.engine import physical as phys
+from hyperspace_tpu.hyperspace import (
+    Hyperspace,
+    disable_hyperspace,
+    enable_hyperspace,
+)
+from hyperspace_tpu.telemetry.profiling import last_join_stages
+
+NUM_BUCKETS = 8
+
+
+def _write_parts(data: dict, path: str, parts: int) -> None:
+    """Write `data` as `parts` parquet files — multi-file facts keep the
+    concat Table identity warm across queries (the pair-memo key)."""
+    os.makedirs(path, exist_ok=True)
+    n = len(next(iter(data.values())))
+    cut = [int(round(i * n / parts)) for i in range(parts + 1)]
+    for i in range(parts):
+        sl = {k: np.asarray(v)[cut[i]:cut[i + 1]] for k, v in data.items()}
+        engine_io.write_parquet(
+            Table.from_pydict(sl), os.path.join(path, f"part-{i:05d}.parquet")
+        )
+
+
+@pytest.fixture()
+def make_star(tmp_path, monkeypatch):
+    """Factory: write one fact + N dimension tables, index every dimension
+    on its first column (covering the rest), return the session. Fresh
+    device memos per build."""
+    monkeypatch.delenv("HYPERSPACE_QUERY_STREAMING", raising=False)
+    monkeypatch.delenv("HYPERSPACE_MULTIWAY", raising=False)
+    monkeypatch.delenv("HYPERSPACE_JOIN_SIZE_CLASSES", raising=False)
+    monkeypatch.delenv("HYPERSPACE_JOIN_CHUNK_ROWS", raising=False)
+
+    def build(fact, dims, num_buckets=NUM_BUCKETS, fact_parts=2):
+        phys.clear_device_memos()
+        s = HyperspaceSession(warehouse=str(tmp_path))
+        s.conf.set(IndexConstants.INDEX_SYSTEM_PATH, str(tmp_path / "indexes"))
+        s.conf.set(IndexConstants.INDEX_NUM_BUCKETS, num_buckets)
+        hs = Hyperspace(s)
+        _write_parts(fact, str(tmp_path / "fact"), fact_parts)
+        for name, d in dims:
+            s.write_parquet(d, str(tmp_path / name))
+            k = list(d.keys())[0]
+            hs.create_index(
+                s.read.parquet(str(tmp_path / name)),
+                IndexConfig(f"star_{name}", [k], [c for c in d if c != k]),
+            )
+        enable_hyperspace(s)
+        return s, hs
+
+    return build
+
+
+def _star2(seed=3, n=8000, hot=True):
+    """The canonical 1-fact/2-dim star: skewed FK on dim1 when `hot`."""
+    rng = np.random.RandomState(seed)
+    k1 = rng.randint(0, 200, n).astype(np.int64)
+    if hot:
+        k1[: n // 3] = 7
+    fact = {
+        "k1": k1,
+        "k2": rng.randint(0, 50, n).astype(np.int64),
+        "v": rng.randint(0, 100, n).astype(np.int64),
+    }
+    dim1 = {
+        "d1": np.arange(200, dtype=np.int64),
+        "g1": rng.randint(0, 10, 200).astype(np.int64),
+    }
+    dim2 = {
+        "d2": np.arange(50, dtype=np.int64),
+        "g2": rng.randint(0, 5, 50).astype(np.int64),
+    }
+    return fact, [("dim1", dim1), ("dim2", dim2)]
+
+
+def _q2(s, tmp_path, group="g1", agg_col="v"):
+    f = s.read.parquet(str(tmp_path / "fact"))
+    d1 = s.read.parquet(str(tmp_path / "dim1"))
+    d2 = s.read.parquet(str(tmp_path / "dim2"))
+    return (
+        f.join(d1, col("k1") == col("d1"))
+        .join(d2, col("k2") == col("d2"))
+        .group_by(group)
+        .agg(t=(agg_col, "sum"), c=(agg_col, "count"), m=(agg_col, "max"))
+    )
+
+
+def _check_star(s, tmp_path, q, monkeypatch, expect_dims=2):
+    """The shared harness: star stream == cascaded fallback byte-for-byte
+    (group order included) == the non-indexed oracle (row sets)."""
+    pp = q().physical_plan()
+    star_nodes = [
+        n for n in pp.collect_nodes() if isinstance(n, phys.MultiwayJoinExec)
+    ]
+    assert len(star_nodes) == 1 and len(star_nodes[0].dims) == expect_dims
+
+    monkeypatch.setenv("HYPERSPACE_QUERY_STREAMING", "1")
+    star = q().collect().rows()
+    js = last_join_stages()
+    assert js is not None and js.get("join_mode") == "star"
+    assert len(js["star_dims"]) == expect_dims
+    for d in js["star_dims"]:
+        assert d["index"].startswith("star_") and d["pairs"] >= 0
+
+    monkeypatch.setenv("HYPERSPACE_MULTIWAY", "0")
+    phys.clear_device_memos()
+    pp0 = q().physical_plan()
+    assert not any(
+        isinstance(n, phys.MultiwayJoinExec) for n in pp0.collect_nodes()
+    )
+    cascade = q().collect().rows()
+    assert star == cascade  # byte-identical, group order included
+    monkeypatch.delenv("HYPERSPACE_MULTIWAY", raising=False)
+
+    disable_hyperspace(s)
+    oracle = q().collect().rows()
+    enable_hyperspace(s)
+    assert sorted(star) == sorted(oracle)
+    return star
+
+
+class TestStarOracle:
+    def test_int_keys_hot_fk(self, make_star, tmp_path, monkeypatch):
+        fact, dims = _star2(hot=True)
+        s, _hs = make_star(fact, dims)
+        _check_star(s, tmp_path, lambda: _q2(s, tmp_path), monkeypatch)
+
+    def test_group_by_fact_column(self, make_star, tmp_path, monkeypatch):
+        """Grouping on a FACT column exercises the direct-cells hint through
+        the star fold (the key never came from a dimension gather)."""
+        fact, dims = _star2(seed=5)
+        fact["gf"] = (np.asarray(fact["v"]) % 7).astype(np.int64)
+        s, _hs = make_star(fact, dims)
+        _check_star(
+            s, tmp_path, lambda: _q2(s, tmp_path, group="gf"), monkeypatch
+        )
+
+    def test_three_dimensions(self, make_star, tmp_path, monkeypatch):
+        rng = np.random.RandomState(9)
+        n = 6000
+        fact = {
+            "k1": rng.randint(0, 100, n).astype(np.int64),
+            "k2": rng.randint(0, 40, n).astype(np.int64),
+            "k3": rng.randint(0, 20, n).astype(np.int64),
+            "v": rng.randint(0, 100, n).astype(np.int64),
+        }
+        fact["k1"][: n // 2] = 11  # hot key
+        dims = [
+            ("dim1", {"d1": np.arange(100, dtype=np.int64),
+                      "g1": rng.randint(0, 10, 100).astype(np.int64)}),
+            ("dim2", {"d2": np.arange(40, dtype=np.int64),
+                      "g2": rng.randint(0, 5, 40).astype(np.int64)}),
+            ("dim3", {"d3": np.arange(20, dtype=np.int64),
+                      "g3": rng.randint(0, 4, 20).astype(np.int64)}),
+        ]
+        s, _hs = make_star(fact, dims)
+
+        def q():
+            f = s.read.parquet(str(tmp_path / "fact"))
+            d1 = s.read.parquet(str(tmp_path / "dim1"))
+            d2 = s.read.parquet(str(tmp_path / "dim2"))
+            d3 = s.read.parquet(str(tmp_path / "dim3"))
+            return (
+                f.join(d1, col("k1") == col("d1"))
+                .join(d2, col("k2") == col("d2"))
+                .join(d3, col("k3") == col("d3"))
+                .group_by("g1")
+                .agg(t=("v", "sum"), c=("v", "count"))
+            )
+
+        _check_star(s, tmp_path, q, monkeypatch, expect_dims=3)
+
+    def test_string_keys(self, make_star, tmp_path, monkeypatch):
+        rng = np.random.RandomState(6)
+        n = 4000
+        k1 = np.array(
+            [f"sku-{i:03d}" for i in rng.randint(0, 60, n)], dtype=object
+        )
+        k1[: n // 2] = "sku-HOT"
+        fact = {
+            "k1": k1,
+            "k2": rng.randint(0, 30, n).astype(np.int64),
+            "v": rng.randint(0, 100, n).astype(np.int64),
+        }
+        dims = [
+            ("dim1", {
+                "d1": np.array(
+                    [f"sku-{i:03d}" for i in range(60)] + ["sku-HOT"],
+                    dtype=object,
+                ),
+                "g1": rng.randint(0, 8, 61).astype(np.int64),
+            }),
+            ("dim2", {"d2": np.arange(30, dtype=np.int64),
+                      "g2": rng.randint(0, 5, 30).astype(np.int64)}),
+        ]
+        s, _hs = make_star(fact, dims)
+        _check_star(s, tmp_path, lambda: _q2(s, tmp_path), monkeypatch)
+
+    def test_null_keys_match_nothing(self, make_star, tmp_path, monkeypatch):
+        rng = np.random.RandomState(7)
+        n = 3000
+        k1 = rng.randint(0, 80, n).astype(object)
+        k1[::5] = None
+        d1k = np.arange(80).astype(object)
+        d1k[::9] = None
+        fact = {
+            "k1": k1,
+            "k2": rng.randint(0, 25, n).astype(np.int64),
+            "v": rng.randint(0, 100, n).astype(np.int64),
+        }
+        dims = [
+            ("dim1", {"d1": d1k, "g1": rng.randint(0, 6, 80).astype(np.int64)}),
+            ("dim2", {"d2": np.arange(25, dtype=np.int64),
+                      "g2": rng.randint(0, 5, 25).astype(np.int64)}),
+        ]
+        s, _hs = make_star(fact, dims)
+        _check_star(s, tmp_path, lambda: _q2(s, tmp_path), monkeypatch)
+
+    def test_shared_payload_name_collision_suffix(
+        self, make_star, tmp_path, monkeypatch
+    ):
+        """Two dimensions carrying the same payload NAME must surface exactly
+        the cascade's collision behavior (second one lands as ``w_r``)."""
+        rng = np.random.RandomState(8)
+        n = 3000
+        fact = {
+            "k1": rng.randint(0, 50, n).astype(np.int64),
+            "k2": rng.randint(0, 20, n).astype(np.int64),
+            "v": rng.randint(0, 100, n).astype(np.int64),
+        }
+        dims = [
+            ("dim1", {"d1": np.arange(50, dtype=np.int64),
+                      "w": rng.randint(0, 9, 50).astype(np.int64)}),
+            ("dim2", {"d2": np.arange(20, dtype=np.int64),
+                      "w": rng.randint(0, 9, 20).astype(np.int64)}),
+        ]
+        s, _hs = make_star(fact, dims)
+
+        def q():
+            f = s.read.parquet(str(tmp_path / "fact"))
+            d1 = s.read.parquet(str(tmp_path / "dim1"))
+            d2 = s.read.parquet(str(tmp_path / "dim2"))
+            return (
+                f.join(d1, col("k1") == col("d1"))
+                .join(d2, col("k2") == col("d2"))
+                .group_by("w")
+                .agg(t=("v", "sum"), c=("v", "count"))
+            )
+
+        _check_star(s, tmp_path, q, monkeypatch)
+
+    def test_multi_chunk_stream(self, make_star, tmp_path, monkeypatch):
+        monkeypatch.setenv("HYPERSPACE_JOIN_CHUNK_ROWS", "2000")
+        fact, dims = _star2(seed=12)
+        s, _hs = make_star(fact, dims)
+        _check_star(s, tmp_path, lambda: _q2(s, tmp_path), monkeypatch)
+        monkeypatch.setenv("HYPERSPACE_QUERY_STREAMING", "1")
+        phys.clear_device_memos()
+        _q2(s, tmp_path).collect()
+        js = last_join_stages()
+        assert js["join_mode"] == "star" and js["chunks"] > 1
+
+
+class TestStarFlagAmbients:
+    @pytest.mark.parametrize(
+        "ambient",
+        [
+            {"HYPERSPACE_ENCODED_DEVICE": "0"},
+            {"HYPERSPACE_ENCODED_DEVICE": "1"},
+            {"HYPERSPACE_ENCODED_DEVICE": "1", "HYPERSPACE_PACKED_CODES": "1"},
+        ],
+        ids=["encoded-off", "encoded-on", "encoded+packed"],
+    )
+    def test_encoded_packed_states(
+        self, make_star, tmp_path, monkeypatch, ambient
+    ):
+        """String-keyed star (dictionary columns in play) under each encoded/
+        packed posture: star == cascade == oracle in every ambient."""
+        for k, v in ambient.items():
+            monkeypatch.setenv(k, v)
+        rng = np.random.RandomState(21)
+        n = 3000
+        k1 = np.array(
+            [f"c-{i:02d}" for i in rng.randint(0, 40, n)], dtype=object
+        )
+        fact = {
+            "k1": k1,
+            "k2": rng.randint(0, 16, n).astype(np.int64),
+            "v": rng.randint(0, 50, n).astype(np.int64),
+        }
+        dims = [
+            ("dim1", {
+                "d1": np.array([f"c-{i:02d}" for i in range(40)], dtype=object),
+                "g1": np.array(
+                    [f"grp-{i % 5}" for i in range(40)], dtype=object
+                ),
+            }),
+            ("dim2", {"d2": np.arange(16, dtype=np.int64),
+                      "g2": rng.randint(0, 4, 16).astype(np.int64)}),
+        ]
+        s, _hs = make_star(fact, dims)
+        _check_star(s, tmp_path, lambda: _q2(s, tmp_path), monkeypatch)
+
+
+class TestStarShapeNegatives:
+    def _tables(self, make_star, hot=False):
+        fact, dims = _star2(seed=14, n=2000, hot=hot)
+        return make_star(fact, dims)
+
+    def test_single_join_is_not_a_star(self, make_star, tmp_path):
+        s, _hs = self._tables(make_star)
+        f = s.read.parquet(str(tmp_path / "fact"))
+        d1 = s.read.parquet(str(tmp_path / "dim1"))
+        q = f.join(d1, col("k1") == col("d1")).group_by("g1").agg(t=("v", "sum"))
+        assert not any(
+            isinstance(n, phys.MultiwayJoinExec)
+            for n in q.physical_plan().collect_nodes()
+        )
+
+    def test_outer_join_is_not_a_star(self, make_star, tmp_path):
+        s, _hs = self._tables(make_star)
+        f = s.read.parquet(str(tmp_path / "fact"))
+        d1 = s.read.parquet(str(tmp_path / "dim1"))
+        d2 = s.read.parquet(str(tmp_path / "dim2"))
+        q = (
+            f.join(d1, col("k1") == col("d1"), how="left")
+            .join(d2, col("k2") == col("d2"))
+            .group_by("g1")
+            .agg(t=("v", "sum"))
+        )
+        assert not any(
+            isinstance(n, phys.MultiwayJoinExec)
+            for n in q.physical_plan().collect_nodes()
+        )
+
+    def test_env_zero_never_plans_star(self, make_star, tmp_path, monkeypatch):
+        s, _hs = self._tables(make_star)
+        monkeypatch.setenv("HYPERSPACE_MULTIWAY", "0")
+        assert not any(
+            isinstance(n, phys.MultiwayJoinExec)
+            for n in _q2(s, tmp_path).physical_plan().collect_nodes()
+        )
+
+    def test_non_aggregate_star_rides_the_cascade(
+        self, make_star, tmp_path, monkeypatch
+    ):
+        """A star-shaped plain join (no aggregate on top) still plans the
+        MultiwayJoinExec wrapper but EXECUTES its byte-identical cascade."""
+        s, _hs = self._tables(make_star)
+
+        def q():
+            f = s.read.parquet(str(tmp_path / "fact"))
+            d1 = s.read.parquet(str(tmp_path / "dim1"))
+            d2 = s.read.parquet(str(tmp_path / "dim2"))
+            return (
+                f.join(d1, col("k1") == col("d1"))
+                .join(d2, col("k2") == col("d2"))
+                .select("v", "g1", "g2")
+            )
+
+        rows = q().collect().sorted_rows()
+        cnt = q().count()
+        monkeypatch.setenv("HYPERSPACE_MULTIWAY", "0")
+        phys.clear_device_memos()
+        assert q().collect().sorted_rows() == rows
+        assert q().count() == cnt
+
+
+class TestStarFaultsAndMemos:
+    def test_mid_stream_fault_leaves_no_partial_memo(
+        self, make_star, tmp_path, monkeypatch
+    ):
+        """A fault between star chunks fails the query cleanly; the pair
+        memos hold NOTHING partial; the retry recomputes correctly."""
+        import hyperspace_tpu.resilience as resilience
+
+        monkeypatch.setenv("HYPERSPACE_JOIN_CHUNK_ROWS", "2000")
+        fact, dims = _star2(seed=17)
+        s, _hs = make_star(fact, dims)
+        monkeypatch.setenv("HYPERSPACE_QUERY_STREAMING", "1")
+
+        real = resilience.check_deadline
+        calls = []
+
+        def boom(tag, *a, **k):
+            if tag == "query.star_stream":
+                calls.append(1)
+                if len(calls) >= 2:
+                    raise RuntimeError("injected star fault")
+            return real(tag, *a, **k)
+
+        monkeypatch.setattr(resilience, "check_deadline", boom)
+        with pytest.raises(RuntimeError, match="injected"):
+            _q2(s, tmp_path).collect()
+        assert len(calls) >= 2  # really died mid-stream
+        assert len(phys._pairs_cache) == 0  # no partial pair memo
+        monkeypatch.setattr(resilience, "check_deadline", real)
+        streamed = _q2(s, tmp_path).collect().rows()
+        monkeypatch.setenv("HYPERSPACE_MULTIWAY", "0")
+        phys.clear_device_memos()
+        assert _q2(s, tmp_path).collect().rows() == streamed
+
+    def test_warm_star_hits_per_dimension_memos(
+        self, make_star, tmp_path, monkeypatch
+    ):
+        """A multi-file fact keeps the concat Table identity stable, so the
+        second star query serves every dimension off the verified-pairs
+        memo — no fresh probe."""
+        fact, dims = _star2(seed=19)
+        s, _hs = make_star(fact, dims, fact_parts=2)
+        monkeypatch.setenv("HYPERSPACE_QUERY_STREAMING", "1")
+
+        cold = _q2(s, tmp_path).collect().rows()
+        js = last_join_stages()
+        assert [d["memo"] for d in js["star_dims"]] == ["miss", "miss"]
+        assert len(phys._pairs_cache) == 2
+
+        warm = _q2(s, tmp_path).collect().rows()
+        js2 = last_join_stages()
+        assert [d["memo"] for d in js2["star_dims"]] == ["hit", "hit"]
+        assert warm == cold
