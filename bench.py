@@ -523,6 +523,13 @@ def run_bench(deadline: float = None) -> dict:
         # -- adaptive planner: every ambient knob UNSET (planner deciding)
         #    vs the best hand-picked pinned configuration per workload
         ph.run("planner", lambda: d.update(_planner_section(s, base, col, runs, hs)))
+        # -- stage-level attribution: mispriced knob + unrelated decode
+        #    slowdown; stage-grain learning flips within the leg, whole-wall
+        #    learning does not (plus the ledger's on/off overhead p50s)
+        ph.run(
+            "attribution",
+            lambda: d.update(_attribution_section(s, base, col, runs, hs)),
+        )
         # -- multi-tenant serving: N clients × mixed Q1/Q3/Q14/point workload
         #    through the QueryServer (throughput, per-class p50/p99, dedup
         #    counters, cold-scan single-flight probe)
@@ -1450,6 +1457,214 @@ def _planner_section(s, base, col, runs, hs) -> dict:
     finally:
         os.environ.pop(_planner.ENV_PLANNER, None)
         os.environ.update(saved)
+    return out
+
+
+def _attribution_section(s, base, col, runs, hs) -> dict:
+    """Stage-level cost attribution: the acceptance experiment is that
+    stage-grain learning corrects a mispriced knob that whole-wall learning
+    cannot see. Setup: a bucket-join whose ``join_size_classes`` knob is
+    given a mispriced model prior (model picks OFF; ON is measured-better
+    inside the knob's own pad/probe/verify stages), plus an injected
+    UNRELATED slowdown (an ``io.decode`` fault hang) that dominates the
+    whole wall. Two legs over the same 8 queries:
+
+    - ``attribution_stage_flip_query``: with ``HYPERSPACE_STAGE_ATTRIBUTION``
+      on, the planner compares the knob's stage-local subtotals and flips to
+      the measured-better arm (expected at query 5 with min_samples=2);
+    - ``attribution_wall_flip_query``: with attribution off, the same
+      mispricing hides inside the decode-dominated wall (expected 0 = never);
+    - ``attribution_{stage,wall}_ratio``: alt/model means at each grain —
+      the stage ratio clears the flip margin, the wall ratio does not;
+    - ``attribution_overhead_{on,off}_p50_s``: the same warm join timed
+      under both ambients with no faults or biases — the stage ledger's
+      cost must be within the noise band in both directions.
+
+    `tools/bench_compare.py --keys 'attribution*'` gates these."""
+    from hyperspace_tpu import IndexConfig as _IndexConfig
+    from hyperspace_tpu.engine import HyperspaceSession as _HS
+    from hyperspace_tpu.engine import io as _eio
+    from hyperspace_tpu.engine import physical as _phys
+    from hyperspace_tpu.engine.scan_cache import (
+        global_bucketed_cache as _gbc,
+        global_concat_cache as _gcc,
+        global_scan_cache as _gsc,
+    )
+    from hyperspace_tpu.engine.table import Table as _T
+    from hyperspace_tpu.hyperspace import Hyperspace as _Hyperspace
+    from hyperspace_tpu.hyperspace import enable_hyperspace as _enable
+    from hyperspace_tpu.ops import bucket_join as _bj
+    from hyperspace_tpu.plananalysis import costmodel as _cm
+    from hyperspace_tpu.plananalysis import planner as _planner
+    from hyperspace_tpu.telemetry import faults as _faults
+    from hyperspace_tpu.telemetry import stage_ledger as _sl
+
+    # Own session: the 16-bucket conf (few decode calls, so the injected
+    # hang is a large CONSTANT per query) must not leak into later phases.
+    sess = _HS(warehouse=base)
+    sess.conf.set("hyperspace.index.num.buckets", "16")
+    hs_local = _Hyperspace(sess)
+    n, card = 60_000, 1000
+    rng = np.random.RandomState(71)
+    fact_dir = os.path.join(base, "fact_attr")
+    dim_dir = os.path.join(base, "dim_attr")
+    _eio.write_parquet(
+        _T.from_pydict(
+            {
+                "fk": rng.randint(0, card, n).astype(np.int64).tolist(),
+                "grp": rng.randint(0, 16, n).astype(np.int64).tolist(),
+                "v": rng.randint(0, 1000, n).astype(np.int64).tolist(),
+            }
+        ),
+        os.path.join(fact_dir, "part-00000.parquet"),
+    )
+    sess.write_parquet(
+        {
+            "k": np.arange(card, dtype=np.int64),
+            "w": rng.randint(0, 100, card).astype(np.int64),
+        },
+        dim_dir,
+    )
+    # Both sides indexed with distinct join column names: the streamed
+    # bucket-join path (JoinIndexRule applied) is the one whose pad/probe/
+    # verify stages the knob governs.
+    hs_local.create_index(
+        sess.read.parquet(dim_dir), _IndexConfig("bench_attr_dim", ["k"], ["w"])
+    )
+    hs_local.create_index(
+        sess.read.parquet(fact_dir),
+        _IndexConfig("bench_attr_fact", ["fk"], ["grp", "v"]),
+    )
+    _enable(sess)
+
+    def q():
+        return (
+            sess.read.parquet(fact_dir)
+            .join(sess.read.parquet(dim_dir), col("fk") == col("k"))
+            .group_by("grp")
+            .agg(total=("v", "sum"))
+        )
+
+    def clear():
+        _gsc().clear()
+        _gcc().clear()
+        _gbc().clear()
+        _phys.clear_device_memos()
+
+    governed = list(_cm.KNOB_ENV.values()) + [
+        _planner.ENV_PLANNER,
+        _planner.ENV_PLANNER_DIR,
+        _planner.ENV_MIN_SAMPLES,
+        _sl.ENV_STAGE_ATTRIBUTION,
+    ]
+    saved = {k: os.environ.pop(k) for k in governed if k in os.environ}
+    real_estimate = _cm.estimate
+    real_classed, real_ranges = _bj.probe_classed, _bj.probe_ranges
+    out: dict = {}
+    try:
+        # Overhead first, with nothing monkeypatched: warm query, planner
+        # off, attribution toggled by ambient only.
+        os.environ[_planner.ENV_PLANNER] = "0"
+        q().collect()
+        for amb, key in (("1", "on"), ("0", "off")):
+            os.environ[_sl.ENV_STAGE_ATTRIBUTION] = amb
+            out[f"attribution_overhead_{key}_p50_s"] = round(
+                timed_p50(lambda: q().collect(), runs), 4
+            )
+
+        # Mispriced prior: the model prices join_size_classes OFF as the
+        # cheaper arm (it is not), and every other knob flat so only the
+        # one flip is in play.
+        def fixed_estimate(stats, cal, prune_selectivity=None):
+            est = real_estimate(stats, cal)
+            fx = {k: (mv, av, 0.0001, 0.0001) for k, (mv, av, _, _) in est.items()}
+            fx["streaming"] = (True, False, 0.0001, 0.0001)
+            fx["multiway"] = (False, True, 0.0001, 0.0001)
+            fx["join_size_classes"] = (False, True, 0.006, 0.0065)
+            return fx
+
+        _cm.estimate = fixed_estimate
+
+        # The knob's TRUE cost, made visible at stage grain: the classed
+        # arm pays 2ms in its probe stage, the unclassed arm 20ms — tiny
+        # against the ~1s decode-dominated wall, decisive against the
+        # ~7ms stage subtotal. The guard keeps nested probe_ranges calls
+        # (inside probe_classed) from double-billing.
+        guard = threading.local()
+
+        def biased(real):
+            def inner(*a, **k):
+                if getattr(guard, "on", False):
+                    return real(*a, **k)
+                guard.on = True
+                try:
+                    with _sl.stage_scope("probe"):
+                        time.sleep(0.002 if _bj.size_classes_enabled() else 0.020)
+                    return real(*a, **k)
+                finally:
+                    guard.on = False
+
+            return inner
+
+        _bj.probe_classed = biased(real_classed)
+        _bj.probe_ranges = biased(real_ranges)
+
+        # Warm both arms' compiles before any timed leg.
+        for arm in ("1", "0"):
+            os.environ["HYPERSPACE_JOIN_SIZE_CLASSES"] = arm
+            clear()
+            q().collect()
+        os.environ.pop("HYPERSPACE_JOIN_SIZE_CLASSES", None)
+        os.environ.pop(_planner.ENV_PLANNER, None)
+        os.environ[_planner.ENV_MIN_SAMPLES] = "2"
+
+        def run_leg(tag, attribution_on):
+            os.environ[_planner.ENV_PLANNER_DIR] = os.path.join(
+                base, f"planner_attr_{tag}"
+            )
+            os.environ[_sl.ENV_STAGE_ATTRIBUTION] = "1" if attribution_on else "0"
+            _planner.reset()
+            flip_at = 0
+            with _faults.inject("io.decode", rate=1.0, kind="hang0.5"):
+                for i in range(8):
+                    clear()
+                    q().collect()
+                    act = _planner.activity_summary().get("join_size_classes", {})
+                    if not flip_at and act.get("measured_flips"):
+                        flip_at = i + 1
+            arms = {
+                key[2]: st
+                for key, st in _planner.outcome_summary().items()
+                if key[1] == "join_size_classes"
+            }
+            return flip_at, arms
+
+        stage_flip, stage_arms = run_leg("stage", True)
+        wall_flip, wall_arms = run_leg("wall", False)
+        out["attribution_stage_flip_query"] = stage_flip
+        out["attribution_wall_flip_query"] = wall_flip
+        s_on = stage_arms.get("on", {}).get("mean_stage_s")
+        s_off = stage_arms.get("off", {}).get("mean_stage_s")
+        if s_on is not None and s_off:
+            out["attribution_stage_on_mean_stage_s"] = s_on
+            out["attribution_stage_off_mean_stage_s"] = s_off
+            out["attribution_stage_ratio"] = round(s_on / s_off, 3)
+        w_on = wall_arms.get("on", {}).get("mean_wall_s")
+        w_off = wall_arms.get("off", {}).get("mean_wall_s")
+        if w_on is not None and w_off:
+            out["attribution_wall_on_mean_s"] = w_on
+            out["attribution_wall_off_mean_s"] = w_off
+            out["attribution_wall_ratio"] = round(w_on / w_off, 3)
+    finally:
+        _cm.estimate = real_estimate
+        _bj.probe_classed = real_classed
+        _bj.probe_ranges = real_ranges
+        os.environ.pop("HYPERSPACE_JOIN_SIZE_CLASSES", None)
+        for k in governed:
+            os.environ.pop(k, None)
+        os.environ.update(saved)
+        _planner.reset()
+        clear()
     return out
 
 

@@ -24,6 +24,7 @@ import numpy as np
 from ..telemetry import accounting as _accounting
 from ..telemetry import device_observatory as _devobs
 from ..telemetry import metrics as _metrics
+from ..telemetry import stage_ledger as _stage_ledger
 
 # Bound once: device_array is the hottest instrumented path (every device op
 # over cached host columns) — per-call cost is one locked int add.
@@ -100,26 +101,30 @@ def device_array(
     _MISSES.inc()
     # Upload-miss = a real host→device transfer this query caused. Timing it
     # requires forcing the (async) transfer to completion, so seconds only
-    # arrive under HYPERSPACE_DEVICE_TIMING — bytes and count always.
-    if _devobs.timing_mode():
-        import time as _time
+    # arrive under HYPERSPACE_DEVICE_TIMING — bytes and count always. The
+    # whole miss region is the ``h2d`` stage for attribution: upload bytes
+    # bill to a dedicated lane even when the miss fires inside another
+    # stage's bracket (innermost label wins).
+    with _stage_ledger.stage_scope("h2d"):
+        if _devobs.timing_mode():
+            import time as _time
 
-        t0 = _time.monotonic()
-        dev = jnp.asarray(host)
-        dev.block_until_ready()
-        upload_s = _time.monotonic() - t0
-    else:
-        dev = jnp.asarray(host)
-        upload_s = None
-    _accounting.add("device_upload_bytes", int(dev.nbytes))
-    _devobs.record_h2d(int(dev.nbytes), upload_s)
-    if encoded:
-        _devobs.record_encoded_stage(
-            site or "?",
-            int(flat_bytes),
-            int(dev.nbytes),
-            packed_bytes=int(dev.nbytes) if packed else None,
-        )
+            t0 = _time.monotonic()
+            dev = jnp.asarray(host)
+            dev.block_until_ready()
+            upload_s = _time.monotonic() - t0
+        else:
+            dev = jnp.asarray(host)
+            upload_s = None
+        _accounting.add("device_upload_bytes", int(dev.nbytes))
+        _devobs.record_h2d(int(dev.nbytes), upload_s)
+        if encoded:
+            _devobs.record_encoded_stage(
+                site or "?",
+                int(flat_bytes),
+                int(dev.nbytes),
+                packed_bytes=int(dev.nbytes) if packed else None,
+            )
     charged = int(charged_bytes) if charged_bytes is not None else int(dev.nbytes)
 
     def _evict(wr, key=key):

@@ -45,6 +45,7 @@ from ..storage.filesystem import FileStatus, FileSystem, LocalFileSystem
 from ..telemetry import accounting as _accounting
 from ..telemetry import faults as _faults
 from ..telemetry import metrics as _metrics
+from ..telemetry import stage_ledger as _stage_ledger
 from ..util.path_utils import is_data_path
 from . import encoding as _encoding
 from .schema import BOOL, FLOAT32, FLOAT64, INT32, INT64, STRING, Field, Schema
@@ -838,10 +839,13 @@ def warm_file_cache(
 
         led = _accounting.current_ledger()  # charge workers to the submitter
         sc = _resilience.current_scope()  # workers honor the query deadline
+        stage = _stage_ledger.worker_stage("decode")  # bill the submit stage
 
         def warm_one(job):
             p, sel, cols = job
-            with _accounting.use_ledger(led), _resilience.use_scope(sc):
+            with _accounting.use_ledger(led), _resilience.use_scope(
+                sc
+            ), _stage_ledger.stage_scope(stage):
                 _faults.check("pool.worker")
                 if sel is None:
                     _decode_into_cache(p, file_format, file_columns)
@@ -897,9 +901,12 @@ def iter_file_tables(
 
     led = _accounting.current_ledger()  # pool workers charge the submitter
     sc = _resilience.current_scope()  # workers honor the query deadline
+    stage = _stage_ledger.worker_stage("decode")  # bill the submit stage
 
     def decode_one(path: str) -> Table:
-        with _accounting.use_ledger(led), _resilience.use_scope(sc):
+        with _accounting.use_ledger(led), _resilience.use_scope(
+            sc
+        ), _stage_ledger.stage_scope(stage):
             _faults.check("pool.worker")
             t0 = _time.monotonic()
             meta, sel = sel_of.get(path, (None, None))
@@ -907,6 +914,7 @@ def iter_file_tables(
                 t = file_table(path, file_format, file_columns)
             else:
                 t = pruned_file_table(path, file_format, file_columns, meta, sel)
+            _stage_ledger.note_rows(t.num_rows)
             if on_decode is not None:
                 on_decode(_time.monotonic() - t0)
             return t
@@ -1083,9 +1091,12 @@ def read_files(
 
             led = _accounting.current_ledger()  # charge workers to the submitter
             sc = _resilience.current_scope()  # workers honor the query deadline
+            stage = _stage_ledger.worker_stage("decode")  # bill the submit stage
 
             def decode_miss_worker(i: int) -> Table:
-                with _accounting.use_ledger(led), _resilience.use_scope(sc):
+                with _accounting.use_ledger(led), _resilience.use_scope(
+                    sc
+                ), _stage_ledger.stage_scope(stage):
                     _faults.check("pool.worker")
                     return decode_miss(i)
 

@@ -183,6 +183,7 @@ class DataFrame:
 
         from ..exceptions import CorruptIndexError
         from ..index import quarantine
+        from ..plananalysis import attribution as _attribution
         from ..plananalysis import planner as _planner
         from ..telemetry import tracing
 
@@ -200,11 +201,14 @@ class DataFrame:
                 # persistent home) — only on success: a quarantine retry's
                 # partial wall would poison the arm stats. The row-group
                 # pruning counter delta rides along so the class's pushdown
-                # selectivity prior is learned, not guessed.
+                # selectivity prior is learned, not guessed; so does the
+                # per-stage wall snapshot (still-open query scope), which
+                # lets the store learn at stage grain.
                 _planner.observe(
                     decisions,
                     _time.monotonic() - t0,
                     pruning=_planner.prune_counters(pr0) if pr0 is not None else None,
+                    stages=_attribution.query_stage_walls(),
                 )
                 return out
             except CorruptIndexError as e:

@@ -39,6 +39,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..telemetry import metrics as _metrics
+from ..telemetry import stage_ledger as _stage_ledger
 from ..telemetry.compile_log import observed_jit as _observed_jit
 from .mesh import BUCKET_AXIS, quantize_cap
 from .shim import shard_map
@@ -195,16 +196,20 @@ def exchange_rows(
     Returns (bucket_ids [n_dev*cap], valid mask, payload arrays), each sharded over
     the mesh: device d's block holds its bucket range, valid rows sorted by
     (bucket, sort_keys...) and grouped before padding."""
-    n_dev = mesh.devices.size
-    if in_valid is None:
-        in_valid = jnp.ones(h1.shape, dtype=jnp.int32)
-        if n_valid is None:
-            n_valid = int(h1.shape[0])
-    if n_valid is not None:
-        _record_exchange(n_valid, n_dev, cap, [h1, in_valid, *payload, *sort_keys])
-    return _exchange_program(mesh, num_buckets, cap)(
-        h1, in_valid, list(payload), list(sort_keys)
-    )
+    # The whole call is the ``exchange`` stage for attribution: the pad
+    # ledger tick in _record_exchange and the exchange program's device time
+    # bill the mesh lane, not whichever stage submitted the bucketize.
+    with _stage_ledger.stage_scope("exchange"):
+        n_dev = mesh.devices.size
+        if in_valid is None:
+            in_valid = jnp.ones(h1.shape, dtype=jnp.int32)
+            if n_valid is None:
+                n_valid = int(h1.shape[0])
+        if n_valid is not None:
+            _record_exchange(n_valid, n_dev, cap, [h1, in_valid, *payload, *sort_keys])
+        return _exchange_program(mesh, num_buckets, cap)(
+            h1, in_valid, list(payload), list(sort_keys)
+        )
 
 
 def distributed_bucketize(
@@ -395,20 +400,21 @@ def distributed_bucketize_coded(
     the all_to_all (`HYPERSPACE_PACKED_CODES`). Output contract (and bytes of
     the output) match `distributed_bucketize`: int32 bucket ids, int32
     validity, payload lanes in their input dtypes."""
-    counts = exchange_counts_coded(mesh, bucket, num_buckets)
-    cap = quantize_cap(int(counts.max()) if counts.size else 0)
-    n_dev = mesh.devices.size
-    spec = tuple(tuple(s) for s in packed_spec)
-    _record_exchange(
-        n_valid,
-        n_dev,
-        cap,
-        [bucket, in_valid, *payload, *sort_keys],
-        packed_spec=spec if spec else None,
-    )
-    return _exchange_coded_program(
-        mesh, num_buckets, cap, tuple(sort_from_payload), spec
-    )(bucket, in_valid, list(payload), list(sort_keys))
+    with _stage_ledger.stage_scope("exchange"):
+        counts = exchange_counts_coded(mesh, bucket, num_buckets)
+        cap = quantize_cap(int(counts.max()) if counts.size else 0)
+        n_dev = mesh.devices.size
+        spec = tuple(tuple(s) for s in packed_spec)
+        _record_exchange(
+            n_valid,
+            n_dev,
+            cap,
+            [bucket, in_valid, *payload, *sort_keys],
+            packed_spec=spec if spec else None,
+        )
+        return _exchange_coded_program(
+            mesh, num_buckets, cap, tuple(sort_from_payload), spec
+        )(bucket, in_valid, list(payload), list(sort_keys))
 
 
 # ---------------------------------------------------------------------------

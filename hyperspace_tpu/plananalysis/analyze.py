@@ -119,6 +119,23 @@ def _stage_lines(span, children_of, indent: int) -> List[str]:
             + f"{kind} stages: "
             + " ".join(stages + extra)
         )
+        # Multiway star joins carry per-dimension stage walls in the summary
+        # (`star_dims`): render one line per dimension so the explain tree
+        # shows WHERE a star probe spent its time, not just a bare node.
+        if child.attrs.get("join_mode") == "star":
+            for dim in child.attrs.get("star_dims") or ():
+                if not isinstance(dim, dict):
+                    continue
+                cells = [f"dim[{dim.get('index', '?')}]:"]
+                for k in ("pad_s", "probe_s", "verify_s"):
+                    v = dim.get(k)
+                    if v is not None:
+                        cells.append(f"{k[:-2]}={_fmt_seconds(v)}")
+                for k in ("buckets", "pairs", "memo"):
+                    v = dim.get(k)
+                    if v is not None:
+                        cells.append(f"{k}={v}")
+                out.append(pad + "  " + " ".join(cells))
         fallbacks = child.attrs.get("pallas_fallbacks")
         if fallbacks:
             out.append(pad + f"pallas fallbacks: {fallbacks}")
@@ -132,6 +149,7 @@ def explain_analyze_string(df) -> str:
     from .. import resilience
     from ..engine.physical import ExecContext
     from ..telemetry import accounting, metrics, tracing
+    from . import attribution as _attribution
     from . import planner as _planner
 
     session = df.session
@@ -149,7 +167,11 @@ def explain_analyze_string(df) -> str:
                 with _planner.decisions_scope(pd):
                     t0 = _time.monotonic()
                     result = phys.execute(ExecContext(session))
-                _planner.observe(pd, _time.monotonic() - t0)
+                _planner.observe(
+                    pd,
+                    _time.monotonic() - t0,
+                    stages=_attribution.query_stage_walls(),
+                )
                 root.set_attr("rows_out", int(result.num_rows))
                 accounting.set_value("rows_produced", int(result.num_rows))
     snap1 = metrics.snapshot()
@@ -306,6 +328,15 @@ def explain_analyze_string(df) -> str:
             )
     else:
         lines.append("  (no ledger recorded)")
+
+    # Attribution: per-stage cost vectors (stage ledger) joined with knob
+    # ownership and the planner's stage-grain predicted-vs-actual. Only
+    # rendered when the query carried stage data (HYPERSPACE_STAGE_ATTRIBUTION
+    # on and at least one labeled bracket ran).
+    attr_lines = _attribution.explain_lines(led.to_dict() if led else None)
+    if attr_lines:
+        lines.append("")
+        lines.extend(attr_lines)
 
     delta = metrics.counters_delta(snap0, snap1)
     lines.append("")

@@ -1,0 +1,175 @@
+"""Knob → stage ownership: which pipeline stages a governed knob can
+actually move, and the stage-local cost the planner should learn from.
+
+PR 17's outcome store credits or blames a knob for the ENTIRE query wall,
+so a pushdown win hides behind a cold decode and a packed-codes regression
+behind a warm cache (ROADMAP item 2's "per-knob cost attribution finer than
+whole-wall A/B"). With the stage ledger (`telemetry/stage_ledger.py`)
+labeling every stage bracket, this module closes the loop:
+
+- `KNOB_STAGES` declares, per cost-model knob, the stage set whose seconds
+  that knob governs — pushdown can only change decode, packed_codes only
+  the h2d/exchange lanes, join_size_classes only the pad/probe/verify
+  brackets it shapes, and so on.
+- `knob_stage_seconds(knob, stage_walls)` reduces one query's per-stage
+  walls to the knob-relevant subtotal (None when nothing relevant was
+  labeled — the planner then falls back to whole wall, exactly the old
+  behavior, so sparse labeling degrades gracefully instead of lying).
+- `explain_lines(ledger)` renders the Attribution section of
+  `explain(analyze=True)`: per-stage cost vectors joined with knob
+  ownership and the planner's predicted-vs-actual at stage grain.
+
+Stage walls are BUSY time (concurrent workers sum — the `StageTimings`
+convention), so a knob subtotal can exceed the query wall on an overlapped
+pipeline; the planner only ever compares subtotals of the SAME stage set
+across arms, so the comparison stays apples-to-apples.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..telemetry import stage_ledger as _stage_ledger
+
+#: Governed knob -> the stages whose cost it can move. Keys mirror
+#: `costmodel.KNOBS`; stage names are the `StageTimings.timed` bracket
+#: names plus the dedicated ``h2d`` / ``exchange`` attribution lanes.
+#: A knob that genuinely shapes the whole pipeline (streaming, chunk_rows)
+#: lists every stage it touches — the subtotal is then close to whole wall,
+#: which is the honest answer for a whole-pipeline knob.
+KNOB_STAGES: Dict[str, tuple] = {
+    # Zone-map pushdown only changes what the decode stage reads.
+    "pushdown": ("decode",),
+    # Streaming re-shapes the whole read-side pipeline.
+    "streaming": ("decode", "filter", "partial", "merge"),
+    # Chunk sizing moves decode granularity and the join staging it feeds.
+    "chunk_rows": ("decode", "pad", "probe"),
+    # Encoded execution trades decode (stay in codes) against staging.
+    "encoded_exec": ("decode", "h2d"),
+    # Bit-packed lanes only change transfer/exchange bytes.
+    "packed_codes": ("h2d", "exchange"),
+    # Size-class bucketing shapes pad/probe/verify of the streamed join.
+    "join_size_classes": ("pad", "probe", "verify"),
+    # Multiway star probing replaces per-dim probe/expand cascades.
+    "multiway": ("probe", "expand", "verify"),
+    # Hash quantization changes probe and the aggregate partial/merge work.
+    "hash_quantize": ("probe", "partial", "merge"),
+}
+
+
+def knob_stage_seconds(
+    knob: str, stage_walls: Optional[Dict[str, float]]
+) -> Optional[float]:
+    """The stage-local seconds `knob` governs in one query's per-stage wall
+    snapshot. None (→ caller falls back to whole wall) when the knob has no
+    declared stage set, no snapshot exists, or none of its stages were
+    labeled in this query — a zero subtotal is indistinguishable from "the
+    relevant stages never ran", and learning from it would credit the knob
+    for free queries."""
+    if not stage_walls:
+        return None
+    stages = KNOB_STAGES.get(knob)
+    if not stages:
+        return None
+    total = 0.0
+    seen = False
+    for st in stages:
+        v = stage_walls.get(st)
+        if v:
+            total += float(v)
+            seen = True
+    return total if seen and total > 0.0 else None
+
+
+def query_stage_walls() -> Optional[Dict[str, float]]:
+    """The running query's per-stage busy walls (None when attribution is
+    off or nothing was labeled) — what `session` / `explain(analyze=True)`
+    pass to `planner.observe(stages=...)`."""
+    return _stage_ledger.query_stage_walls()
+
+
+def stages_of(ledger: Optional[dict]) -> Dict[str, dict]:
+    """The per-stage cost vectors of one closed ledger dict ({} when the
+    query ran without attribution)."""
+    if not isinstance(ledger, dict):
+        return {}
+    stages = ledger.get("stages")
+    return stages if isinstance(stages, dict) else {}
+
+
+def _owners(stage: str) -> List[str]:
+    return sorted(k for k, sts in KNOB_STAGES.items() if stage in sts)
+
+
+def _fmt_cell(field: str, v) -> str:
+    if field.endswith("_s"):
+        return f"{v * 1e3:.2f}ms"
+    if field.startswith("bytes_"):
+        return f"{_fmt_bytes(v)}"
+    return str(v)
+
+
+def _fmt_bytes(n) -> str:
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024
+    return f"{n:.1f}GiB"
+
+
+def explain_lines(ledger: Optional[dict]) -> List[str]:
+    """Render the Attribution section: one line per stage (canonical vector
+    field order, zero fields dropped) with the knobs owning that stage, then
+    the planner's stage-grain predicted-vs-actual when decisions rode the
+    ledger. Empty list when the query carried no stage data."""
+    stages = stages_of(ledger)
+    if not stages:
+        return []
+    lines: List[str] = ["Attribution (per-stage cost vectors):"]
+    for st in sorted(stages):
+        vec = stages[st]
+        parts = []
+        for f in _stage_ledger.VECTOR_FIELDS:
+            v = vec.get(f)
+            if v:
+                parts.append(f"{f}={_fmt_cell(f, v)}")
+        owners = _owners(st)
+        own = f"  [knobs: {', '.join(owners)}]" if owners else ""
+        lines.append(f"  {st:<12} {' '.join(parts)}{own}")
+    # The planner ledger dict keys knob entries at top level (next to the
+    # close-time annotations like actual_wall_s) — render predicted vs
+    # stage-local actual for every decided knob whose stages were labeled.
+    planner = ledger.get("planner") if isinstance(ledger, dict) else None
+    if isinstance(planner, dict):
+        walls = {
+            st: vec.get("wall_s", 0.0)
+            for st, vec in stages.items()
+            if isinstance(vec, dict) and vec.get("wall_s")
+        }
+        grain: List[str] = []
+        for knob in sorted(planner):
+            d = planner[knob]
+            if not isinstance(d, dict) or "arm" not in d:
+                continue
+            sub = d.get("stage_actual_s")
+            if not isinstance(sub, (int, float)):
+                sub = knob_stage_seconds(knob, walls)
+            if sub is None:
+                continue
+            cell = f"{knob}[{d['arm']}]: stage_actual={sub * 1e3:.2f}ms"
+            pred = d.get("predicted_s")
+            if isinstance(pred, (int, float)) and pred > 0:
+                cell += f" predicted={pred * 1e3:.2f}ms"
+                sd = d.get("stage_drift_x")
+                if not isinstance(sd, (int, float)):
+                    sd = round(sub / pred, 3)
+                cell += f" drift={sd:g}x"
+            grain.append(cell)
+        if grain:
+            lines.append(
+                "  knob-relevant subtotals (busy time, stages overlap):"
+            )
+            for g in grain:
+                lines.append(f"    {g}")
+    return lines

@@ -235,23 +235,35 @@ def decisions_scope(pd: Optional[PlanDecisions]) -> Iterator[None]:
 
 
 class _ArmStat:
-    __slots__ = ("n", "wall_sum", "pred_sum")
+    # stage_n/stage_sum fold the knob-relevant STAGE subtotal when the query
+    # carried stage attribution (schema_version-2 outcome records); wall
+    # stats keep folding regardless, so v1 records and attribution-off
+    # queries contribute exactly as before.
+    __slots__ = ("n", "wall_sum", "pred_sum", "stage_n", "stage_sum")
 
     def __init__(self):
         self.n = 0
         self.wall_sum = 0.0
         self.pred_sum = 0.0
+        self.stage_n = 0
+        self.stage_sum = 0.0
 
-    def fold(self, wall_s: float, predicted_s: float) -> None:
+    def fold(self, wall_s: float, predicted_s: float, stage_s=None) -> None:
         self.n += 1
         self.wall_sum += float(wall_s)
         self.pred_sum += float(predicted_s)
+        if isinstance(stage_s, (int, float)) and stage_s > 0:
+            self.stage_n += 1
+            self.stage_sum += float(stage_s)
 
     def mean_wall(self) -> float:
         return self.wall_sum / self.n if self.n else 0.0
 
     def mean_pred(self) -> float:
         return self.pred_sum / self.n if self.n else 0.0
+
+    def mean_stage(self) -> float:
+        return self.stage_sum / self.stage_n if self.stage_n else 0.0
 
 
 class OutcomeStore:
@@ -302,15 +314,24 @@ class OutcomeStore:
                     if not isinstance(o, dict):
                         continue
                     try:
-                        self._fold(fp, knob, str(o["arm"]), float(o["wall_s"]), float(o.get("predicted_s", 0.0)))
+                        self._fold(
+                            fp,
+                            knob,
+                            str(o["arm"]),
+                            float(o["wall_s"]),
+                            float(o.get("predicted_s", 0.0)),
+                            # v1 records carry no stage_s — they fold as
+                            # wall-only, exactly the old semantics.
+                            stage_s=o.get("stage_s"),
+                        )
                     except (KeyError, TypeError, ValueError):
                         continue
 
-    def _fold(self, fp, knob, arm, wall_s, predicted_s) -> _ArmStat:
+    def _fold(self, fp, knob, arm, wall_s, predicted_s, stage_s=None) -> _ArmStat:
         st = self._stats.get((fp, knob, arm))
         if st is None:
             st = self._stats[(fp, knob, arm)] = _ArmStat()
-        st.fold(wall_s, predicted_s)
+        st.fold(wall_s, predicted_s, stage_s=stage_s)
         return st
 
     def _fold_prune(self, fp: str, scanned: int, skipped: int) -> None:
@@ -353,13 +374,28 @@ class OutcomeStore:
             else:
                 pruning = None
             for knob, o in outcomes.items():
-                st = self._fold(fp, knob, o["arm"], o["wall_s"], o.get("predicted_s", 0.0))
+                st = self._fold(
+                    fp,
+                    knob,
+                    o["arm"],
+                    o["wall_s"],
+                    o.get("predicted_s", 0.0),
+                    stage_s=o.get("stage_s"),
+                )
                 if st.n <= _PERSIST_CAP:
                     persist[knob] = o
             if not persist:
                 return
+            # Records carrying a stage-local subtotal are the versioned
+            # per-stage record kind (v2); readers of either version tolerate
+            # the other — v1 folds wall-only, and an old reader of a v2
+            # record simply ignores the extra ``stage_s`` key.
+            versioned = any(
+                isinstance(o.get("stage_s"), (int, float))
+                for o in persist.values()
+            )
             rec = {
-                "schema_version": 1,
+                "schema_version": 2 if versioned else 1,
                 "kind": "planner_outcome",
                 "ts": round(time.time(), 6),
                 "fingerprint": fp,
@@ -393,14 +429,18 @@ class OutcomeStore:
 
     def summary(self) -> Dict[tuple, dict]:
         with self._lock:
-            return {
-                key: {
+            out = {}
+            for key, st in self._stats.items():
+                row = {
                     "n": st.n,
                     "mean_wall_s": round(st.mean_wall(), 6),
                     "mean_predicted_s": round(st.mean_pred(), 6),
                 }
-                for key, st in self._stats.items()
-            }
+                if st.stage_n:
+                    row["stage_n"] = st.stage_n
+                    row["mean_stage_s"] = round(st.mean_stage(), 6)
+                out[key] = row
+            return out
 
 
 _stores: Dict[str, OutcomeStore] = {}
@@ -444,6 +484,8 @@ def reset() -> None:
         for st in _stores.values():
             st.close()
         _stores.clear()
+    with _activity_lock:
+        _activity.clear()
 
 
 # ---------------------------------------------------------------------------
@@ -506,14 +548,28 @@ def _decide(phys, fingerprint: Optional[str]) -> PlanDecisions:
             if sm.n >= min_n and sa.n >= min_n:
                 # Both arms measured: the better-measured arm wins, with
                 # hysteresis — flipping away from the model needs a margin.
-                if sa.mean_wall() < sm.mean_wall() * _FLIP_MARGIN:
+                # STAGE grain when both arms hold enough stage-attributed
+                # samples: the knob-relevant stage subtotal can't be masked
+                # by (or blamed for) cost in stages the knob doesn't govern.
+                if sm.stage_n >= min_n and sa.stage_n >= min_n:
+                    flip = sa.mean_stage() < sm.mean_stage() * _FLIP_MARGIN
+                else:
+                    flip = sa.mean_wall() < sm.mean_wall() * _FLIP_MARGIN
+                if flip:
                     value, source = alt_v, "measured"
             elif (
                 not explore_claimed
                 and sm.n >= min_n
                 and sa.n < min_n
                 and sm.mean_pred() >= _MIN_PRED_S
-                and sm.mean_wall() > drift_x * sm.mean_pred()
+                and (
+                    # Drift at stage grain when the chosen arm's stage
+                    # subtotal is measured (predictions are per-knob
+                    # ATTRIBUTABLE seconds, so stage-local actual is the
+                    # matching denominator); whole wall otherwise.
+                    sm.mean_stage() if sm.stage_n >= min_n else sm.mean_wall()
+                )
+                > drift_x * sm.mean_pred()
             ):
                 # Predicted-vs-actual drift on the chosen arm: the model is
                 # provably mispricing this class. Gather alternative-arm
@@ -523,8 +579,45 @@ def _decide(phys, fingerprint: Optional[str]) -> PlanDecisions:
         decisions[knob] = Decision(knob, value, alt_v if value == model_v else model_v, pred_m if value == model_v else pred_a, pred_a if value == model_v else pred_m, source)
 
     pd = PlanDecisions(fingerprint, decisions, cal.source)
+    _note_activity(decisions)
     _record(pd)
     return pd
+
+
+# Per-knob planner activity since process start (or reset()): how many
+# queries the planner DECIDED the knob for (non-pinned), how many times it
+# explored the alternative arm, and how many times a measured flip won.
+# Monotonic like the registry counters; the exporter's `planner` frame key
+# and dashboards read `activity_summary()`.
+_activity: Dict[str, dict] = {}
+_activity_lock = threading.Lock()
+
+
+def _note_activity(decisions: Dict[str, Decision]) -> None:
+    with _activity_lock:
+        for knob, d in decisions.items():
+            if d.source == "pinned":
+                continue
+            a = _activity.get(knob)
+            if a is None:
+                a = _activity[knob] = {
+                    "decisions": 0,
+                    "explorations": 0,
+                    "measured_flips": 0,
+                }
+            a["decisions"] += 1
+            if d.source == "explore":
+                a["explorations"] += 1
+            elif d.source == "measured":
+                a["measured_flips"] += 1
+
+
+def activity_summary() -> Dict[str, dict]:
+    """Per-knob decision/exploration/measured-flip counts since start —
+    ``{knob: {decisions, explorations, measured_flips}}``, empty when the
+    planner never decided (off, or no queries yet)."""
+    with _activity_lock:
+        return {k: dict(v) for k, v in sorted(_activity.items())}
 
 
 def _record(pd: PlanDecisions) -> None:
@@ -564,7 +657,9 @@ def prune_counters(base=None):
     return (s, k)
 
 
-def observe(pd: Optional[PlanDecisions], wall_s: float, pruning=None) -> None:
+def observe(
+    pd: Optional[PlanDecisions], wall_s: float, pruning=None, stages=None
+) -> None:
     """Feed one executed query's measured wall into the outcome store: the
     whole wall lands on every non-pinned knob's chosen arm (sound per class
     because the class — the fingerprint — holds everything else fixed, and
@@ -572,22 +667,33 @@ def observe(pd: Optional[PlanDecisions], wall_s: float, pruning=None) -> None:
     monotonic measurement, so learning works with every telemetry sink off.
     `pruning` is the query's `(scanned, skipped)` row-group counter delta
     (from `prune_counters`), folded into the class's pushdown selectivity
-    prior."""
+    prior. `stages` is the query's per-stage busy-wall snapshot
+    (`attribution.query_stage_walls()`): when present, each knob's outcome
+    additionally records the STAGE subtotal that knob governs
+    (`attribution.KNOB_STAGES`), so flips and drift trigger on stage-local
+    cost instead of whole wall — an unrelated stage's slowdown can no
+    longer mask (or fake) a knob's effect."""
     if pd is None or pd.fingerprint is None:
         return
     try:
         store = _outcome_store()
         if store is None:
             return
+        from . import attribution as _attribution
+
         outcomes = {}
         for knob, d in pd.decisions.items():
             if d.source == "pinned":
                 continue
-            outcomes[knob] = {
+            o = {
                 "arm": d.arm,
                 "wall_s": round(float(wall_s), 6),
                 "predicted_s": d.predicted_s,
             }
+            stage_s = _attribution.knob_stage_seconds(knob, stages)
+            if stage_s is not None:
+                o["stage_s"] = round(float(stage_s), 6)
+            outcomes[knob] = o
         if outcomes:
             store.observe(pd.fingerprint, outcomes, pruning=pruning)
     except Exception:
@@ -604,10 +710,30 @@ def annotate_close(led, wall_s: float) -> None:
     if not isinstance(p, dict):
         return
     p["actual_wall_s"] = round(float(wall_s), 6)
+    # Stage-grain join: when the ledger carries per-stage vectors, each
+    # knob's entry also gets its stage-local actual and drift — the numbers
+    # hsreport's stage-drift table and the Attribution section render.
+    stage_walls = None
+    stages = led.get("stages")
+    if isinstance(stages, dict) and stages:
+        stage_walls = {
+            st: vec.get("wall_s", 0.0)
+            for st, vec in stages.items()
+            if isinstance(vec, dict) and vec.get("wall_s")
+        }
+    from . import attribution as _attribution
+
     for knob, d in p.items():
         if isinstance(d, dict) and isinstance(d.get("predicted_s"), (int, float)):
             pred = d["predicted_s"]
             d["drift_x"] = round(wall_s / pred, 3) if pred and pred > 0 else None
+            if stage_walls:
+                stage_s = _attribution.knob_stage_seconds(knob, stage_walls)
+                if stage_s is not None:
+                    d["stage_actual_s"] = round(stage_s, 6)
+                    d["stage_drift_x"] = (
+                        round(stage_s / pred, 3) if pred and pred > 0 else None
+                    )
     try:
         from ..telemetry import history as _history
 

@@ -117,6 +117,7 @@ class QueryScope:
         "retries",
         "lane",
         "plan_decisions",
+        "stage_ledger",
     )
 
     def __init__(self, name: str, timeout_s: Optional[float]):
@@ -134,6 +135,10 @@ class QueryScope:
         # same reason `lane` does: pool workers adopt the scope, so gates on
         # every thread working for this query see one decisions object.
         self.plan_decisions = None
+        # Per-stage cost accumulator (telemetry/stage_ledger.py) — lazily
+        # created on first stage bracket, rides the scope so every adopted
+        # worker thread bills the same ledger. None until attribution stamps.
+        self.stage_ledger = None
 
     def charge_retry(self) -> int:
         with self._lock:

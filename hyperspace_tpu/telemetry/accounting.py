@@ -53,6 +53,7 @@ from collections import deque
 from typing import Iterator, List, Optional
 
 from . import metrics as _metrics
+from . import stage_ledger as _stage_ledger
 
 ENV_ACCOUNTING = "HYPERSPACE_ACCOUNTING"
 
@@ -139,6 +140,10 @@ class QueryLedger:
         "wall_s",
         "_lock",
         "_counts",
+        # Stage-attribution flag, captured ONCE at ledger open (one env read
+        # per query) — the per-add stamp below gates on this bool, never on
+        # the environment.
+        "stage_attr",
     )
 
     def __init__(
@@ -156,6 +161,7 @@ class QueryLedger:
         self.wall_s: Optional[float] = None
         self._lock = threading.Lock()
         self._counts: dict = {}
+        self.stage_attr = False
 
     def add(self, key: str, n) -> None:
         with self._lock:
@@ -219,10 +225,13 @@ def current_ledger() -> Optional[QueryLedger]:
 
 def add(key: str, n) -> None:
     """Charge `n` of `key` to the ambient query's ledger; no-op (one
-    contextvar read) without one."""
+    contextvar read) without one. Ledgers opened with stage attribution on
+    additionally bill cost-vector counters to the ambient stage."""
     led = _current.get()
     if led is not None:
         led.add(key, n)
+        if led.stage_attr:
+            _stage_ledger.stamp_counter(key, n)
 
 
 def set_value(key: str, n) -> None:
@@ -371,6 +380,7 @@ def ledger_scope(query_id: str, name: str, root=None) -> Iterator[QueryLedger]:
     led = QueryLedger(
         query_id, name, tenant=_tenant.get(), lane=_resilience.current_lane()
     )
+    led.stage_attr = _stage_ledger.enabled()
     token = _current.set(led)
     t0 = time.monotonic()
     try:
@@ -419,6 +429,17 @@ def ledger_scope(query_id: str, name: str, root=None) -> Iterator[QueryLedger]:
             v = led.get(field)
             if v:
                 _metrics.counter(f"accounting.{field}").inc(v)
+        # Stage-attribution join: the scope's per-stage cost vectors land as
+        # the ledger's ``stages`` key BEFORE annotate_close/to_dict, so the
+        # planner's close annotation, history baselines, hsreport's drift
+        # table, and explain's Attribution section all see one snapshot.
+        if led.stage_attr:
+            try:
+                stages = _stage_ledger.close_stages(led)
+                if stages:
+                    led.set_value("stages", stages)
+            except Exception:
+                pass
         # Planner predicted-vs-actual join: runs only when the adaptive
         # planner recorded decisions on this ledger (a dict lookup when it
         # didn't), BEFORE to_dict snapshots — so history records, spans, and

@@ -168,6 +168,18 @@ class MetricsExporter:
                 "pads": pads,
                 "transfers": transfers,
             }
+        # Adaptive-planner activity (decisions / explorations / measured
+        # flips per knob): dashboards see planner behavior without reading
+        # the outcome-store sidecar. Omitted while the planner never decided
+        # so pre-planner frame consumers see unchanged schemas.
+        try:
+            from ..plananalysis import planner as _planner
+
+            activity = _planner.activity_summary()
+            if activity:
+                out["planner"] = activity
+        except Exception:
+            pass
         if final:
             out["final"] = True
         return out

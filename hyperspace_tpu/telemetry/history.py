@@ -261,7 +261,7 @@ class FingerprintBaseline:
     compaction checkpoints serialize) plus sum/sum-of-squares for the Nσ
     anomaly bound, plus totals of the tracked cost fields."""
 
-    __slots__ = ("fingerprint", "names", "hist", "wall_sumsq", "fields")
+    __slots__ = ("fingerprint", "names", "hist", "wall_sumsq", "fields", "stages")
 
     def __init__(self, fingerprint: str):
         self.fingerprint = fingerprint
@@ -269,6 +269,11 @@ class FingerprintBaseline:
         self.hist = _metrics.Histogram(f"history.{fingerprint}")  # unregistered
         self.wall_sumsq = 0.0
         self.fields: Dict[str, float] = {}
+        # Per-stage cost-vector totals (stage attribution, PR 19):
+        # {stage: {"n": queries_that_labeled_it, <field>: total, ...}} —
+        # folded from each ledger's ``stages`` key. Empty for classes whose
+        # queries ran with HYPERSPACE_STAGE_ATTRIBUTION=0.
+        self.stages: Dict[str, dict] = {}
 
     @property
     def count(self) -> int:
@@ -315,9 +320,29 @@ class FingerprintBaseline:
             v = ledger.get(f)
             if isinstance(v, (int, float)) and v:
                 self.fields[f] = self.fields.get(f, 0) + v
+        stages = ledger.get("stages")
+        if isinstance(stages, dict):
+            # One ledger = one query: each stage it labeled counts n=1.
+            self._fold_stages(stages, default_n=1)
+
+    def _fold_stages(self, stages: dict, default_n: int) -> None:
+        """Fold stage vectors into the per-stage totals. A ledger's vectors
+        carry no "n" (each is one query → `default_n`); a checkpoint's
+        accumulators carry their own folded "n"."""
+        for st, vec in stages.items():
+            if not isinstance(vec, dict):
+                continue
+            acc = self.stages.get(st)
+            if acc is None:
+                acc = self.stages[st] = {"n": 0}
+            for k, v in vec.items():
+                if k != "n" and isinstance(v, (int, float)):
+                    acc[k] = acc.get(k, 0) + v
+            n = vec.get("n", default_n)
+            acc["n"] += n if isinstance(n, int) and n > 0 else default_n
 
     def to_checkpoint(self) -> dict:
-        return {
+        out = {
             "schema_version": SCHEMA_VERSION,
             "kind": "baseline",
             "fingerprint": self.fingerprint,
@@ -327,6 +352,15 @@ class FingerprintBaseline:
             "fields": {k: round(v, 6) if isinstance(v, float) else v
                        for k, v in sorted(self.fields.items())},
         }
+        if self.stages:
+            # New key on the v1 record: old readers ignore unknown keys (the
+            # standing forward-compat contract), so no version bump needed.
+            out["stages"] = {
+                st: {k: round(v, 6) if isinstance(v, float) else v
+                     for k, v in sorted(acc.items())}
+                for st, acc in sorted(self.stages.items())
+            }
+        return out
 
     def merge_checkpoint(self, rec: dict) -> None:
         self.hist.merge_state(rec.get("wall") or {})
@@ -344,6 +378,9 @@ class FingerprintBaseline:
             for k, v in fields.items():
                 if isinstance(v, (int, float)):
                     self.fields[k] = self.fields.get(k, 0) + v
+        stages = rec.get("stages")
+        if isinstance(stages, dict):
+            self._fold_stages(stages, default_n=1)
 
     def summary(self) -> dict:
         mean, std = self.mean_std()
@@ -361,6 +398,12 @@ class FingerprintBaseline:
             out["wall_max_s"] = s.get("max")
         for k, v in sorted(self.fields.items()):
             out[k] = round(v, 6) if isinstance(v, float) else v
+        if self.stages:
+            out["stages"] = {
+                st: {k: round(v, 6) if isinstance(v, float) else v
+                     for k, v in sorted(acc.items())}
+                for st, acc in sorted(self.stages.items())
+            }
         return out
 
 

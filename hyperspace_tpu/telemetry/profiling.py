@@ -25,6 +25,7 @@ import time
 from collections import deque
 from typing import Dict, Iterator, Optional
 
+from . import stage_ledger
 from . import tracing
 
 
@@ -56,9 +57,15 @@ class StageTimings:
 
     @contextlib.contextmanager
     def timed(self, stage: str) -> Iterator[None]:
+        # Every pipelined stage bracket doubles as a stage-attribution scope
+        # (telemetry/stage_ledger.py): counters ticked inside bill this stage
+        # and the busy wall banks on the ambient QueryScope. One env read
+        # when HYPERSPACE_STAGE_ATTRIBUTION=0; StageTimings' own sums are
+        # untouched either way.
         t0 = time.monotonic()
         try:
-            yield
+            with stage_ledger.stage_scope(stage):
+                yield
         finally:
             self.add(stage, time.monotonic() - t0)
 

@@ -53,6 +53,9 @@ from typing import Dict, Iterator, List, Optional
 
 ENV_TRACE_FILE = "HYPERSPACE_TRACE_FILE"
 ENV_TRACING = "HYPERSPACE_TRACING"
+#: Live Chrome-trace capture directory (`stage_ledger.ENV_TIMELINE_DIR`):
+#: every finalized root trace writes one timeline-<query_id>.json here.
+ENV_TIMELINE_DIR = "HYPERSPACE_TIMELINE_DIR"
 
 #: Spans per trace hard cap (a traced query touching thousands of operators
 #: keeps the tree; further spans are dropped, counted per trace, and surfaced
@@ -244,6 +247,10 @@ def active() -> bool:
     if _capture.get() is not None:
         return True
     if os.environ.get(ENV_TRACE_FILE):
+        return True
+    if os.environ.get(ENV_TIMELINE_DIR):
+        # Live timeline capture is a sink: spans must record for _finalize
+        # to have a tree to convert.
         return True
     return os.environ.get(ENV_TRACING) == "1"
 
@@ -466,25 +473,45 @@ def _finalize(trace: QueryTrace) -> None:
     if cap is not None and cap.trace is None:
         cap.trace = trace
     path = os.environ.get(ENV_TRACE_FILE)
-    if not path:
-        return
-    try:
-        lines = []
-        for s in list(trace.spans):
-            if s.duration_s is None:
-                # A worker span left open (its pool outlived the root): export
-                # it closed at the root's end with an explicit marker rather
-                # than an unparseable null duration.
-                s.end(status="unclosed")
-            lines.append(json.dumps(s.to_json(), default=str))
-        from . import rotation as _rotation
+    if path:
+        try:
+            lines = []
+            for s in list(trace.spans):
+                if s.duration_s is None:
+                    # A worker span left open (its pool outlived the root):
+                    # export it closed at the root's end with an explicit
+                    # marker rather than an unparseable null duration.
+                    s.end(status="unclosed")
+                lines.append(json.dumps(s.to_json(), default=str))
+            from . import rotation as _rotation
 
-        with _export_lock:
-            # Size-capped rotation (HYPERSPACE_TRACE_MAX_MB; off by
-            # default): one whole trace per append, so rotated files each
-            # stay independently parseable.
-            _rotation.append(
-                path, "\n".join(lines) + "\n", _rotation.ENV_TRACE_MAX_MB
-            )
-    except Exception:
-        pass
+            with _export_lock:
+                # Size-capped rotation (HYPERSPACE_TRACE_MAX_MB; off by
+                # default): one whole trace per append, so rotated files each
+                # stay independently parseable.
+                _rotation.append(
+                    path, "\n".join(lines) + "\n", _rotation.ENV_TRACE_MAX_MB
+                )
+        except Exception:
+            pass
+    # Live timeline capture: with HYPERSPACE_TIMELINE_DIR set, every root
+    # trace also lands as one Chrome-trace/Perfetto JSON file (one lane per
+    # stage / worker family / op class — `stage_ledger.chrome_trace`), so a
+    # causal query timeline needs no post-hoc tool run. One env read off.
+    tdir = os.environ.get(ENV_TIMELINE_DIR)
+    if tdir:
+        try:
+            from . import stage_ledger as _stage_ledger
+
+            spans = []
+            for s in list(trace.spans):
+                if s.duration_s is None:
+                    s.end(status="unclosed")
+                spans.append(s.to_json())
+            doc = _stage_ledger.chrome_trace(spans)
+            os.makedirs(tdir, exist_ok=True)
+            out = os.path.join(tdir, f"timeline-{trace.query_id}.json")
+            with open(out, "w") as fh:
+                json.dump(doc, fh, default=str)
+        except Exception:
+            pass

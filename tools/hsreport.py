@@ -8,7 +8,7 @@ stable plan-class fingerprint. This tool is the read side: what an operator
 
 Usage:
     python tools/hsreport.py HISTORY_DIR [--top 10] [--recent 5]
-        [--compare OTHER_DIR] [--json]
+        [--fingerprint PREFIX] [--compare OTHER_DIR] [--json]
 
 Sections:
 - **Top plan classes by total cost** — per fingerprint: query count, names,
@@ -17,6 +17,13 @@ Sections:
   the newest ``--recent`` queries, compacted checkpoints included) vs the
   recent-window p50 — the "is this class getting slower" view
   (`tools/bench_compare.py --history` gates on exactly this).
+- **Stage drift** — the same expected-vs-actual question at STAGE grain
+  (per-stage busy walls from the stage ledger, recorded when
+  ``HYPERSPACE_STAGE_ATTRIBUTION`` was on): which stage of a drifting
+  class actually moved — a decode regression and a probe regression are
+  different runbook pages.
+- ``--fingerprint PREFIX`` scopes every section to plan classes whose
+  fingerprint starts with PREFIX — drill into one class's history.
 - **SLO compliance** — lane-labeled ledgers (served queries) judged against
   the ambient ``HYPERSPACE_SLO_*`` objectives via `telemetry.slo.
   compliance_over` — the offline twin of the live monitor.
@@ -85,8 +92,15 @@ def drift(
     return out
 
 
-def build_report(dir_path: str, top: int, recent_k: int) -> dict:
+def build_report(
+    dir_path: str, top: int, recent_k: int, fingerprint: Optional[str] = None
+) -> dict:
     raw, checkpoints = load_dir(dir_path)
+    if fingerprint:
+        raw = {fp: v for fp, v in raw.items() if fp.startswith(fingerprint)}
+        checkpoints = {
+            fp: v for fp, v in checkpoints.items() if fp.startswith(fingerprint)
+        }
     baselines = {
         fp: bl.summary()
         for fp, bl in _history.fold_baselines(
@@ -112,6 +126,7 @@ def build_report(dir_path: str, top: int, recent_k: int) -> dict:
             dict(fingerprint=fp, **summary) for fp, summary in classes[:top]
         ],
         "drift": drift(raw, checkpoints, recent_k)[:top],
+        "stage_drift": _stage_drift(raw, checkpoints, recent_k, top),
         "slo": _slo.compliance_over(all_ledgers),
         "compile_hotspots": [
             {
@@ -143,7 +158,79 @@ def build_report(dir_path: str, top: int, recent_k: int) -> dict:
         "code_staging": _code_staging(baselines, top),
         "planner": _planner_table(raw, dir_path, top),
     }
+    if fingerprint:
+        report["fingerprint_filter"] = fingerprint
     return report
+
+
+def _stage_drift(
+    raw: Dict[str, list], checkpoints: Dict[str, list], recent_k: int, top: int
+) -> List[dict]:
+    """Expected-vs-actual at STAGE grain: per (class, stage) — the mean
+    per-query stage busy wall of the newest `recent_k` ledgers vs the class
+    baseline (compacted checkpoint stage accumulators + every older raw
+    ledger). Same window split as `drift`, but localized: when a class's
+    whole-wall drift row fires, this table says WHICH stage moved. Stage
+    vectors ride ledgers only when ``HYPERSPACE_STAGE_ATTRIBUTION`` was on;
+    classes/stages without both a recent and a baseline signal are
+    omitted. Worst ratio first."""
+    rows = []
+    for fp in sorted(set(raw) | set(checkpoints)):
+        ledgers = raw.get(fp, [])
+        recent = [
+            r["ledger"]["stages"]
+            for r in ledgers[-recent_k:]
+            if isinstance(r["ledger"].get("stages"), dict)
+        ]
+        if not recent:
+            continue
+        base: Dict[str, list] = {}  # stage -> [wall_sum, n]
+        for rec in checkpoints.get(fp, ()):
+            stages = rec.get("stages")
+            if not isinstance(stages, dict):
+                continue
+            for st, vec in stages.items():
+                if not isinstance(vec, dict):
+                    continue
+                acc = base.setdefault(st, [0.0, 0])
+                acc[0] += float(vec.get("wall_s") or 0.0)
+                n = vec.get("n")
+                acc[1] += n if isinstance(n, int) and n > 0 else 1
+        for rec in ledgers[:-recent_k]:
+            stages = rec["ledger"].get("stages")
+            if not isinstance(stages, dict):
+                continue
+            for st, vec in stages.items():
+                if isinstance(vec, dict) and vec.get("wall_s"):
+                    acc = base.setdefault(st, [0.0, 0])
+                    acc[0] += float(vec["wall_s"])
+                    acc[1] += 1
+        for st in sorted({s for stages in recent for s in stages}):
+            walls = [
+                float(stages[st].get("wall_s") or 0.0)
+                for stages in recent
+                if isinstance(stages.get(st), dict)
+            ]
+            if not walls:
+                continue
+            bw, bn = base.get(st, (0.0, 0))
+            if not bn or bw <= 0:
+                continue
+            expected = bw / bn
+            actual = sum(walls) / len(walls)
+            rows.append(
+                {
+                    "fingerprint": fp,
+                    "stage": st,
+                    "baseline_n": bn,
+                    "expected_wall_s": round(expected, 6),
+                    "recent_n": len(walls),
+                    "actual_wall_s": round(actual, 6),
+                    "ratio": round(actual / expected, 3),
+                }
+            )
+    rows.sort(key=lambda r: -r["ratio"])
+    return rows[:top]
 
 
 def _device_hotspots(baselines: Dict[str, dict], top: int) -> List[dict]:
@@ -326,7 +413,12 @@ def _fmt_s(v: Optional[float]) -> str:
 
 def render(report: dict) -> str:
     lines = [
-        f"workload history: {report['dir']}",
+        f"workload history: {report['dir']}"
+        + (
+            f"  (classes matching {report['fingerprint_filter']}*)"
+            if report.get("fingerprint_filter")
+            else ""
+        ),
         f"  {report['ledger_records']} ledgers + "
         f"{report['checkpoint_records']} checkpoints across "
         f"{report['fingerprints']} plan classes; "
@@ -350,6 +442,15 @@ def render(report: dict) -> str:
                 f"  {d['fingerprint']}  expected={_fmt_s(d['expected_p50_s'])}"
                 f" actual={_fmt_s(d['actual_p50_s'])} (x{d['ratio']})"
                 f"  baseline_n={d['baseline_n']}  [{names}]"
+            )
+    if report.get("stage_drift"):
+        lines += ["", "stage drift (recent stage busy-wall vs class baseline):"]
+        for d in report["stage_drift"]:
+            lines.append(
+                f"  {d['fingerprint']}  {d['stage']:<10}"
+                f" expected={_fmt_s(d['expected_wall_s'])}"
+                f" actual={_fmt_s(d['actual_wall_s'])} (x{d['ratio']})"
+                f"  baseline_n={d['baseline_n']} recent_n={d['recent_n']}"
             )
     if report["slo"]:
         lines += ["", "SLO compliance (recorded serving traffic):"]
@@ -487,6 +588,12 @@ def main(argv=None) -> int:
         "--recent", type=int, default=5, help="recent-window size for drift"
     )
     ap.add_argument(
+        "--fingerprint",
+        default=None,
+        metavar="PREFIX",
+        help="only plan classes whose fingerprint starts with PREFIX",
+    )
+    ap.add_argument(
         "--compare",
         default=None,
         metavar="DIR",
@@ -504,7 +611,9 @@ def main(argv=None) -> int:
     if not os.path.isdir(args.history_dir):
         print(f"hsreport: not a directory: {args.history_dir}", file=sys.stderr)
         return 2
-    report = build_report(args.history_dir, args.top, args.recent)
+    report = build_report(
+        args.history_dir, args.top, args.recent, fingerprint=args.fingerprint
+    )
     if args.json:
         print(json.dumps(report, indent=2, default=str))
     else:
