@@ -582,9 +582,19 @@ def _decode_into_cache(
     inside the miss body."""
     from .scan_cache import global_scan_cache
 
+    from ..serve import replicas as _replicas
+
     return _singleflight.shared(
         ("file", path, _cols_key(file_columns)),
-        lambda: _decode_into_cache_miss(path, file_format, file_columns),
+        # Cross-replica discipline OUTSIDE the miss body (serve.replicas;
+        # no-op at one env read without a fleet): an owned file decodes
+        # directly, a foreign cold file first takes the fleet's on-lake
+        # lease so K replicas hitting one cold file serialize onto the page
+        # cache the first decode warmed — the in-process flight above it
+        # keeps deduplicating threads exactly as before.
+        lambda: _replicas.coordinate_decode(
+            path, lambda: _decode_into_cache_miss(path, file_format, file_columns)
+        ),
         lambda: global_scan_cache().get(path, file_columns, record=False),
     )
 
@@ -737,9 +747,15 @@ def _decode_rg_into_cache(
     the flights guard."""
     from .scan_cache import global_scan_cache
 
+    from ..serve import replicas as _replicas
+
     return _singleflight.shared(
         ("file", path, tuple(cols), tuple(sel)),
-        lambda: _decode_rg_into_cache_miss(path, cols, sel, meta),
+        # Same cross-replica guard as the whole-file flight: routed by FILE
+        # (not selection) so one replica owns all of a file's pruned reads.
+        lambda: _replicas.coordinate_decode(
+            path, lambda: _decode_rg_into_cache_miss(path, cols, sel, meta)
+        ),
         lambda: global_scan_cache().get(path, cols, record=False, sel=sel),
     )
 

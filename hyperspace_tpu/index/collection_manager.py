@@ -388,13 +388,37 @@ class CachingIndexCollectionManager(IndexCollectionManager):
     the cache with the pre-commit generation DURING that window — with only
     the pre-clear, the committed entry stayed invisible for up to the cache
     TTL after the action returned. The after-clear runs in a `finally` so a
-    failed action's transient orphan is also re-read, not trusted from cache."""
+    failed action's transient orphan is also re-read, not trusted from cache.
+
+    Under a replica fleet (``HYPERSPACE_REPLICAS=1``, `serve.replicas`) the
+    clear crosses processes: a committed mutation additionally PUBLISHES the
+    index's new latest ``log_entry_id`` to the fleet's epoch file, and every
+    replica's `get_indexes` polls the epoch signature (one rate-limited
+    `os.stat`) before trusting its TTL cache — a refresh/compaction landed by
+    ANY replica flips every replica's readers to the new stable generation
+    without waiting out the TTL. Fleet off = one env read, byte-identical
+    single-process behavior."""
 
     def __init__(self, session: HyperspaceSession, **kwargs):
         super().__init__(session, **kwargs)
         self._cache = IndexCacheFactory.create(session.hs_conf.cache_type, session)
+        # This manager's PRIVATE invalidation cursor (serve.replicas): a
+        # shared cursor would let one manager consume the epoch signal and
+        # starve every other manager of its cache clear.
+        self._epoch_state: dict = {}
+
+    def _fleet_registry_dir(self) -> str:
+        from ..serve import replicas as _replicas
+
+        return _replicas.registry_dir(self._session.warehouse)
 
     def get_indexes(self, states_filter: Optional[Sequence[str]] = None) -> List[IndexLogEntry]:
+        from ..serve import replicas as _replicas
+
+        if _replicas.fleet_enabled() and _replicas.check_invalidation(
+            self._epoch_state, self._fleet_registry_dir()
+        ):
+            self._cache.clear()
         cached = self._cache.get()
         if cached is None:
             cached = super().get_indexes(None)
@@ -406,30 +430,67 @@ class CachingIndexCollectionManager(IndexCollectionManager):
     def clear_cache(self) -> None:
         self._cache.clear()
 
-    def _mutate(self, fn) -> None:
+    def _publish_fleet_invalidation(self, index_name: Optional[str]) -> None:
+        """Announce a committed mutation's latest log id to the fleet
+        (no-op at one env read without a fleet; never fails the action)."""
+        from ..serve import replicas as _replicas
+
+        if index_name is None or not _replicas.fleet_enabled():
+            return
+        try:
+            log_mgr, _, _ = self._managers_for(index_name)
+            _replicas.publish_invalidation(
+                index_name, log_mgr.get_latest_id(), self._fleet_registry_dir()
+            )
+        except Exception:
+            pass
+
+    def _mutate(self, fn, index_name: Optional[str] = None) -> None:
         self.clear_cache()
         try:
             fn()
+            self._publish_fleet_invalidation(index_name)
         finally:
             self.clear_cache()
 
     def create(self, df, index_config) -> None:
-        self._mutate(lambda: super(CachingIndexCollectionManager, self).create(df, index_config))
+        self._mutate(
+            lambda: super(CachingIndexCollectionManager, self).create(df, index_config),
+            index_config.index_name,
+        )
 
     def delete(self, index_name: str) -> None:
-        self._mutate(lambda: super(CachingIndexCollectionManager, self).delete(index_name))
+        self._mutate(
+            lambda: super(CachingIndexCollectionManager, self).delete(index_name),
+            index_name,
+        )
 
     def restore(self, index_name: str) -> None:
-        self._mutate(lambda: super(CachingIndexCollectionManager, self).restore(index_name))
+        self._mutate(
+            lambda: super(CachingIndexCollectionManager, self).restore(index_name),
+            index_name,
+        )
 
     def vacuum(self, index_name: str) -> None:
-        self._mutate(lambda: super(CachingIndexCollectionManager, self).vacuum(index_name))
+        self._mutate(
+            lambda: super(CachingIndexCollectionManager, self).vacuum(index_name),
+            index_name,
+        )
 
     def refresh(self, index_name: str, mode: str = "full") -> None:
-        self._mutate(lambda: super(CachingIndexCollectionManager, self).refresh(index_name, mode))
+        self._mutate(
+            lambda: super(CachingIndexCollectionManager, self).refresh(index_name, mode),
+            index_name,
+        )
 
     def optimize(self, index_name: str, mode: str = "quick") -> None:
-        self._mutate(lambda: super(CachingIndexCollectionManager, self).optimize(index_name, mode))
+        self._mutate(
+            lambda: super(CachingIndexCollectionManager, self).optimize(index_name, mode),
+            index_name,
+        )
 
     def cancel(self, index_name: str) -> None:
-        self._mutate(lambda: super(CachingIndexCollectionManager, self).cancel(index_name))
+        self._mutate(
+            lambda: super(CachingIndexCollectionManager, self).cancel(index_name),
+            index_name,
+        )

@@ -7,6 +7,11 @@ One engine process, heavy parallel traffic. Three pieces compose:
   token budgets, classified `AdmissionRejectedError` load shedding.
 - `singleflight` — cross-query deduplication over the engine's shared
   caches: N identical concurrent cold requests decode the lake once.
+- `replicas` — the scale-out half (``HYPERSPACE_REPLICAS=1``): an on-lake
+  replica registry with heartbeat liveness and claim-by-rename reclaim,
+  rendezvous-hash file routing + an on-lake decode lease (K processes
+  decode each cold file once fleet-wide), epoch-file cache invalidation
+  keyed on committed log entry ids, and fleet-apportioned tenant budgets.
 - tenant labels end to end — every served query's root span, ledger,
   exporter frame, and Prometheus series carries its tenant
   (`telemetry.accounting.tenant_scope`).
@@ -21,6 +26,17 @@ from .admission import (  # noqa: F401
     AdmissionController,
     default_queue_depth,
     default_tenant_budget,
+)
+from .replicas import (  # noqa: F401
+    ENV_REPLICA_DIR,
+    ENV_REPLICAS,
+    fleet_enabled,
+    fleet_stats,
+    join_fleet,
+    leave_fleet,
+    live_replicas,
+    owner_of,
+    replica_id,
 )
 from .scheduler import (  # noqa: F401
     ENV_MAX_CONCURRENT,

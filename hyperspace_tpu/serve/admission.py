@@ -19,6 +19,14 @@ queued:
   while everyone else keeps flowing — per-tenant isolation without weighing
   queries against each other.
 
+Under a replica fleet (``HYPERSPACE_REPLICAS=1``, `serve.replicas`) the
+tenant budget is a FLEET budget: each replica enforces its apportioned
+share ``ceil(budget / live_replicas)`` (floor 1), re-read from the live
+membership view at every admit — a joining replica shrinks everyone's
+share, a SIGKILLed one returns its share to the survivors, both within one
+view-refresh period and with no coordination beyond the on-lake registry.
+Fleet off = the configured budget verbatim (one env read).
+
 ``serve.admit`` is a named fault point (`telemetry.faults`): the chaos
 harness can make admission itself flaky, and the mixed-workload chaos leg
 asserts results stay byte-identical to serial execution anyway.
@@ -87,11 +95,23 @@ class AdmissionController:
         self._in_flight = 0
         self._per_tenant: Dict[str, int] = {}
 
+    def effective_tenant_budget(self) -> int:
+        """The budget this replica enforces RIGHT NOW: the configured value
+        apportioned across live fleet members (`serve.replicas`), or
+        verbatim outside a fleet. Recomputed per admit so membership
+        changes rebalance without any explicit signal."""
+        if not self.tenant_budget:
+            return self.tenant_budget
+        from . import replicas as _replicas
+
+        return _replicas.apportioned_budget(self.tenant_budget)
+
     def admit(self, tenant: str) -> None:
         """Grant one in-flight token to `tenant` or raise
         `AdmissionRejectedError`. The ``serve.admit`` fault point fires first
         (an injected fault is an admission-path failure, not a rejection)."""
         _faults.check("serve.admit")
+        budget = self.effective_tenant_budget()
         with self._lock:
             if self._in_flight >= self.queue_depth:
                 _REJECTED_DEPTH.inc()
@@ -103,11 +123,12 @@ class AdmissionController:
                     tenant=tenant,
                 )
             held = self._per_tenant.get(tenant, 0)
-            if self.tenant_budget and held >= self.tenant_budget:
+            if budget and held >= budget:
                 _REJECTED_TENANT.inc()
                 raise AdmissionRejectedError(
                     f"tenant '{tenant}' at HYPERSPACE_SERVE_TENANT_BUDGET="
-                    f"{self.tenant_budget} in-flight queries; rejecting (other "
+                    f"{self.tenant_budget} (this replica's fleet share: "
+                    f"{budget}) in-flight queries; rejecting (other "
                     "tenants are unaffected)",
                     reason="tenant_budget",
                     tenant=tenant,
@@ -128,10 +149,14 @@ class AdmissionController:
             _TENANTS_ACTIVE.set(len(self._per_tenant))
 
     def stats(self) -> dict:
+        effective = self.effective_tenant_budget()
         with self._lock:
-            return {
+            out = {
                 "in_flight": self._in_flight,
                 "queue_depth": self.queue_depth,
                 "tenant_budget": self.tenant_budget,
                 "per_tenant": dict(self._per_tenant),
             }
+        if effective != self.tenant_budget:
+            out["tenant_budget_fleet_share"] = effective
+        return out

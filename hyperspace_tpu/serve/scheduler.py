@@ -198,6 +198,14 @@ class QueryServer:
             else max(1, int(max_concurrent))
         )
         self.admission = AdmissionController(queue_depth, tenant_budget)
+        # Fleet membership (HYPERSPACE_REPLICAS=1, serve.replicas): the
+        # serving front door IS the replica — constructing one registers
+        # this process in the on-lake registry and starts its heartbeat.
+        # Idempotent across servers in one process; one env read when off.
+        from . import replicas as _replicas
+
+        if _replicas.fleet_enabled():
+            _replicas.join_fleet()
         self._cv = threading.Condition()
         self._lanes = {lane: deque() for lane in LANES}
         self._workers: list = []
@@ -442,4 +450,8 @@ class QueryServer:
                 "serving_enabled": serving_enabled(),
             }
         )
+        from . import replicas as _replicas
+
+        if _replicas.fleet_enabled():
+            out["replicas"] = _replicas.fleet_stats()
         return out

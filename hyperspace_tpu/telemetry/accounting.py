@@ -451,6 +451,16 @@ def ledger_scope(query_id: str, name: str, root=None) -> Iterator[QueryLedger]:
                 _planner.annotate_close(led, wall)
             except Exception:
                 pass
+        # Fleet attribution (serve.replicas): the process's stable replica
+        # id rides every closed ledger — fleet on or off — so a shared
+        # history dir written by K replicas splits per-replica afterwards
+        # (tools/hsreport.py). Consumers tolerate unknown keys by contract.
+        try:
+            from ..serve.replicas import replica_id as _rid
+
+            led.set_value("replica_id", _rid())
+        except Exception:
+            pass
         _bank_tenant(led)
         d = led.to_dict()
         if root is not None:

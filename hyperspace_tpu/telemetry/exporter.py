@@ -40,6 +40,7 @@ import json
 import math
 import os
 import signal
+import socket
 import sys
 import threading
 import time
@@ -117,6 +118,15 @@ class MetricsExporter:
             "ledgers": accounting.drain_pending(),
             "compile_programs": compile_log.program_summary(),
         }
+        # Fleet attribution (serve.replicas): which replica wrote this frame
+        # — a dashboard tailing K replicas' streams splits by it. Stamped
+        # unconditionally (consumers tolerate unknown keys by contract).
+        try:
+            from ..serve.replicas import replica_id as _rid
+
+            out["replica_id"] = _rid()
+        except Exception:
+            pass
         # Persistent-compile-cache traffic: only when the knob is live or an
         # event fired, so pre-existing frame consumers see unchanged schemas.
         cache = compile_log.compile_cache_summary()
@@ -392,4 +402,25 @@ def prometheus_text(prefix: str = "hyperspace") -> str:
                     lines.append(f"# TYPE {n} {mtype}")
                     rendered_type = True
                 lines.append(f'{n}{{lane="{lane}"}} {_prom_num(v)}')
+    # Replica identity as a Prometheus info series (the `build_info`
+    # pattern): constant 1, identity in the labels — joins any other series
+    # from this process to its replica on the fleet dashboard. Rendered
+    # unconditionally (one process = one series); label values escaped like
+    # the tenant series above.
+    try:
+        from ..serve.replicas import replica_id as _rid
+
+        def _esc(v):
+            return (
+                str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+            )
+
+        n = f"{prefix}_replica_info"
+        lines.append(f"# TYPE {n} gauge")
+        lines.append(
+            f'{n}{{replica_id="{_esc(_rid())}",host="{_esc(socket.gethostname())}",'
+            f'pid="{os.getpid()}"}} 1'
+        )
+    except Exception:
+        pass
     return "\n".join(lines) + "\n"

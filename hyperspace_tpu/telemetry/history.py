@@ -637,6 +637,16 @@ class HistoryStore:
             "fingerprint": fingerprint,
             "ledger": ledger,
         }
+        # Record-envelope replica stamp (serve.replicas): segments from K
+        # replicas co-exist in one shared history dir, and the envelope
+        # stamp attributes every record kind — not just ledgers — to its
+        # writer (readers tolerate unknown keys by the segment contract).
+        try:
+            from ..serve.replicas import replica_id as _rid
+
+            rec["replica_id"] = _rid()
+        except Exception:
+            pass
         # json.dumps defaults to ensure_ascii=True, so the line is pure
         # ASCII and len(line) == encoded bytes — the segment-cap arithmetic
         # below is exact without paying an encode.

@@ -1,0 +1,153 @@
+"""Replica fleet across a REAL `jax.distributed` 2-process CPU mesh.
+
+The CI-able stand-in for a multi-host pod (docs/serving.md "Replica fleet"):
+two local processes each initialize the JAX distributed runtime (so each is
+a genuine jax "host" with its own process_id), join one on-lake replica
+registry, and must agree on membership, rendezvous ownership, and epoch
+invalidation — and both must return byte-identical query results over the
+shared lake.
+
+Marked ``slow``: coordinator startup costs seconds, and tier-1 (`-m 'not
+slow'`) skips it; the dedicated CI mesh leg runs it explicitly.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD = r"""
+import json, os, sys, time
+sys.path.insert(0, {repo!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+proc_id = int(sys.argv[1])
+port = sys.argv[2]
+
+import jax
+
+jax.distributed.initialize(
+    coordinator_address=f"localhost:{{port}}", num_processes=2, process_id=proc_id
+)
+
+from hyperspace_tpu.engine import HyperspaceSession, col
+from hyperspace_tpu.serve import replicas as R
+
+out = {{"proc": proc_id, "jax_procs": jax.process_count()}}
+rid = R.join_fleet()
+out["replica_id"] = rid
+
+deadline = time.time() + 30
+while len(R.live_replicas(refresh=True)) < 2:
+    assert time.time() < deadline, "fleet barrier timeout"
+    time.sleep(0.05)
+members = R.live_replicas()
+out["members"] = members
+out["owners"] = {{f"key{{i}}": R.owner_of(f"key{{i}}", members) for i in range(20)}}
+
+# Process 0 publishes an invalidation; process 1 must observe the flip.
+cursor = {{}}
+R.check_invalidation(cursor)
+if proc_id == 0:
+    R.publish_invalidation("meshIdx", 42)
+    out["observed"] = True
+else:
+    deadline = time.time() + 15
+    seen = False
+    while not seen and time.time() < deadline:
+        seen = R.check_invalidation(cursor)
+        time.sleep(0.02)
+    out["observed"] = seen
+    out["epoch_entry"] = R.read_epoch().get("entries", {{}}).get("meshIdx")
+
+# Both processes answer the same query over the shared lake.
+s = HyperspaceSession(warehouse=os.environ["MESH_WAREHOUSE"])
+rows = (
+    s.read.parquet(os.path.join(os.environ["MESH_WAREHOUSE"], "t"))
+    .filter(col("k") < 50)
+    .select("k", "v")
+    .collect()
+    .sorted_rows()
+)
+out["rows"] = [[int(a), int(b)] for a, b in rows]
+R.leave_fleet()
+print("RESULT " + json.dumps(out), flush=True)
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_jax_distributed_fleet(tmp_path):
+    wh = str(tmp_path / "wh")
+    from hyperspace_tpu.engine import HyperspaceSession
+
+    sess = HyperspaceSession(warehouse=wh)
+    sess.write_parquet(
+        {
+            "k": np.arange(500, dtype=np.int64),
+            "v": (np.arange(500, dtype=np.int64) * 3) % 101,
+        },
+        os.path.join(wh, "t"),
+    )
+    reg = str(tmp_path / "registry")
+    port = _free_port()
+    env = dict(os.environ)
+    env.update(
+        {
+            "JAX_PLATFORMS": "cpu",
+            "HYPERSPACE_REPLICAS": "1",
+            "HYPERSPACE_REPLICA_DIR": reg,
+            "HYPERSPACE_REPLICA_VIEW_S": "0",
+            "HYPERSPACE_REPLICA_EPOCH_CHECK_S": "0",
+            "MESH_WAREHOUSE": wh,
+        }
+    )
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _CHILD.format(repo=REPO), str(i), str(port)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    results = {}
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, err[-800:]
+        line = [l for l in out.splitlines() if l.startswith("RESULT ")][-1]
+        r = json.loads(line[len("RESULT ") :])
+        results[r["proc"]] = r
+
+    a, b = results[0], results[1]
+    # Both are genuine jax.distributed processes...
+    assert a["jax_procs"] == 2 and b["jax_procs"] == 2
+    # ...agreeing on fleet membership and rendezvous ownership...
+    assert a["replica_id"] != b["replica_id"]
+    assert a["members"] == b["members"]
+    assert set(a["members"]) == {a["replica_id"], b["replica_id"]}
+    assert a["owners"] == b["owners"]
+    owned = set(a["owners"].values())
+    assert owned == set(a["members"]), "both replicas should own some keys"
+    # ...the epoch publish from proc 0 reached proc 1 (no TTL wait)...
+    assert b["observed"] is True
+    assert b["epoch_entry"] == 42
+    # ...and both answered the shared-lake query byte-identically.
+    assert a["rows"] == b["rows"] and a["rows"]

@@ -27,6 +27,13 @@ Sections:
 - **SLO compliance** — lane-labeled ledgers (served queries) judged against
   the ambient ``HYPERSPACE_SLO_*`` objectives via `telemetry.slo.
   compliance_over` — the offline twin of the live monitor.
+- **Replica fleet** — when the store was written by a replica fleet
+  (``HYPERSPACE_REPLICAS=1``: K server processes landing segments in ONE
+  shared history dir, each record stamped with its writer's `replica_id`),
+  the fleet-wide totals split per replica: records, attributed wall,
+  per-lane SLO compliance (the same `compliance_over` judgment, scoped to
+  one replica's traffic), and each replica's top plan classes — is the
+  fleet balanced, and is one replica dragging the lane SLO.
 - **Hotspots** — compile-storm classes (most XLA compiles) and retry
   hotspots (most io retries): where warm-path latency is going to compile
   or fault churn.
@@ -128,6 +135,7 @@ def build_report(
         "drift": drift(raw, checkpoints, recent_k)[:top],
         "stage_drift": _stage_drift(raw, checkpoints, recent_k, top),
         "slo": _slo.compliance_over(all_ledgers),
+        "replicas": _replica_fleet(raw, top),
         "compile_hotspots": [
             {
                 "fingerprint": fp,
@@ -231,6 +239,55 @@ def _stage_drift(
             )
     rows.sort(key=lambda r: -r["ratio"])
     return rows[:top]
+
+
+def _replica_fleet(raw: Dict[str, list], top: int) -> Optional[dict]:
+    """Per-replica vs fleet split of a shared history dir. Records are
+    attributed by the segment-record envelope stamp (every record lands
+    with its writer's `replica_id`; older ledgers may carry it only inside
+    the ledger dict — both are read). None when no record is stamped (a
+    pre-fleet store) so pre-existing report consumers see an unchanged
+    report shape."""
+    by_replica: Dict[str, dict] = {}
+    stamped = 0
+    for fp, recs in raw.items():
+        for r in recs:
+            led = r.get("ledger") or {}
+            rid = r.get("replica_id") or led.get("replica_id")
+            if not rid:
+                continue
+            stamped += 1
+            st = by_replica.setdefault(
+                rid, {"records": 0, "wall_s": 0.0, "ledgers": [], "classes": {}}
+            )
+            st["records"] += 1
+            st["wall_s"] += float(led.get("wall_s") or 0.0)
+            st["ledgers"].append(led)
+            cl = st["classes"]
+            cl[fp] = cl.get(fp, 0.0) + float(led.get("wall_s") or 0.0)
+    if not stamped:
+        return None
+    replicas = {}
+    for rid, st in sorted(by_replica.items()):
+        top_classes = sorted(st["classes"].items(), key=lambda kv: -kv[1])[:top]
+        replicas[rid] = {
+            "records": st["records"],
+            "wall_s": round(st["wall_s"], 3),
+            "slo": _slo.compliance_over(st["ledgers"]),
+            "top_classes": [
+                {"fingerprint": fp, "wall_s": round(w, 3)} for fp, w in top_classes
+            ],
+        }
+    all_ledgers = [led for st in by_replica.values() for led in st["ledgers"]]
+    return {
+        "replicas": replicas,
+        "fleet": {
+            "size": len(by_replica),
+            "records": stamped,
+            "wall_s": round(sum(st["wall_s"] for st in by_replica.values()), 3),
+            "slo": _slo.compliance_over(all_ledgers),
+        },
+    }
 
 
 def _device_hotspots(baselines: Dict[str, dict], top: int) -> List[dict]:
@@ -462,6 +519,37 @@ def render(report: dict) -> str:
                 f"{s['compliance'] if s['compliance'] is not None else '-'}"
                 f" (target {s['target']:.2%}) {verdict}"
             )
+    if report.get("replicas"):
+        fleet = report["replicas"]["fleet"]
+        lines += [
+            "",
+            f"replica fleet: {fleet['size']} replica(s), {fleet['records']} "
+            f"records, total wall {fleet['wall_s']:.3f}s",
+        ]
+        for lane, s in (fleet.get("slo") or {}).items():
+            verdict = "MET" if s["met"] else ("MISSED" if s["met"] is not None else "-")
+            lines.append(
+                f"  fleet {lane}: {s['total']} queries, {s['violations']} over "
+                f"{s['objective_ms']:g}ms, compliance="
+                f"{s['compliance'] if s['compliance'] is not None else '-'} {verdict}"
+            )
+        for rid, st in report["replicas"]["replicas"].items():
+            lines.append(
+                f"  {rid}: {st['records']} records, wall {st['wall_s']:.3f}s"
+            )
+            for lane, s in (st.get("slo") or {}).items():
+                verdict = (
+                    "MET" if s["met"] else ("MISSED" if s["met"] is not None else "-")
+                )
+                lines.append(
+                    f"    {lane}: {s['total']} queries, compliance="
+                    f"{s['compliance'] if s['compliance'] is not None else '-'} "
+                    f"{verdict}"
+                )
+            for c in st.get("top_classes") or []:
+                lines.append(
+                    f"    class {c['fingerprint']}  wall={c['wall_s']:.3f}s"
+                )
     if report["compile_hotspots"]:
         lines += ["", "compile-storm hotspots (XLA compiles per class):"]
         for h in report["compile_hotspots"]:
